@@ -1,0 +1,76 @@
+"""Benchmark harness. Prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline metric (BASELINE.md north star): ImageNet CaffeNet training
+throughput, images/sec/chip, on the real TPU chip. The reference never
+committed numbers (SURVEY.md §6); `vs_baseline` is measured against
+REFERENCE_IMG_PER_SEC below — the published CaffeNet-era single-GPU training
+throughput class the SparkNet paper's workers ran at (K520, Caffe, batch 256:
+~2.5 s/iter ≈ ~100 images/sec/GPU). Update when real paper numbers land.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+# SparkNet-era per-worker Caffe AlexNet throughput (images/sec on one
+# g2.8xlarge K520 GPU — the hardware class in reference README.md:13-28).
+REFERENCE_IMG_PER_SEC = 100.0
+
+BATCH = 256
+WARMUP = 3
+ITERS = 10
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from sparknet_tpu import CompiledNet
+    from sparknet_tpu import precision
+    from sparknet_tpu.solver import SgdSolver, SolverConfig
+    from sparknet_tpu.zoo import caffenet
+
+    precision.set_policy("bfloat16")  # MXU fast path; f32 accumulation
+    net = CompiledNet.compile(caffenet(batch=BATCH, crop=227, n_classes=1000))
+    solver = SgdSolver(net, SolverConfig(
+        base_lr=0.01, momentum=0.9, weight_decay=5e-4,
+        lr_policy="step", gamma=0.1, stepsize=100000))
+    params = net.init_params(jax.random.PRNGKey(0))
+    state = solver.init_state(params)
+    rng = np.random.default_rng(0)
+    batch = {
+        "data": jax.numpy.asarray(
+            rng.standard_normal((BATCH, 227, 227, 3), dtype=np.float32)),
+        "label": jax.numpy.asarray(
+            rng.integers(0, 1000, (BATCH, 1)).astype(np.int32)),
+    }
+
+    for i in range(WARMUP):
+        params, state, loss = solver.step(params, state, batch,
+                                          jax.random.PRNGKey(i))
+    # NOTE: scalar fetch, not block_until_ready — the axon relay platform
+    # treats block_until_ready as a no-op; only a D2H copy synchronizes.
+    float(loss)
+
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        params, state, loss = solver.step(params, state, batch,
+                                          jax.random.PRNGKey(100 + i))
+    # fetch a weight scalar too: forces the last backward+update, not just
+    # the last forward (loss alone would let one backward escape timing).
+    float(loss)
+    float(params["conv1"]["b"][0])
+    dt = time.perf_counter() - t0
+
+    img_per_sec = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "caffenet_train_images_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec / REFERENCE_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+from .spec import NetSpec, LayerSpec, InputSpec, Filler  # noqa: F401
+from .net import CompiledNet  # noqa: F401

@@ -1,0 +1,61 @@
+"""Device-pytree <-> Caffe-layout WeightCollection conversion.
+
+The device stores TPU-first layouts (conv HWIO, inner-product (in, out) with
+NCHW-flatten row ordering); Caffe stores OIHW and (out, in). These conversions
+are exact permutations, so a get_weights -> set_weights round trip is
+bit-identical — the property the reference's sync loop depended on
+(`libs/CaffeNet.scala:123-150`).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from .net import CompiledNet, PyTree
+from .weights import WeightCollection
+
+
+def params_to_collection(net: CompiledNet, params: PyTree) -> WeightCollection:
+    """Device pytree -> Caffe-layout host WeightCollection."""
+    weights: Dict[str, List[np.ndarray]] = {}
+    order: List[str] = []
+    for layer in net.spec.layers:
+        if layer.name not in params:
+            continue
+        order.append(layer.name)
+        lp = params[layer.name]
+        blobs: List[np.ndarray] = []
+        w = np.asarray(lp["w"], dtype=np.float32)
+        if layer.type == "Convolution":
+            blobs.append(np.transpose(w, (3, 2, 0, 1)))  # HWIO -> OIHW
+        elif layer.type == "InnerProduct":
+            blobs.append(np.ascontiguousarray(w.T))  # (in,out) -> (out,in)
+        else:
+            blobs.append(w)
+        if "b" in lp:
+            blobs.append(np.asarray(lp["b"], dtype=np.float32))
+        weights[layer.name] = blobs
+    return WeightCollection(weights, order)
+
+
+def collection_to_params(net: CompiledNet, coll: WeightCollection) -> PyTree:
+    """Caffe-layout WeightCollection -> device pytree (with shape asserts)."""
+    params: PyTree = {}
+    for layer in net.spec.layers:
+        if layer.name not in coll:
+            continue
+        blobs = coll[layer.name]
+        lp: Dict[str, jnp.ndarray] = {}
+        w = blobs[0]
+        if layer.type == "Convolution":
+            lp["w"] = jnp.asarray(np.transpose(w, (2, 3, 1, 0)))  # OIHW -> HWIO
+        elif layer.type == "InnerProduct":
+            lp["w"] = jnp.asarray(np.ascontiguousarray(w.T))
+        else:
+            lp["w"] = jnp.asarray(w)
+        if len(blobs) > 1:
+            lp["b"] = jnp.asarray(blobs[1])
+        params[layer.name] = lp
+    return params

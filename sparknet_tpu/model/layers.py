@@ -1,0 +1,328 @@
+"""JAX implementations of the Caffe layer set, NHWC / TPU-first.
+
+Each layer type provides:
+  - `init_<type>(key, layer, in_shapes) -> params dict` (parametric layers)
+  - `apply_<type>(layer, params, inputs, ctx) -> outputs tuple`
+  - `infer_<type>(layer, in_shapes) -> out_shapes tuple`
+
+Layout: image tensors are NHWC on device (TPU-native minor-dim = channels →
+lanes). Parameter storage is also TPU-first: conv weights HWIO, inner-product
+weights (in, out). Caffe-layout import/export (OIHW, (out, in) with
+NCHW-flatten ordering) lives in `sparknet_tpu.model.caffe_compat` so that
+`.caffemodel`-style weights round-trip exactly.
+
+Semantics parity notes are per-layer, citing the reference's model zoo usage
+(files under /root/reference/models/) since the actual kernels lived in
+native Caffe (see reference `libs/CaffeNet.scala:91,118`).
+"""
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops.pooling import caffe_pool_output_size, global_pool2d, pool2d
+from ..ops.lrn import lrn as lrn_op
+from .. import precision
+from .spec import Filler, LayerSpec
+
+Params = Dict[str, jnp.ndarray]
+
+
+@dataclasses.dataclass
+class ApplyCtx:
+    """Per-call context threaded through layer application."""
+
+    train: bool = False
+    rng: Optional[jax.Array] = None
+
+    def fold(self, name: str) -> jax.Array:
+        assert self.rng is not None, "dropout in train mode needs an rng key"
+        # crc32, not hash(): Python string hashing is randomized per process,
+        # which would make dropout masks irreproducible across runs/hosts.
+        return jax.random.fold_in(self.rng, zlib.crc32(name.encode()))
+
+
+# ---------------------------------------------------------------------------
+# Fillers (Caffe FillerParameter semantics)
+# ---------------------------------------------------------------------------
+
+
+def fill(key: jax.Array, filler: Filler, shape: Tuple[int, ...],
+         fan_in: int) -> jnp.ndarray:
+    t = filler.type
+    if t == "constant":
+        return jnp.full(shape, filler.value, dtype=jnp.float32)
+    if t == "gaussian":
+        return filler.mean + filler.std * jax.random.normal(key, shape)
+    if t == "xavier":
+        scale = float(np.sqrt(3.0 / fan_in))
+        return jax.random.uniform(key, shape, minval=-scale, maxval=scale)
+    if t == "msra":
+        std = float(np.sqrt(2.0 / fan_in))
+        return std * jax.random.normal(key, shape)
+    if t == "uniform":
+        return jax.random.uniform(key, shape, minval=filler.min,
+                                  maxval=filler.max)
+    raise ValueError(f"unknown filler type {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# Convolution
+# ---------------------------------------------------------------------------
+
+
+def infer_convolution(layer: LayerSpec, in_shapes):
+    (n, h, w, c), = in_shapes[:1]
+    p = layer.conv
+    oh = (h + 2 * p.pad - p.kernel_size) // p.stride + 1
+    ow = (w + 2 * p.pad - p.kernel_size) // p.stride + 1
+    return ((n, oh, ow, p.num_output),)
+
+
+def init_convolution(key, layer: LayerSpec, in_shapes) -> Params:
+    p = layer.conv
+    c_in = in_shapes[0][-1]
+    fan_in = (c_in // p.group) * p.kernel_size * p.kernel_size
+    wkey, bkey = jax.random.split(key)
+    # HWIO with I = c_in / group (XLA grouped-conv convention).
+    w = fill(wkey, p.weight_filler,
+             (p.kernel_size, p.kernel_size, c_in // p.group, p.num_output),
+             fan_in)
+    params = {"w": w}
+    if p.bias_term:
+        params["b"] = fill(bkey, p.bias_filler, (p.num_output,), fan_in)
+    return params
+
+
+def apply_convolution(layer: LayerSpec, params: Params, inputs, ctx: ApplyCtx):
+    p = layer.conv
+    (x,) = inputs
+    x = precision.cast_in(x)
+    y = lax.conv_general_dilated(
+        x,
+        precision.cast_in(params["w"]),
+        window_strides=(p.stride, p.stride),
+        padding=((p.pad, p.pad), (p.pad, p.pad)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=p.group,
+        precision=precision.matmul_precision(),
+        preferred_element_type=precision.preferred_out(),
+    )
+    if "b" in params:
+        y = y + params["b"].astype(y.dtype)
+    return (y,)
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+
+def infer_pooling(layer: LayerSpec, in_shapes):
+    n, h, w, c = in_shapes[0]
+    p = layer.pool
+    if p.global_pooling:
+        return ((n, 1, 1, c),)
+    oh = caffe_pool_output_size(h, p.kernel_size, p.stride, p.pad)
+    ow = caffe_pool_output_size(w, p.kernel_size, p.stride, p.pad)
+    return ((n, oh, ow, c),)
+
+
+def apply_pooling(layer: LayerSpec, params, inputs, ctx: ApplyCtx):
+    p = layer.pool
+    (x,) = inputs
+    if p.global_pooling:
+        return (global_pool2d(x, p.pool),)
+    return (pool2d(x, p.pool, p.kernel_size, p.stride, p.pad),)
+
+
+# ---------------------------------------------------------------------------
+# LRN
+# ---------------------------------------------------------------------------
+
+
+def infer_lrn(layer: LayerSpec, in_shapes):
+    return (in_shapes[0],)
+
+
+def apply_lrn(layer: LayerSpec, params, inputs, ctx: ApplyCtx):
+    p = layer.lrn
+    (x,) = inputs
+    return (lrn_op(x, p.local_size, alpha=p.alpha, beta=p.beta, k=p.k),)
+
+
+# ---------------------------------------------------------------------------
+# ReLU
+# ---------------------------------------------------------------------------
+
+
+def infer_relu(layer: LayerSpec, in_shapes):
+    return (in_shapes[0],)
+
+
+def apply_relu(layer: LayerSpec, params, inputs, ctx: ApplyCtx):
+    (x,) = inputs
+    return (jnp.maximum(x, 0),)
+
+
+# ---------------------------------------------------------------------------
+# InnerProduct
+# ---------------------------------------------------------------------------
+
+
+def _flat_dim(shape: Tuple[int, ...]) -> int:
+    d = 1
+    for s in shape[1:]:
+        d *= s
+    return d
+
+
+def infer_innerproduct(layer: LayerSpec, in_shapes):
+    n = in_shapes[0][0]
+    return ((n, layer.inner_product.num_output),)
+
+
+def init_innerproduct(key, layer: LayerSpec, in_shapes) -> Params:
+    p = layer.inner_product
+    fan_in = _flat_dim(in_shapes[0])
+    wkey, bkey = jax.random.split(key)
+    # Stored (in, out): feeds the MXU directly as x @ w.
+    params = {"w": fill(wkey, p.weight_filler, (fan_in, p.num_output), fan_in)}
+    if p.bias_term:
+        params["b"] = fill(bkey, p.bias_filler, (p.num_output,), fan_in)
+    return params
+
+
+def apply_innerproduct(layer: LayerSpec, params: Params, inputs, ctx: ApplyCtx):
+    (x,) = inputs
+    if x.ndim == 4:
+        # Caffe flattens NCHW-ordered; transpose so imported Caffe weights
+        # (and exported ones) line up element-for-element.
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    x = precision.cast_in(x.reshape(x.shape[0], -1))
+    y = jnp.dot(x, precision.cast_in(params["w"]),
+                precision=precision.matmul_precision(),
+                preferred_element_type=precision.preferred_out())
+    if "b" in params:
+        y = y + params["b"].astype(y.dtype)
+    return (y,)
+
+
+# ---------------------------------------------------------------------------
+# Softmax / SoftmaxWithLoss / Accuracy
+# ---------------------------------------------------------------------------
+
+
+def infer_softmax(layer: LayerSpec, in_shapes):
+    return (in_shapes[0],)
+
+
+def apply_softmax(layer: LayerSpec, params, inputs, ctx: ApplyCtx):
+    (x,) = inputs
+    # Caffe softmax axis=1 == channel; channels are the last axis here.
+    return (jax.nn.softmax(x, axis=-1),)
+
+
+def _squeeze_label(label: jnp.ndarray) -> jnp.ndarray:
+    if label.ndim == 2 and label.shape[1] == 1:
+        label = label[:, 0]
+    return label.astype(jnp.int32)
+
+
+def infer_softmaxwithloss(layer: LayerSpec, in_shapes):
+    return ((),)
+
+
+def apply_softmaxwithloss(layer: LayerSpec, params, inputs, ctx: ApplyCtx):
+    logits, label = inputs
+    label = _squeeze_label(label)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, label[:, None], axis=-1)[:, 0]
+    return (jnp.mean(nll),)
+
+
+def infer_accuracy(layer: LayerSpec, in_shapes):
+    return ((),)
+
+
+def apply_accuracy(layer: LayerSpec, params, inputs, ctx: ApplyCtx):
+    logits, label = inputs
+    label = _squeeze_label(label)
+    k = layer.accuracy.top_k if layer.accuracy else 1
+    if k == 1:
+        correct = jnp.argmax(logits, axis=-1).astype(jnp.int32) == label
+    else:
+        topk = lax.top_k(logits, k)[1].astype(jnp.int32)
+        correct = jnp.any(topk == label[:, None], axis=-1)
+    return (jnp.mean(correct.astype(jnp.float32)),)
+
+
+# ---------------------------------------------------------------------------
+# Dropout
+# ---------------------------------------------------------------------------
+
+
+def infer_dropout(layer: LayerSpec, in_shapes):
+    return (in_shapes[0],)
+
+
+def apply_dropout(layer: LayerSpec, params, inputs, ctx: ApplyCtx):
+    (x,) = inputs
+    ratio = layer.dropout.dropout_ratio if layer.dropout else 0.5
+    if not ctx.train or ratio == 0.0:
+        return (x,)
+    keep = 1.0 - ratio
+    mask = jax.random.bernoulli(ctx.fold(layer.name), keep, x.shape)
+    # Caffe scales at train time by 1/keep so eval needs no rescale.
+    return (jnp.where(mask, x / keep, 0.0).astype(x.dtype),)
+
+
+# ---------------------------------------------------------------------------
+# Concat / Flatten (small extras used by common Caffe zoo nets)
+# ---------------------------------------------------------------------------
+
+
+def infer_concat(layer: LayerSpec, in_shapes):
+    base = list(in_shapes[0])
+    base[-1] = sum(s[-1] for s in in_shapes)
+    return (tuple(base),)
+
+
+def apply_concat(layer: LayerSpec, params, inputs, ctx: ApplyCtx):
+    return (jnp.concatenate(inputs, axis=-1),)
+
+
+def infer_flatten(layer: LayerSpec, in_shapes):
+    return ((in_shapes[0][0], _flat_dim(in_shapes[0])),)
+
+
+def apply_flatten(layer: LayerSpec, params, inputs, ctx: ApplyCtx):
+    (x,) = inputs
+    if x.ndim == 4:
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    return (x.reshape(x.shape[0], -1),)
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+LAYER_IMPLS = {
+    "Convolution": (init_convolution, apply_convolution, infer_convolution),
+    "Pooling": (None, apply_pooling, infer_pooling),
+    "LRN": (None, apply_lrn, infer_lrn),
+    "ReLU": (None, apply_relu, infer_relu),
+    "InnerProduct": (init_innerproduct, apply_innerproduct, infer_innerproduct),
+    "Softmax": (None, apply_softmax, infer_softmax),
+    "SoftmaxWithLoss": (None, apply_softmaxwithloss, infer_softmaxwithloss),
+    "Accuracy": (None, apply_accuracy, infer_accuracy),
+    "Dropout": (None, apply_dropout, infer_dropout),
+    "Concat": (None, apply_concat, infer_concat),
+    "Flatten": (None, apply_flatten, infer_flatten),
+}

@@ -1,0 +1,146 @@
+"""NetSpec -> pure JAX function compiler.
+
+The reference's equivalent is Caffe's native net builder (`FloatNet` built from
+a `NetParameter`, wrapped at reference `libs/CaffeNet.scala:28-68`). Here the
+"net" is data: a `CompiledNet` holds
+  - `init_params(key) -> params` (pytree: {layer_name: {"w": ..., "b": ...}})
+  - `apply(params, batch, train=, rng=) -> {blob_name: array}`
+and everything downstream (`jit`, `grad`, `shard_map`) composes functionally.
+
+Layout: 4D inputs are declared NCHW in prototxt but consumed NHWC on device;
+`CompiledNet.input_shapes` reports the NHWC shapes the caller must feed.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .layers import LAYER_IMPLS, ApplyCtx, Params
+from .spec import InputSpec, NetSpec, validate
+
+PyTree = Dict[str, Params]
+
+
+def _to_nhwc_shape(shape: Tuple[int, ...]) -> Tuple[int, ...]:
+    if len(shape) == 4:
+        n, c, h, w = shape
+        return (n, h, w, c)
+    return shape
+
+
+_DTYPES = {"float32": jnp.float32, "int32": jnp.int32, "bfloat16": jnp.bfloat16}
+
+
+@dataclasses.dataclass(frozen=True)
+class CompiledNet:
+    spec: NetSpec
+    #: blob name -> NHWC device shape for every net input
+    input_shapes: Dict[str, Tuple[int, ...]]
+    #: blob name -> dtype string
+    input_dtypes: Dict[str, str]
+    #: blob name -> shape for every top produced in TRAIN phase (() = scalar)
+    blob_shapes: Dict[str, Tuple[int, ...]]
+    #: names of output blobs (tops never consumed by a later layer), per phase
+    output_names: Tuple[str, ...]
+
+    # -- construction -------------------------------------------------------
+
+    @staticmethod
+    def compile(spec: NetSpec) -> "CompiledNet":
+        validate(spec)
+        input_shapes = {i.name: _to_nhwc_shape(i.shape) for i in spec.inputs}
+        input_dtypes = {i.name: i.dtype for i in spec.inputs}
+        blob_shapes: Dict[str, Tuple[int, ...]] = dict(input_shapes)
+        consumed: set = set()
+        produced: List[str] = list(input_shapes)
+        for layer in spec.layers:
+            if layer.type not in LAYER_IMPLS:
+                raise ValueError(f"unsupported layer type {layer.type!r} "
+                                 f"(layer {layer.name!r})")
+            _, _, infer = LAYER_IMPLS[layer.type]
+            in_shapes = tuple(blob_shapes[b] for b in layer.bottoms)
+            out_shapes = infer(layer, in_shapes)
+            for t, s in zip(layer.tops, out_shapes):
+                blob_shapes[t] = s
+                produced.append(t)
+            consumed.update(b for b in layer.bottoms if b not in layer.tops)
+        outputs = tuple(
+            dict.fromkeys(t for t in produced
+                          if t not in consumed and t not in input_shapes))
+        return CompiledNet(spec=spec, input_shapes=input_shapes,
+                           input_dtypes=input_dtypes, blob_shapes=blob_shapes,
+                           output_names=outputs)
+
+    # -- parameters ---------------------------------------------------------
+
+    def init_params(self, key: jax.Array) -> PyTree:
+        params: PyTree = {}
+        shapes: Dict[str, Tuple[int, ...]] = dict(self.input_shapes)
+        for layer in self.spec.layers:
+            init, _, infer = LAYER_IMPLS[layer.type]
+            in_shapes = tuple(shapes[b] for b in layer.bottoms)
+            if init is not None:
+                key, sub = jax.random.split(key)
+                params[layer.name] = init(sub, layer, in_shapes)
+            for t, s in zip(layer.tops, infer(layer, in_shapes)):
+                shapes[t] = s
+        return params
+
+    def param_layers(self) -> List[str]:
+        return [l.name for l in self.spec.layers
+                if LAYER_IMPLS[l.type][0] is not None]
+
+    # -- execution ----------------------------------------------------------
+
+    def apply(self, params: PyTree, batch: Dict[str, jnp.ndarray], *,
+              train: bool = False, rng: Optional[jax.Array] = None,
+              phase: Optional[str] = None) -> Dict[str, jnp.ndarray]:
+        """Run the net. `batch` maps input blob names to NHWC arrays.
+
+        Returns every blob produced (inputs excluded), so callers can read
+        hidden activations by name — parity with the reference's
+        `forward(rowIt, dataBlobNames)` path (`libs/CaffeNet.scala:101-107`)
+        used by FeaturizerApp.
+        """
+        phase = phase or ("TRAIN" if train else "TEST")
+        ctx = ApplyCtx(train=train, rng=rng)
+        blobs: Dict[str, jnp.ndarray] = dict(batch)
+        all_tops = set()
+        for layer in self.spec.layers_for_phase(phase):
+            _, apply_fn, _ = LAYER_IMPLS[layer.type]
+            inputs = tuple(blobs[b] for b in layer.bottoms)
+            outputs = apply_fn(layer, params.get(layer.name), inputs, ctx)
+            for t, v in zip(layer.tops, outputs):
+                blobs[t] = v
+                all_tops.add(t)
+        for name in batch:
+            if name not in all_tops:
+                blobs.pop(name, None)
+        return blobs
+
+    def loss_fn(self, loss_blob: str = "loss"):
+        """Returns `f(params, batch, rng) -> (loss, aux_blobs)` for jax.grad."""
+
+        def f(params, batch, rng=None):
+            blobs = self.apply(params, batch, train=True, rng=rng)
+            return blobs[loss_blob], blobs
+
+        return f
+
+    def example_batch(self, key: Optional[jax.Array] = None,
+                      batch_size: Optional[int] = None) -> Dict[str, jnp.ndarray]:
+        """Synthesize a correctly-shaped random batch (for tests/AOT warmup)."""
+        key = key if key is not None else jax.random.PRNGKey(0)
+        batch = {}
+        for name, shape in self.input_shapes.items():
+            if batch_size is not None:
+                shape = (batch_size,) + tuple(shape[1:])
+            key, sub = jax.random.split(key)
+            if self.input_dtypes[name] == "int32":
+                batch[name] = jax.random.randint(sub, shape, 0, 10, jnp.int32)
+            else:
+                batch[name] = jax.random.normal(sub, shape, jnp.float32)
+        return batch

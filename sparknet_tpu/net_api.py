@@ -1,0 +1,158 @@
+"""The framework's Net/Solver API surface — NetInterface parity, TPU-native.
+
+Reference API being matched (`libs/CaffeNet.scala:14-20`):
+
+    trait NetInterface {
+      def forward(rowIt): Array[Row]
+      def forwardBackward(rowIt): Unit
+      def getWeights(): WeightCollection
+      def setWeights(weights): Unit
+      def outputSchema(): StructType
+    }
+    trait Solver { def step(rowIt): Unit }            // CaffeSolver.scala:7-9
+
+`JaxNet` is the stateful convenience wrapper over the pure `CompiledNet` +
+`SgdSolver` core: it owns the current params/optimizer-state (device-resident,
+replicated or sharded), exposes forward / forward_backward / step /
+get_weights / set_weights / output_schema, and save/load. All compute methods
+are jit-compiled once and reused.
+
+Unlike the reference there is no JVM<->C++ copy per call: batches go host->
+device once, weights stay device-resident, and `get_weights` is the only
+deliberate device->host transfer (for checkpoint/export).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .model.caffe_compat import collection_to_params, params_to_collection
+from .model.net import CompiledNet, PyTree
+from .model.spec import NetSpec
+from .model.weights import WeightCollection
+from .schema import Field, Schema
+from .solver import SgdSolver, SolverConfig, SolverState
+
+
+def _maybe_nhwc(name: str, arr: np.ndarray, want_shape: Tuple[int, ...],
+                layout: str) -> np.ndarray:
+    """Accept NCHW host batches and transpose to device NHWC.
+
+    layout="auto" (default) disambiguates by matching the expected NHWC
+    element shape, so both reference-style NCHW batches and native NHWC
+    batches just work; pass "NHWC"/"NCHW" to force.
+    """
+    if arr.ndim != 4:
+        return arr
+    if layout == "NCHW":
+        return np.transpose(arr, (0, 2, 3, 1))
+    if layout == "auto":
+        want = tuple(want_shape[1:])
+        if tuple(arr.shape[1:]) != want and \
+                (arr.shape[2], arr.shape[3], arr.shape[1]) == want:
+            return np.transpose(arr, (0, 2, 3, 1))
+    return arr
+
+
+class JaxNet:
+    """Stateful net: CompiledNet + device params (+ optional solver)."""
+
+    def __init__(self, spec: NetSpec, *, seed: int = 0,
+                 solver: Optional[SolverConfig] = None,
+                 input_layout: str = "auto",
+                 loss_blob: str = "loss"):
+        self.net = CompiledNet.compile(spec)
+        self.input_layout = input_layout
+        self.params: PyTree = self.net.init_params(jax.random.PRNGKey(seed))
+        self.solver: Optional[SgdSolver] = None
+        self.solver_state: Optional[SolverState] = None
+        if solver is not None:
+            self.solver = SgdSolver(self.net, solver, loss_blob=loss_blob)
+            self.solver_state = self.solver.init_state(self.params)
+        self._fwd_test = jax.jit(
+            lambda p, b: self.net.apply(p, b, train=False))
+        self._fwd_train = jax.jit(
+            lambda p, b, r: self.net.apply(p, b, train=True, rng=r))
+        _loss_blob = loss_blob
+        self._grad = jax.jit(jax.grad(
+            lambda p, b, r: self.net.apply(p, b, train=True, rng=r)[_loss_blob]))
+        self._rng = jax.random.PRNGKey(seed ^ 0x5EED)
+
+    # -- data plumbing ------------------------------------------------------
+
+    def _prep(self, batch: Dict[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
+        out = {}
+        for name, want in self.net.input_shapes.items():
+            if name not in batch:
+                raise ValueError(f"batch missing net input {name!r}")
+            arr = np.asarray(batch[name])
+            arr = _maybe_nhwc(name, arr, want, self.input_layout)
+            if tuple(arr.shape[1:]) != tuple(want[1:]):
+                raise ValueError(
+                    f"input {name!r}: got {arr.shape}, net expects "
+                    f"(N,)+{tuple(want[1:])} (device layout NHWC)")
+            out[name] = jnp.asarray(arr)
+        return out
+
+    # -- NetInterface parity -------------------------------------------------
+
+    def forward(self, batch: Dict[str, np.ndarray],
+                blob_names: Optional[List[str]] = None
+                ) -> Dict[str, np.ndarray]:
+        """Test-phase forward. Returns output blobs (+ any requested hidden
+        blobs, parity with `forward(rowIt, dataBlobNames)`,
+        `libs/CaffeNet.scala:88-109`)."""
+        blobs = self._fwd_test(self.params, self._prep(batch))
+        want = set(self.net.output_names) | set(blob_names or [])
+        return {k: np.asarray(v) for k, v in blobs.items() if k in want}
+
+    def forward_backward(self, batch: Dict[str, np.ndarray]) -> PyTree:
+        """Forward + backward; returns grads, does NOT update weights
+        (parity with `forwardBackward`, `libs/CaffeNet.scala:111-121`)."""
+        self._rng, sub = jax.random.split(self._rng)
+        return self._grad(self.params, self._prep(batch), sub)
+
+    def step(self, batch: Dict[str, np.ndarray]) -> float:
+        """One SGD step (parity with `CaffeSolver.step`). Returns loss."""
+        assert self.solver is not None, "construct JaxNet with solver= to train"
+        self._rng, sub = jax.random.split(self._rng)
+        self.params, self.solver_state, loss = self.solver.step(
+            self.params, self.solver_state, self._prep(batch), sub)
+        return float(loss)
+
+    def get_weights(self) -> WeightCollection:
+        return params_to_collection(self.net, self.params)
+
+    def set_weights(self, weights: WeightCollection) -> None:
+        new = collection_to_params(self.net, weights)
+        for lname, lp in self.params.items():
+            assert lname in new, f"weights missing layer {lname!r}"
+            for pname, w in lp.items():
+                assert new[lname][pname].shape == w.shape, (
+                    f"{lname}/{pname}: {new[lname][pname].shape} != {w.shape}")
+        self.params = new
+
+    def output_schema(self) -> Schema:
+        """Schema of output blobs (parity `outputSchema`,
+        `libs/CaffeNet.scala:167-173`)."""
+        fields = []
+        for name in self.net.output_names:
+            shape = self.net.blob_shapes[name]
+            fields.append(Field(name=name, dtype="float32",
+                                shape=tuple(shape[1:]) if shape else ()))
+        return Schema(*fields)
+
+    # -- checkpoint ---------------------------------------------------------
+
+    def save_weights(self, path: str) -> None:
+        """Weight-only export (parity `saveWeightsToFile`,
+        `libs/CaffeNet.scala:159-165`)."""
+        self.get_weights().save(path)
+
+    def load_weights(self, path: str) -> None:
+        """Weight-only import (parity `copyTrainedLayersFrom`,
+        `libs/CaffeNet.scala:152-157`)."""
+        self.set_weights(WeightCollection.load(path))

@@ -1,0 +1,2 @@
+from .pooling import pool2d, global_pool2d, caffe_pool_output_size  # noqa: F401
+from .lrn import lrn  # noqa: F401

@@ -1,0 +1,42 @@
+"""Local Response Normalization (across channels), Caffe semantics.
+
+Caffe formula (LRNLayer, used by the reference AlexNet at
+`models/bvlc_reference_caffenet/train_val.prototxt` norm1/norm2):
+
+    out[c] = x[c] / (k + (alpha / n) * sum_{c' in window(c, n)} x[c']^2) ^ beta
+
+window(c, n) = channels [c - (n-1)/2, c + (n-1)/2] clipped to [0, C).
+
+On NHWC the channel window is the minor (lane) dimension. The default path
+lets XLA fuse a channel-padded reduce_window; `sparknet_tpu.ops.pallas_lrn`
+provides a hand-fused Pallas TPU kernel selected automatically on TPU for
+supported shapes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# NOTE: deliberately not jit-decorated — always called inside an outer jit,
+# and grad-through-jit with static_argnames mis-linearizes in jax 0.9.
+def lrn(x: jnp.ndarray, local_size: int = 5, *, alpha: float = 1e-4,
+        beta: float = 0.75, k: float = 1.0) -> jnp.ndarray:
+    """LRN across the channel (last) axis of an NHWC (or N...C) tensor."""
+    half = (local_size - 1) // 2
+    # Window sums accumulate in f32: better numerics, and reduce_window-add
+    # on bf16 fails to linearize under jit (jax 0.9).
+    sq = jnp.square(x).astype(jnp.float32)
+    # Sliding window sum over channels; clip at the edges (Caffe clips, so the
+    # normalizer for edge channels sums fewer terms).
+    window = (1,) * (x.ndim - 1) + (local_size,)
+    strides = (1,) * x.ndim
+    padding = tuple((0, 0) for _ in range(x.ndim - 1)) + ((half, half),)
+    ssq = lax.reduce_window(sq, 0.0, lax.add, window,
+                            strides, padding).astype(x.dtype)
+    scale = (jnp.asarray(k, x.dtype)
+             + jnp.asarray(alpha / local_size, x.dtype) * ssq)
+    # scale > 0 always (k >= 1), so x * scale^-beta == x * exp(-beta*log(scale));
+    # pow with a traced exponent has no linearization rule.
+    return x * jnp.exp(jnp.asarray(-beta, x.dtype) * jnp.log(scale))

@@ -1,0 +1,3 @@
+from .mesh import (DATA_AXIS, MODEL_AXIS, make_mesh,  # noqa: F401
+                   initialize_multihost)
+from .trainer import ParallelTrainer, TrainState  # noqa: F401

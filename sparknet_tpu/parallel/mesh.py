@@ -1,0 +1,80 @@
+"""Device mesh construction + multi-host initialization.
+
+Replaces the reference's entire cluster control plane (Spark driver +
+executors + `spark-submit`, reference `apps/CifarApp.scala:31-49`,
+`ec2/spark_ec2.py`) with the JAX single-controller model: every host runs the
+same program, `jax.distributed.initialize` forms the global runtime, and a
+`jax.sharding.Mesh` over all devices is the communication fabric — collectives
+ride ICI (and DCN across slices) instead of driver TCP.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_names: Sequence[str] = (DATA_AXIS,),
+              shape: Optional[Tuple[int, ...]] = None) -> Mesh:
+    """Build a mesh over the first `n_devices` devices (default: all).
+
+    1-D (data,) meshes cover the reference's pure-DP world; pass
+    axis_names=("data","model") + shape for DP×TP hybrid layouts.
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    if shape is None:
+        shape = (len(devices),) if len(axis_names) == 1 else None
+        assert shape is not None, "multi-axis mesh needs an explicit shape"
+    arr = mesh_utils.create_device_mesh(shape, devices=devices)
+    return Mesh(arr, axis_names=tuple(axis_names))
+
+
+_MULTIHOST_ENV_HINTS = ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+                        "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES")
+
+
+def initialize_multihost(coordinator: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> bool:
+    """Form the multi-host runtime. Must be called BEFORE any other JAX use
+    (backend init pins the process world — do not touch jax.devices() or
+    jax.process_count() first).
+
+    Returns True if a multi-host world was formed, False for a deliberate
+    single-process run (no coordinator configured). Real initialization
+    failures PROPAGATE — a pod run silently degrading to per-host training
+    would be wrong results with no error.
+    """
+    if os.environ.get("SPARKNET_TPU_DIST_INIT"):
+        return True
+    explicit = coordinator is not None
+    configured = explicit or any(os.environ.get(k) for k in _MULTIHOST_ENV_HINTS)
+    if not configured:
+        return False  # single-process (tests, single TPU VM)
+    kwargs = {}
+    if explicit:
+        kwargs = dict(coordinator_address=coordinator,
+                      num_processes=num_processes, process_id=process_id)
+    jax.distributed.initialize(**kwargs)
+    os.environ["SPARKNET_TPU_DIST_INIT"] = "1"
+    return True
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharded(mesh: Mesh, ndim: int, axis: int = 0) -> NamedSharding:
+    spec = [None] * ndim
+    spec[axis] = DATA_AXIS
+    return NamedSharding(mesh, P(*spec))

@@ -1,0 +1,212 @@
+"""Distributed data-parallel trainer: τ-local-step parameter averaging on-mesh.
+
+This is the TPU-native re-design of the reference's whole training loop
+(reference `apps/CifarApp.scala:100-149`):
+
+    reference (Spark)                        here (one XLA program)
+    -----------------------------------     ---------------------------------
+    sc.broadcast(netWeights)            →   nothing: params live per-device
+    foreach{ setWeights(bcast.value) }  →   (already there after pmean)
+    foreachPartition{ τ × solver.step } →   lax.scan of τ jitted SGD steps
+    map(getWeights).reduce(add)         →   lax.pmean over the mesh axis
+    netWeights.scalarDivide(n) (driver) →   (pmean is already the mean)
+
+Semantics preserved exactly (SURVEY.md §7 "hard parts" #2):
+  - τ local SGD steps between averagings, each worker on its own data shard;
+  - only the *net weights* are averaged; solver momentum stays worker-local
+    and stale across syncs (reference `libs/CaffeNet.scala:123-137` — only
+    net blobs cross the wire);
+  - τ=1 `sync_sgd` mode averages gradients instead: classic synchronous SGD.
+
+State layout: every leaf of params/momentum carries a leading device axis of
+size mesh.n_devices, sharded over the data axis — i.e. each device holds
+exactly its own (possibly diverged) replica. After a round the replicas are
+numerically identical, but keeping the axis makes divergence-during-τ a
+first-class, inspectable thing instead of hidden executor state.
+
+The whole round (τ steps + averaging) is ONE compiled executable: no host
+round-trips, weights never leave the devices, the driver only gets scalars.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..model.net import CompiledNet, PyTree
+from ..solver import SgdSolver, SolverConfig, SolverState
+from .mesh import DATA_AXIS
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    """Replicated-per-device training state. Leaves have a leading
+    [n_devices] axis sharded over the data mesh axis."""
+
+    params: PyTree
+    momentum: PyTree
+    it: jnp.ndarray  # [n_devices] int32 (same value everywhere)
+
+
+class ParallelTrainer:
+    """Data-parallel trainer over a 1-D (data,) mesh.
+
+    mode: "local_sgd" (τ steps then weight pmean — the reference's scheme) or
+          "sync_sgd" (per-step gradient pmean, τ must be 1).
+    """
+
+    def __init__(self, net: CompiledNet, solver_cfg: SolverConfig, mesh: Mesh,
+                 tau: int = 10, mode: str = "local_sgd",
+                 loss_blob: str = "loss", acc_blob: Optional[str] = None):
+        assert mode in ("local_sgd", "sync_sgd")
+        if mode == "sync_sgd":
+            assert tau == 1, "sync_sgd averages every step; tau must be 1"
+        self.net = net
+        self.solver = SgdSolver(net, solver_cfg, loss_blob=loss_blob)
+        self.mesh = mesh
+        self.tau = tau
+        self.mode = mode
+        self.loss_blob = loss_blob
+        self.acc_blob = acc_blob
+        self.n_devices = int(np.prod(mesh.devices.shape))
+
+        dev = P(DATA_AXIS)  # leading device axis
+        batch_spec = P(None, DATA_AXIS)  # [tau, global_batch, ...] -> shard batch
+        state_specs = TrainState(params=dev, momentum=dev, it=dev)
+
+        self._round = jax.jit(
+            shard_map(self._round_impl, mesh=mesh,
+                      in_specs=(state_specs, batch_spec, P(DATA_AXIS)),
+                      out_specs=(state_specs, P())),
+            donate_argnums=(0,))
+        self._eval = jax.jit(
+            shard_map(self._eval_impl, mesh=mesh,
+                      in_specs=(dev, P(DATA_AXIS)),
+                      out_specs=P()))
+
+    # -- state construction --------------------------------------------------
+
+    def init_state(self, key: jax.Array) -> TrainState:
+        """Identical initial params on every device (the reference seeds all
+        workers from worker-0's weights, `apps/CifarApp.scala:98`)."""
+        return self.state_from_params(self.net.init_params(key))
+
+    def state_from_params(self, params: PyTree) -> TrainState:
+        def tile(x):
+            return jnp.broadcast_to(x[None], (self.n_devices,) + x.shape)
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        state = TrainState(params=jax.tree.map(tile, params),
+                           momentum=jax.tree.map(tile, zeros),
+                           it=jnp.zeros((self.n_devices,), jnp.int32))
+        return jax.device_put(state, NamedSharding(self.mesh, P(DATA_AXIS)))
+
+    def averaged_params(self, state: TrainState) -> PyTree:
+        """Single copy of the (already synchronized) params: device 0's."""
+        return jax.tree.map(lambda x: x[0], state.params)
+
+    # -- one training round (runs INSIDE shard_map; axis = DATA_AXIS) --------
+
+    def _round_impl(self, state: TrainState, batches, rng):
+        # shapes here are per-device: params [1, ...]; batches [tau, local_b, ...]
+        params = jax.tree.map(lambda x: x[0], state.params)
+        momentum = jax.tree.map(lambda x: x[0], state.momentum)
+        it = state.it[0]
+        rng = rng[0]
+
+        def local_step(carry, inputs):
+            params, sstate = carry
+            batch, step_rng = inputs
+            if self.mode == "sync_sgd":
+                (loss, _), grads = jax.value_and_grad(
+                    lambda p: self.net.loss_fn(self.loss_blob)(
+                        p, batch, step_rng), has_aux=True)(params)
+                grads = lax.pmean(grads, DATA_AXIS)
+                loss = lax.pmean(loss, DATA_AXIS)
+                params, sstate = self.solver.update(params, sstate, grads)
+            else:
+                (loss, _), grads = jax.value_and_grad(
+                    lambda p: self.net.loss_fn(self.loss_blob)(
+                        p, batch, step_rng), has_aux=True)(params)
+                params, sstate = self.solver.update(params, sstate, grads)
+            return (params, sstate), loss
+
+        step_rngs = jax.random.split(rng, self.tau)
+        (params, sstate), losses = lax.scan(
+            local_step, (params, SolverState(momentum=momentum, it=it)),
+            (batches, step_rngs))
+
+        if self.mode == "local_sgd":
+            # THE sync: weight averaging as an in-pod allreduce. Momentum is
+            # deliberately NOT averaged (reference parity, SURVEY §7).
+            params = lax.pmean(params, DATA_AXIS)
+        mean_loss = lax.pmean(jnp.mean(losses), DATA_AXIS)
+
+        new_state = TrainState(
+            params=jax.tree.map(lambda x: x[None], params),
+            momentum=jax.tree.map(lambda x: x[None], sstate.momentum),
+            it=sstate.it[None],
+        )
+        return new_state, mean_loss
+
+    # -- distributed eval ----------------------------------------------------
+
+    def _eval_impl(self, params, batch):
+        params = jax.tree.map(lambda x: x[0], params)
+        blobs = self.net.apply(params, batch, train=False)
+        acc_blob = self.acc_blob or _find_accuracy_blob(self.net)
+        n = next(iter(batch.values())).shape[0]
+        correct = blobs[acc_blob] * n
+        total_correct = lax.psum(correct, DATA_AXIS)
+        total_n = lax.psum(jnp.asarray(n, jnp.float32), DATA_AXIS)
+        return total_correct / total_n
+
+    # -- public API ----------------------------------------------------------
+
+    def train_round(self, state: TrainState, batches: Dict[str, np.ndarray],
+                    rng: jax.Array) -> Tuple[TrainState, float]:
+        """One outer round: τ local steps per device + averaging.
+
+        `batches[input]` has shape [tau, global_batch, ...] with
+        global_batch = n_devices × per-device batch; it is sharded over
+        devices along axis 1.
+        """
+        rngs = jax.random.split(rng, self.n_devices)
+        new_state, loss = self._round(state, self._shard_batches(batches), rngs)
+        return new_state, loss
+
+    def evaluate(self, state: TrainState, batch: Dict[str, np.ndarray]) -> float:
+        """Distributed accuracy over one global batch (psum of correct/count —
+        reference's eval reduce, `apps/CifarApp.scala:107-124`)."""
+        sharded = {
+            k: jax.device_put(jnp.asarray(v),
+                              NamedSharding(self.mesh, P(DATA_AXIS)))
+            for k, v in batch.items()}
+        return float(self._eval(state.params, sharded))
+
+    def _shard_batches(self, batches):
+        out = {}
+        for k, v in batches.items():
+            arr = jnp.asarray(v)
+            assert arr.shape[0] == self.tau, (
+                f"{k}: leading dim {arr.shape[0]} != tau {self.tau}")
+            assert arr.shape[1] % self.n_devices == 0, (
+                f"{k}: global batch {arr.shape[1]} not divisible by "
+                f"{self.n_devices} devices")
+            out[k] = jax.device_put(
+                arr, NamedSharding(self.mesh, P(None, DATA_AXIS)))
+        return out
+
+
+def _find_accuracy_blob(net: CompiledNet) -> str:
+    for layer in net.spec.layers:
+        if layer.type == "Accuracy":
+            return layer.tops[0]
+    raise ValueError("net has no Accuracy layer; pass acc_blob=")

@@ -1,0 +1,65 @@
+"""Numeric policy: compute dtype + MXU precision for matmuls/convs.
+
+Two supported modes:
+  - "float32" (default): f32 operands, Precision.HIGHEST — bit-faithful to the
+    reference's float32 Caffe kernels; use for accuracy-parity runs and tests.
+  - "bfloat16": operands cast to bf16, f32 accumulation
+    (preferred_element_type) — the TPU MXU fast path; use for throughput.
+
+Set globally via `set_policy("bfloat16")` or scoped with `policy(...)`.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_state = threading.local()
+
+
+def _get() -> str:
+    return getattr(_state, "mode", "float32")
+
+
+def set_policy(mode: str) -> None:
+    assert mode in ("float32", "bfloat16"), mode
+    _state.mode = mode
+
+
+@contextlib.contextmanager
+def policy(mode: str):
+    prev = _get()
+    set_policy(mode)
+    try:
+        yield
+    finally:
+        set_policy(prev)
+
+
+def compute_dtype():
+    return jnp.bfloat16 if _get() == "bfloat16" else jnp.float32
+
+
+def matmul_precision():
+    if _get() == "bfloat16":
+        return jax.lax.Precision.DEFAULT  # operands already bf16
+    return jax.lax.Precision.HIGHEST
+
+
+def preferred_out():
+    """Accumulation/output dtype for matmuls & convs.
+
+    float32 mode: explicit f32. bfloat16 mode: None (output stays bf16 —
+    the MXU still accumulates partial products in f32 internally; an explicit
+    f32 preferred_element_type would break the conv transpose rule with mixed
+    cotangent dtypes)."""
+    return None if _get() == "bfloat16" else jnp.float32
+
+
+def cast_in(x: jnp.ndarray) -> jnp.ndarray:
+    dt = compute_dtype()
+    if x.dtype in (jnp.float32, jnp.bfloat16) and x.dtype != dt:
+        return x.astype(dt)
+    return x

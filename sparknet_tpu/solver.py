@@ -1,0 +1,187 @@
+"""Caffe-semantics SGD solver as a jitted functional update.
+
+The reference's SGD lived entirely inside native Caffe
+(`FloatSGDSolver.ApplyUpdate`, wrapped at reference `libs/CaffeSolver.scala:11-18`):
+momentum, lr policy, per-blob lr_mult/decay_mult, weight decay, all configured
+by `SolverParameter` prototxt. Here the same semantics are a pure function
+over a pytree, so the whole train step (forward + backward + update) compiles
+to one XLA executable and the optimizer state is first-class, checkpointable
+data.
+
+Caffe SGD update rule (SGDSolver<Dtype>::ComputeUpdateValue semantics):
+
+    local_rate  = rate(iter) * lr_mult
+    local_decay = weight_decay * decay_mult
+    V <- momentum * V + local_rate * (grad + local_decay * W)
+    W <- W - V
+
+LR policies (Caffe `GetLearningRate`): fixed, step, exp, inv, multistep, poly,
+sigmoid.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .model.net import CompiledNet, PyTree
+from .model.spec import ParamSpec
+
+
+@dataclass(frozen=True)
+class SolverConfig:
+    base_lr: float = 0.01
+    lr_policy: str = "fixed"
+    gamma: float = 0.1
+    stepsize: int = 100000
+    stepvalue: Tuple[int, ...] = ()
+    power: float = 1.0
+    max_iter: int = 10000
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    iter_size: int = 1
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "SolverConfig":
+        solver_type = d.get("type", "SGD")
+        if solver_type not in ("SGD",):
+            raise ValueError(
+                f"unsupported solver type {solver_type!r} (only SGD with "
+                f"momentum is implemented — fail loudly rather than silently "
+                f"training with different dynamics)")
+        fields = {f.name for f in dataclasses.fields(SolverConfig)}
+        kw = {k: v for k, v in d.items() if k in fields}
+        if "stepvalue" in kw:
+            kw["stepvalue"] = tuple(kw["stepvalue"])
+        return SolverConfig(**kw)
+
+
+def learning_rate(cfg: SolverConfig, it: jnp.ndarray) -> jnp.ndarray:
+    """rate(iter) for every Caffe lr_policy; `it` may be traced."""
+    it = it.astype(jnp.float32)
+    p = cfg.lr_policy
+    if p == "fixed":
+        return jnp.asarray(cfg.base_lr, jnp.float32)
+    if p == "step":
+        current = jnp.floor(it / cfg.stepsize)
+        return cfg.base_lr * jnp.power(cfg.gamma, current)
+    if p == "exp":
+        return cfg.base_lr * jnp.power(cfg.gamma, it)
+    if p == "inv":
+        return cfg.base_lr * jnp.power(1.0 + cfg.gamma * it, -cfg.power)
+    if p == "multistep":
+        if not cfg.stepvalue:
+            return jnp.asarray(cfg.base_lr, jnp.float32)
+        steps = jnp.asarray(cfg.stepvalue, jnp.float32)
+        current = jnp.sum(it[None] >= steps)
+        return cfg.base_lr * jnp.power(cfg.gamma, current.astype(jnp.float32))
+    if p == "poly":
+        return cfg.base_lr * jnp.power(1.0 - it / cfg.max_iter, cfg.power)
+    if p == "sigmoid":
+        return cfg.base_lr / (1.0 + jnp.exp(-cfg.gamma * (it - cfg.stepsize)))
+    raise ValueError(f"unknown lr_policy {p!r}")
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SolverState:
+    """Optimizer state pytree: momentum history + iteration counter.
+
+    NOTE (parity): in the reference, momentum history is worker-local native
+    state that never crosses the wire — only net blobs are averaged
+    (`libs/CaffeNet.scala:123-137`). The distributed trainer preserves that:
+    it averages `params`, never `SolverState.momentum`.
+    """
+
+    momentum: PyTree
+    it: jnp.ndarray  # scalar int32 iteration counter
+
+
+class SgdSolver:
+    """Functional SGD solver bound to a CompiledNet.
+
+    `step` is the analogue of the reference's `Solver.step(rowIt)`
+    (`libs/CaffeSolver.scala:15-18`): forward + backward + ApplyUpdate, except
+    compiled into a single XLA executable (donated args, so updates are
+    in-place on device).
+    """
+
+    def __init__(self, net: CompiledNet, cfg: SolverConfig,
+                 loss_blob: str = "loss"):
+        self.net = net
+        self.cfg = cfg
+        self.loss_blob = loss_blob
+        self._lr_mults, self._decay_mults = _param_multipliers(net)
+        self._step = jax.jit(self._step_impl, donate_argnums=(0, 1))
+
+    # -- state --------------------------------------------------------------
+
+    def init_state(self, params: PyTree) -> SolverState:
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return SolverState(momentum=zeros, it=jnp.zeros((), jnp.int32))
+
+    # -- single-step update (pure) ------------------------------------------
+
+    def update(self, params: PyTree, state: SolverState, grads: PyTree
+               ) -> Tuple[PyTree, SolverState]:
+        """Apply one Caffe-SGD update given precomputed grads (pure fn)."""
+        rate = learning_rate(self.cfg, state.it)
+
+        def upd(path_key, w, v, g):
+            lr_mult, decay_mult = path_key
+            local_rate = rate * lr_mult
+            local_decay = self.cfg.weight_decay * decay_mult
+            v_new = self.cfg.momentum * v + local_rate * (g + local_decay * w)
+            return w - v_new, v_new
+
+        new_params: PyTree = {}
+        new_mom: PyTree = {}
+        for lname, lparams in params.items():
+            new_params[lname], new_mom[lname] = {}, {}
+            for pname, w in lparams.items():
+                mults = self._lr_mults[lname][pname], self._decay_mults[lname][pname]
+                nw, nv = upd(mults, w, state.momentum[lname][pname],
+                             grads[lname][pname])
+                new_params[lname][pname] = nw
+                new_mom[lname][pname] = nv
+        return new_params, SolverState(momentum=new_mom, it=state.it + 1)
+
+    def _step_impl(self, params, state, batch, rng):
+        (loss, blobs), grads = jax.value_and_grad(
+            lambda p: self.net.loss_fn(self.loss_blob)(p, batch, rng),
+            has_aux=True)(params)
+        new_params, new_state = self.update(params, state, grads)
+        return new_params, new_state, loss
+
+    # -- public API ---------------------------------------------------------
+
+    def step(self, params: PyTree, state: SolverState,
+             batch: Dict[str, jnp.ndarray], rng: Optional[jax.Array] = None
+             ) -> Tuple[PyTree, SolverState, jnp.ndarray]:
+        """One jitted train step. Returns (params, state, loss)."""
+        if rng is None:
+            rng = jax.random.fold_in(jax.random.PRNGKey(0), int(state.it))
+        return self._step(params, state, batch, rng)
+
+
+def _param_multipliers(net: CompiledNet):
+    """Per-blob lr_mult/decay_mult from LayerSpec.params.
+
+    Caffe convention (reference prototxts, e.g.
+    `models/cifar10/cifar10_quick_train_test.prototxt` `param { lr_mult: 1 }
+    param { lr_mult: 2 }`): first ParamSpec is the weight, second the bias.
+    Missing specs default to 1.0.
+    """
+    lr: Dict[str, Dict[str, float]] = {}
+    decay: Dict[str, Dict[str, float]] = {}
+    for layer in net.spec.layers:
+        from .model.layers import LAYER_IMPLS
+        if LAYER_IMPLS[layer.type][0] is None:
+            continue
+        specs = list(layer.params) + [ParamSpec()] * (2 - len(layer.params))
+        lr[layer.name] = {"w": specs[0].lr_mult, "b": specs[1].lr_mult}
+        decay[layer.name] = {"w": specs[0].decay_mult, "b": specs[1].decay_mult}
+    return lr, decay

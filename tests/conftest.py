@@ -1,0 +1,23 @@
+"""Test fixtures. Must run before jax initializes: force CPU platform with 8
+virtual devices so multi-chip sharding is tested without TPU hardware (the
+reference had no distributed tests at all — see SURVEY.md §4)."""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The TPU plugin pins jax_platforms at interpreter boot (sitecustomize), so a
+# plain env var is not enough — override via jax.config before backend init.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,143 @@
+"""CompiledNet / JaxNet tests — mirrors the reference's CaffeNetSpec
+(`src/test/scala/libs/CaffeNetSpec.scala`): construction, forward output
+schema/shapes, forward purity (weights unchanged), save->load roundtrip —
+plus gradient checks the reference never had.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu import CompiledNet, net_from_prototxt
+from sparknet_tpu.model.caffe_compat import (collection_to_params,
+                                             params_to_collection)
+from sparknet_tpu.model.weights import WeightCollection
+from sparknet_tpu.net_api import JaxNet
+from sparknet_tpu.solver import SolverConfig
+from tests.test_prototxt import ADULT
+
+CIFARISH = """
+name: "tiny_cifar"
+input: "data"
+input_shape { dim: 4 dim: 3 dim: 16 dim: 16 }
+input: "label"
+input_shape { dim: 4 dim: 1 }
+layer {
+  name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  param { lr_mult: 1 } param { lr_mult: 2 }
+  convolution_param {
+    num_output: 8 pad: 2 kernel_size: 5 stride: 1
+    weight_filler { type: "gaussian" std: 0.01 }
+    bias_filler { type: "constant" }
+  }
+}
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+        pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+layer { name: "relu1" type: "ReLU" bottom: "pool1" top: "pool1" }
+layer { name: "ip1" type: "InnerProduct" bottom: "pool1" top: "ip1"
+        inner_product_param { num_output: 10
+          weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "prob" type: "Softmax" bottom: "ip1" top: "prob" }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip1" bottom: "label" top: "loss" }
+layer { name: "acc" type: "Accuracy" bottom: "ip1" bottom: "label" top: "acc" }
+"""
+
+
+@pytest.fixture(scope="module")
+def tiny_net():
+    return CompiledNet.compile(net_from_prototxt(CIFARISH))
+
+
+def test_shapes_and_outputs(tiny_net):
+    assert tiny_net.input_shapes["data"] == (4, 16, 16, 3)
+    assert tiny_net.blob_shapes["conv1"] == (4, 16, 16, 8)
+    assert tiny_net.blob_shapes["pool1"] == (4, 8, 8, 8)
+    assert tiny_net.blob_shapes["prob"] == (4, 10)
+    assert set(tiny_net.output_names) == {"prob", "loss", "acc"}
+
+
+def test_forward_probabilities_sum_to_one(tiny_net):
+    params = tiny_net.init_params(jax.random.PRNGKey(0))
+    blobs = tiny_net.apply(params, tiny_net.example_batch())
+    probs = np.asarray(blobs["prob"])
+    np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-5)
+    assert (probs >= 0).all()
+
+
+def test_forward_purity(tiny_net):
+    """forward/forwardBackward must not mutate weights
+    (CaffeNetSpec.scala:48-70)."""
+    net = JaxNet(net_from_prototxt(CIFARISH), solver=SolverConfig(base_lr=0.1))
+    before = net.get_weights()
+    batch = {k: np.asarray(v) for k, v in net.net.example_batch().items()}
+    net.forward(batch)
+    net.forward_backward(batch)
+    after = net.get_weights()
+    assert WeightCollection.check_equal(before, after, tol=0.0)
+    net.step(batch)
+    stepped = net.get_weights()
+    assert not WeightCollection.check_equal(before, stepped, tol=1e-9)
+
+
+def test_weight_roundtrip(tiny_net, tmp_path):
+    """save -> load roundtrip preserves weights exactly
+    (CaffeNetSpec.scala:72-82)."""
+    net = JaxNet(net_from_prototxt(CIFARISH), seed=3)
+    path = str(tmp_path / "w.npz")
+    net.save_weights(path)
+    net2 = JaxNet(net_from_prototxt(CIFARISH), seed=7)
+    assert not WeightCollection.check_equal(net.get_weights(),
+                                            net2.get_weights())
+    net2.load_weights(path)
+    assert WeightCollection.check_equal(net.get_weights(), net2.get_weights(),
+                                        tol=0.0)
+
+
+def test_caffe_layout_roundtrip(tiny_net):
+    params = tiny_net.init_params(jax.random.PRNGKey(1))
+    coll = params_to_collection(tiny_net, params)
+    # Caffe layouts: conv OIHW, ip (out, in)
+    assert coll["conv1"][0].shape == (8, 3, 5, 5)
+    assert coll["ip1"][0].shape == (10, 8 * 8 * 8)
+    back = collection_to_params(tiny_net, coll)
+    for lname, lp in params.items():
+        for pname, w in lp.items():
+            np.testing.assert_array_equal(np.asarray(w),
+                                          np.asarray(back[lname][pname]))
+
+
+def test_adult_net_forward():
+    net = JaxNet(net_from_prototxt(ADULT))
+    batch = {"C0": np.random.default_rng(0).standard_normal(
+        (64, 1), dtype=np.float32)}
+    out = net.forward(batch)
+    assert out["prob"].shape == (64, 10)
+    np.testing.assert_allclose(out["prob"].sum(-1), 1.0, rtol=1e-5)
+
+
+def test_output_schema(tiny_net):
+    net = JaxNet(net_from_prototxt(CIFARISH))
+    schema = net.output_schema()
+    assert schema["prob"].shape == (10,)
+    assert schema["loss"].shape == ()
+
+
+def test_gradients_flow(tiny_net):
+    params = tiny_net.init_params(jax.random.PRNGKey(0))
+    batch = tiny_net.example_batch()
+    grads = jax.grad(lambda p: tiny_net.apply(p, batch, train=True,
+                                              rng=jax.random.PRNGKey(1))["loss"]
+                     )(params)
+    norms = [float(jnp.linalg.norm(g)) for lp in grads.values()
+             for g in lp.values()]
+    assert all(np.isfinite(norms)) and sum(norms) > 0
+
+
+def test_hidden_blob_extraction(tiny_net):
+    """FeaturizerApp parity: request a hidden blob by name
+    (apps/FeaturizerApp.scala:91-94)."""
+    net = JaxNet(net_from_prototxt(CIFARISH))
+    batch = {k: np.asarray(v) for k, v in net.net.example_batch().items()}
+    out = net.forward(batch, blob_names=["ip1"])
+    assert out["ip1"].shape == (4, 10)

@@ -1,0 +1,76 @@
+"""Caffe-semantics op tests, cross-checked against torch (CPU) oracles.
+
+torch's ceil_mode pooling, grouped conv2d, and local_response_norm implement
+the same semantics as native Caffe (which the reference called through
+JavaCPP, `libs/CaffeNet.scala:91`), so they serve as an independent oracle.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.ops.lrn import lrn
+from sparknet_tpu.ops.pooling import caffe_pool_output_size, pool2d
+
+
+def nchw(x_nhwc):
+    return np.transpose(x_nhwc, (0, 3, 1, 2))
+
+
+def nhwc(x_nchw):
+    return np.transpose(x_nchw, (0, 2, 3, 1))
+
+
+@pytest.mark.parametrize("h,k,s,p", [
+    (32, 3, 2, 0),   # cifar10 pool1-3: 32->16 via ceil
+    (16, 3, 2, 0),
+    (55, 3, 2, 0),   # alexnet pool1: 55->27
+    (13, 3, 2, 0),   # alexnet pool5: 13->6
+    (10, 2, 2, 0),
+    (7, 3, 2, 1),
+])
+def test_pool_output_size_matches_torch(h, k, s, p):
+    x = torch.zeros(1, 1, h, h)
+    out = F.max_pool2d(x, k, stride=s, padding=p, ceil_mode=True)
+    assert caffe_pool_output_size(h, k, s, p) == out.shape[-1]
+
+
+@pytest.mark.parametrize("mode", ["MAX", "AVE"])
+@pytest.mark.parametrize("h,k,s,p", [(32, 3, 2, 0), (13, 3, 2, 0), (8, 3, 2, 1)])
+def test_pool2d_matches_torch(rng, mode, h, k, s, p):
+    x = rng.standard_normal((2, h, h, 5), dtype=np.float32)
+    got = np.asarray(pool2d(jnp.asarray(x), mode, k, s, p))
+    xt = torch.from_numpy(nchw(x))
+    if mode == "MAX":
+        want = F.max_pool2d(xt, k, stride=s, padding=p, ceil_mode=True)
+    else:
+        want = F.avg_pool2d(xt, k, stride=s, padding=p, ceil_mode=True,
+                            count_include_pad=True)
+    np.testing.assert_allclose(got, nhwc(want.numpy()), rtol=1e-5, atol=1e-5)
+
+
+def test_lrn_matches_torch(rng):
+    x = rng.standard_normal((2, 7, 7, 16), dtype=np.float32)
+    got = np.asarray(lrn(jnp.asarray(x), 5, alpha=1e-4, beta=0.75, k=1.0))
+    want = F.local_response_norm(torch.from_numpy(nchw(x)), size=5,
+                                 alpha=1e-4, beta=0.75, k=1.0)
+    np.testing.assert_allclose(got, nhwc(want.numpy()), rtol=1e-5, atol=1e-6)
+
+
+def test_grouped_conv_matches_torch(rng):
+    # AlexNet conv2 shape: group=2 (models/bvlc_reference_caffenet)
+    x = rng.standard_normal((2, 9, 9, 8), dtype=np.float32)
+    w_hwio = rng.standard_normal((3, 3, 4, 6), dtype=np.float32)  # group=2
+    b = rng.standard_normal((6,), dtype=np.float32)
+    y = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w_hwio), (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=2,
+        precision=jax.lax.Precision.HIGHEST)
+    got = np.asarray(y + b)
+    w_oihw = np.transpose(w_hwio, (3, 2, 0, 1))
+    want = F.conv2d(torch.from_numpy(nchw(x)), torch.from_numpy(w_oihw),
+                    torch.from_numpy(b), stride=1, padding=1, groups=2)
+    np.testing.assert_allclose(got, nhwc(want.numpy()), rtol=1e-4, atol=1e-4)

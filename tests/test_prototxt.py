@@ -1,0 +1,109 @@
+"""Prototxt importer tests (tiny fixtures inline; reference files only read
+if the read-only mount is present)."""
+import os
+
+import pytest
+
+from sparknet_tpu.model.prototxt import (
+    net_from_prototxt,
+    net_from_prototxt_file,
+    parse_message,
+    solver_from_prototxt,
+)
+
+ADULT = """
+name: "adult"
+input: "C0"
+input_shape { dim: 64 dim: 1 }
+layer {
+  name: "ip"
+  type: "InnerProduct"
+  bottom: "C0"
+  top: "ip"
+  param { lr_mult: 1 }
+  param { lr_mult: 2 }
+  inner_product_param {
+    num_output: 10
+    weight_filler { type: "xavier" }
+    bias_filler { type: "constant" }
+  }
+}
+layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }
+"""
+
+SOLVER = """
+# a comment
+net: "whatever.prototxt"
+base_lr: 0.001
+momentum: 0.9
+weight_decay: 0.004
+lr_policy: "fixed"
+max_iter: 4000
+"""
+
+
+def test_parse_message_generic():
+    msg = parse_message('a: 1 b { c: "x" c: "y" } a: 2')
+    assert msg["a"] == [1, 2]
+    assert msg["b"][0]["c"] == ["x", "y"]
+
+
+def test_adult_net():
+    spec = net_from_prototxt(ADULT)
+    assert spec.name == "adult"
+    assert [i.name for i in spec.inputs] == ["C0"]
+    assert spec.inputs[0].shape == (64, 1)
+    ip = spec.layer_by_name("ip")
+    assert ip.inner_product.num_output == 10
+    assert ip.inner_product.weight_filler.type == "xavier"
+    assert ip.params[0].lr_mult == 1 and ip.params[1].lr_mult == 2
+    assert spec.layers[-1].type == "Softmax"
+
+
+def test_solver_parse():
+    cfg = solver_from_prototxt(SOLVER)
+    assert cfg["base_lr"] == 0.001
+    assert cfg["momentum"] == 0.9
+    assert cfg["weight_decay"] == 0.004
+    assert cfg["lr_policy"] == "fixed"
+    assert cfg["max_iter"] == 4000
+
+
+REFERENCE_CIFAR = "/root/reference/models/cifar10/cifar10_quick_train_test.prototxt"
+
+
+@pytest.mark.skipif(not os.path.exists(REFERENCE_CIFAR),
+                    reason="reference mount absent")
+def test_reference_cifar10_prototxt():
+    spec = net_from_prototxt_file(REFERENCE_CIFAR)
+    assert spec.name == "CIFAR10_quick"
+    types = [l.type for l in spec.layers]
+    assert types.count("Convolution") == 3
+    assert types.count("Pooling") == 3
+    assert types.count("InnerProduct") == 2
+    conv1 = spec.layer_by_name("conv1")
+    assert conv1.conv.num_output == 32
+    assert conv1.conv.pad == 2 and conv1.conv.kernel_size == 5
+    assert conv1.conv.weight_filler.type == "gaussian"
+    assert conv1.conv.weight_filler.std == 0.0001
+    pool1 = spec.layer_by_name("pool1")
+    assert pool1.pool.pool == "MAX" and pool1.pool.kernel_size == 3
+
+
+REFERENCE_ALEXNET = "/root/reference/models/bvlc_reference_caffenet/train_val.prototxt"
+
+
+@pytest.mark.skipif(not os.path.exists(REFERENCE_ALEXNET),
+                    reason="reference mount absent")
+def test_reference_caffenet_prototxt():
+    spec = net_from_prototxt_file(
+        REFERENCE_ALEXNET,
+        input_shapes={"data": (256, 3, 227, 227), "label": (256, 1)})
+    types = [l.type for l in spec.layers]
+    assert types.count("Convolution") == 5
+    assert types.count("LRN") == 2
+    assert types.count("Dropout") == 2
+    conv2 = spec.layer_by_name("conv2")
+    assert conv2.conv.group == 2
+    norm1 = spec.layer_by_name("norm1")
+    assert norm1.lrn.local_size == 5 and norm1.lrn.alpha == 0.0001
