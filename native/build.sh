@@ -1,0 +1,7 @@
+#!/bin/sh
+# Build the host C++ data plane shared library.
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -march=native -shared -fPIC -fopenmp -o libjpeg_plane.so \
+    jpeg_plane.cpp -ljpeg
+echo "built $(pwd)/libjpeg_plane.so"

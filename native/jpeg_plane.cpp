@@ -1,0 +1,158 @@
+// Host-side C++ data plane for the ImageNet ingest path.
+//
+// The reference's equivalent was JVM-native imaging (libjpeg via
+// twelvemonkeys/ImageIO + thumbnailator, reference
+// preprocessing/ScaleAndConvert.scala:16-48): JPEG decode + force-resize +
+// planar CHW byte output, the host-CPU-bound hot loop at ImageNet scale.
+// Here: libjpeg decode, bilinear force-resize, CHW emit — plus a fused
+// crop/mean-subtract/NHWC batch kernel so Python never touches pixels.
+// OpenMP parallel across a batch; plain C ABI for ctypes.
+//
+// Build: see native/build.sh (g++ -O3 -shared -fPIC -fopenmp -ljpeg).
+
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf setjmp_buffer;
+};
+
+void error_exit(j_common_ptr cinfo) {
+  ErrorMgr* err = reinterpret_cast<ErrorMgr*>(cinfo->err);
+  longjmp(err->setjmp_buffer, 1);
+}
+
+// Bilinear resize HWC uint8 -> HWC uint8 (force-resize, no aspect keep —
+// matching the reference's thumbnailator forceSize).
+void resize_bilinear_hwc(const uint8_t* src, int sh, int sw, uint8_t* dst,
+                         int dh, int dw, int ch) {
+  const float ys = dh > 1 ? float(sh - 1) / float(dh - 1) : 0.f;
+  const float xs = dw > 1 ? float(sw - 1) / float(dw - 1) : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    const float fy = y * ys;
+    const int y0 = int(fy);
+    const int y1 = y0 + 1 < sh ? y0 + 1 : y0;
+    const float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      const float fx = x * xs;
+      const int x0 = int(fx);
+      const int x1 = x0 + 1 < sw ? x0 + 1 : x0;
+      const float wx = fx - x0;
+      for (int c = 0; c < ch; ++c) {
+        const float v00 = src[(y0 * sw + x0) * ch + c];
+        const float v01 = src[(y0 * sw + x1) * ch + c];
+        const float v10 = src[(y1 * sw + x0) * ch + c];
+        const float v11 = src[(y1 * sw + x1) * ch + c];
+        const float v = (1 - wy) * ((1 - wx) * v00 + wx * v01) +
+                        wy * ((1 - wx) * v10 + wx * v11);
+        dst[(y * dw + x) * ch + c] = uint8_t(v + 0.5f);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode one JPEG and force-resize to (out_h, out_w), writing planar CHW
+// uint8 (3 channels). Returns 0 on success, nonzero on decode error.
+int jp_decode_resize_chw(const uint8_t* jpeg, long jpeg_len, int out_h,
+                         int out_w, uint8_t* out_chw) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  // Declared BEFORE setjmp: longjmp must not jump out of a scope holding
+  // live destructible objects (UB + leak); declared here they survive the
+  // jump and destruct on normal function return.
+  std::vector<uint8_t> hwc;
+  std::vector<uint8_t> resized;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, jpeg, static_cast<unsigned long>(jpeg_len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return 2;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const int sh = cinfo.output_height, sw = cinfo.output_width;
+  const int ch = cinfo.output_components;  // 3 after JCS_RGB
+  if (ch != 3 || sh <= 0 || sw <= 0) {
+    jpeg_destroy_decompress(&cinfo);
+    return 3;
+  }
+  hwc.resize(size_t(sh) * sw * ch);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = hwc.data() + size_t(cinfo.output_scanline) * sw * ch;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  // Strict mode: libjpeg silently tolerates truncated streams (gray fill,
+  // warning counter bumped); treat any warning as corrupt so the skip
+  // accounting matches the PIL fallback.
+  const long warnings = cinfo.err->num_warnings;
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  if (warnings > 0) return 4;
+
+  resized.resize(size_t(out_h) * out_w * ch);
+  resize_bilinear_hwc(hwc.data(), sh, sw, resized.data(), out_h, out_w, ch);
+  // HWC -> planar CHW
+  for (int c = 0; c < ch; ++c)
+    for (int y = 0; y < out_h; ++y)
+      for (int x = 0; x < out_w; ++x)
+        out_chw[(size_t(c) * out_h + y) * out_w + x] =
+            resized[(size_t(y) * out_w + x) * ch + c];
+  return 0;
+}
+
+// Batch decode: jpegs given as one concatenated buffer + offsets/lengths.
+// Each output slot is 3*out_h*out_w bytes; ok[i] = 0 on success.
+// OpenMP-parallel: this is the multi-core ingest loop that keeps chips fed.
+void jp_decode_resize_chw_batch(const uint8_t* blob, const long* offsets,
+                                const long* lengths, int n, int out_h,
+                                int out_w, uint8_t* out, int* ok) {
+#pragma omp parallel for schedule(dynamic)
+  for (int i = 0; i < n; ++i) {
+    ok[i] = jp_decode_resize_chw(blob + offsets[i], lengths[i], out_h, out_w,
+                                 out + size_t(i) * 3 * out_h * out_w);
+  }
+}
+
+// Fused train-time preprocess: CHW uint8 batch -> mean-subtract (full-size
+// CHW f32 mean) -> per-image crop at (ys[i], xs[i]) -> NHWC float32.
+// The C++ twin of reference ImageNetTensorFlowPreprocessor (Preprocessor
+// .scala:150-178): mean-subtract + crop + CHW->HWC in one pass.
+void jp_crop_mean_nhwc(const uint8_t* images_chw, int n, int c, int h, int w,
+                       const float* mean_chw, const int* ys, const int* xs,
+                       int crop, float* out_nhwc) {
+#pragma omp parallel for schedule(static)
+  for (int i = 0; i < n; ++i) {
+    const uint8_t* img = images_chw + size_t(i) * c * h * w;
+    float* dst = out_nhwc + size_t(i) * crop * crop * c;
+    const int y0 = ys[i], x0 = xs[i];
+    for (int y = 0; y < crop; ++y) {
+      for (int x = 0; x < crop; ++x) {
+        for (int cc = 0; cc < c; ++cc) {
+          const size_t src = (size_t(cc) * h + (y + y0)) * w + (x + x0);
+          dst[(size_t(y) * crop + x) * c + cc] =
+              float(img[src]) - (mean_chw ? mean_chw[src] : 0.f);
+        }
+      }
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,1 @@
+from .train_loop import train, probe_value  # noqa: F401

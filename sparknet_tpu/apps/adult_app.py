@@ -1,0 +1,54 @@
+"""Adult/Census tabular app (reference test-fixture workload:
+`models/adult/adult.prototxt` + `LoadAdultDataSpec.scala`), extended to a
+trainable 2-class MLP."""
+from __future__ import annotations
+
+import argparse
+
+from ..data.adult import AdultLoader
+from ..data.dataset import ArrayDataset
+from ..model.spec import (Filler, InnerProductParam, InputSpec, LayerSpec,
+                          NetSpec)
+from ..solver import SolverConfig
+from ..utils.config import RunConfig
+from ..zoo import _heads, _ip, _relu
+from .train_loop import train
+
+
+def adult_net(batch: int, n_features: int) -> NetSpec:
+    """adult.prototxt's MLP with a loss/accuracy head for training."""
+    return NetSpec(
+        name="adult",
+        inputs=(InputSpec("C0", (batch, n_features)),
+                InputSpec("label", (batch, 1), "int32")),
+        layers=(
+            _ip("ip", "C0", 10, filler=Filler(type="xavier")),
+            _relu("relu", "ip"),
+            _ip("ip2", "ip", 2, filler=Filler(type="xavier")),
+        ) + _heads("ip2"),
+    )
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data", required=True, help="adult.data CSV path")
+    p.add_argument("overrides", nargs="*")
+    args = p.parse_args(argv)
+    cfg = RunConfig(
+        model="adult",
+        solver=SolverConfig(base_lr=0.01, momentum=0.9, lr_policy="fixed"),
+        tau=5, local_batch=64, eval_every=5, max_rounds=50,
+    ).with_overrides(*args.overrides)
+    loader = AdultLoader(args.data)
+    full = loader.batch_dict()
+    # held-out eval: last 20% (the reference's adult path had no eval at all)
+    n = len(loader.labels)
+    split = max(1, int(n * 0.8))
+    train_ds = ArrayDataset({k: v[:split] for k, v in full.items()})
+    test_ds = ArrayDataset({k: v[split:] for k, v in full.items()})
+    n_features = loader.features.shape[1]
+    train(cfg, adult_net(cfg.local_batch, n_features), train_ds, test_ds)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Feature-extraction app — reference `apps/FeaturizerApp.scala` equivalent.
+
+The reference's only inference-shaped workload: build a net (no solver), set
+weights once, then map the dataset through `forward(..., List("ip1"))`
+extracting a hidden blob per example (`FeaturizerApp.scala:75-98`). Here:
+load weights (checkpoint or npz), batched jitted forward, write features npz.
+
+Usage:
+    python -m sparknet_tpu.apps.featurizer_app --data-dir data/cifar10 \
+        --weights w.npz --blob ip1 --out features.npz
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from ..data.cifar import CifarLoader
+from ..net_api import JaxNet
+from ..zoo import cifar10_quick
+
+
+def featurize(net: JaxNet, batch_dict, blob: str, batch_size: int
+              ) -> np.ndarray:
+    n = len(next(iter(batch_dict.values())))
+    feats = []
+    usable = (n // batch_size) * batch_size
+    for i in range(0, usable, batch_size):
+        batch = {k: v[i:i + batch_size] for k, v in batch_dict.items()}
+        out = net.forward(batch, blob_names=[blob])
+        feats.append(np.asarray(out[blob]))
+    return np.concatenate(feats) if feats else np.empty((0,))
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data-dir", required=True)
+    p.add_argument("--weights", help="WeightCollection .npz (optional)")
+    p.add_argument("--blob", default="ip1")
+    p.add_argument("--batch", type=int, default=100)
+    p.add_argument("--out", default="features.npz")
+    args = p.parse_args(argv)
+
+    loader = CifarLoader(args.data_dir)
+    net = JaxNet(cifar10_quick(batch=args.batch))
+    if args.weights:
+        net.load_weights(args.weights)
+    feats = featurize(net, loader.train_batch_dict(), args.blob, args.batch)
+    np.savez(args.out, features=feats)
+    print(f"wrote {feats.shape} features to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+"""ctypes binding for the native C++ data plane (native/jpeg_plane.cpp).
+
+Covers the reference's native-imaging role (JVM libjpeg via twelvemonkeys,
+reference `preprocessing/ScaleAndConvert.scala`): JPEG decode + force-resize
++ planar CHW, plus a fused crop/mean-subtract/NHWC batch kernel. Auto-builds
+with g++ on first use (cached .so); `available()` gates all callers, with
+PIL/numpy fallbacks elsewhere.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libjpeg_plane.so"))
+
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    if not os.path.exists(_SO_PATH):
+        script = os.path.join(_NATIVE_DIR, "build.sh")
+        if not os.path.exists(script):
+            _build_failed = True
+            return None
+        try:
+            subprocess.run(["sh", script], check=True, capture_output=True,
+                           timeout=120)
+        except (subprocess.SubprocessError, OSError):
+            _build_failed = True
+            return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        _build_failed = True
+        return None
+    lib.jp_decode_resize_chw.restype = ctypes.c_int
+    lib.jp_decode_resize_chw.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8)]
+    lib.jp_decode_resize_chw_batch.restype = None
+    lib.jp_decode_resize_chw_batch.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
+        ctypes.POINTER(ctypes.c_long), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.jp_crop_mean_nhwc.restype = None
+    lib.jp_crop_mean_nhwc.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_float)]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def decode_resize_chw(data: bytes, height: int, width: int) -> np.ndarray:
+    """One JPEG -> CHW uint8 at (height, width). Raises ValueError on corrupt
+    input (same contract as the PIL fallback)."""
+    lib = _load()
+    assert lib is not None, "native plane unavailable"
+    out = np.empty((3, height, width), dtype=np.uint8)
+    rc = lib.jp_decode_resize_chw(
+        data, len(data), height, width,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if rc != 0:
+        raise ValueError(f"jpeg decode failed (rc={rc})")
+    return out
+
+
+def decode_resize_chw_batch(jpegs: list, height: int, width: int
+                            ) -> Tuple[np.ndarray, np.ndarray]:
+    """Parallel batch decode. Returns (images (N,3,H,W) uint8, ok (N,) bool);
+    corrupt entries have ok=False and undefined pixels."""
+    lib = _load()
+    assert lib is not None, "native plane unavailable"
+    n = len(jpegs)
+    blob = b"".join(jpegs)
+    offsets = np.zeros(n, dtype=np.int64)
+    lengths = np.array([len(j) for j in jpegs], dtype=np.int64)
+    np.cumsum(lengths[:-1], out=offsets[1:])
+    out = np.empty((n, 3, height, width), dtype=np.uint8)
+    ok = np.zeros(n, dtype=np.int32)
+    lib.jp_decode_resize_chw_batch(
+        blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_long)), n, height,
+        width, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_int)))
+    return out, ok == 0
+
+
+def crop_mean_nhwc(images_chw_u8: np.ndarray,
+                   mean_chw: Optional[np.ndarray],
+                   ys: np.ndarray, xs: np.ndarray, crop: int) -> np.ndarray:
+    """Fused mean-subtract + crop + NHWC for a CHW uint8 batch."""
+    lib = _load()
+    assert lib is not None, "native plane unavailable"
+    images_chw_u8 = np.ascontiguousarray(images_chw_u8, dtype=np.uint8)
+    n, c, h, w = images_chw_u8.shape
+    ys = np.ascontiguousarray(ys, dtype=np.int32)
+    xs = np.ascontiguousarray(xs, dtype=np.int32)
+    mean_ptr = None
+    if mean_chw is not None:
+        mean_chw = np.ascontiguousarray(mean_chw, dtype=np.float32)
+        assert mean_chw.shape == (c, h, w), (mean_chw.shape, (c, h, w))
+        mean_ptr = mean_chw.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    out = np.empty((n, crop, crop, c), dtype=np.float32)
+    lib.jp_crop_mean_nhwc(
+        images_chw_u8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n, c, h, w, mean_ptr,
+        ys.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        xs.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        crop, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return out

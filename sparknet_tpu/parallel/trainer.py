@@ -106,6 +106,12 @@ class ParallelTrainer:
         state = TrainState(params=jax.tree.map(tile, params),
                            momentum=jax.tree.map(tile, zeros),
                            it=jnp.zeros((self.n_devices,), jnp.int32))
+        return self.place(state)
+
+    def place(self, state: TrainState) -> TrainState:
+        """Re-place a (possibly host/numpy) TrainState onto the mesh sharding
+        the jitted round expects — required after checkpoint restore, else
+        every subsequent round recompiles for the foreign layout."""
         return jax.device_put(state, NamedSharding(self.mesh, P(DATA_AXIS)))
 
     def averaged_params(self, state: TrainState) -> PyTree:

@@ -1,0 +1,4 @@
+from .logger import Logger, default_logger  # noqa: F401
+from .metrics import PhaseTimers, ThroughputMeter  # noqa: F401
+from .config import RunConfig  # noqa: F401
+from . import checkpoint  # noqa: F401

@@ -1,0 +1,78 @@
+"""Typed run configuration.
+
+The reference's config was scattered across four channels (positional argv,
+env vars, model/solver data files, hardcoded app constants — SURVEY §5.6).
+Here one dataclass covers model, solver, data, mesh, τ, eval cadence,
+checkpointing; loadable from JSON and overridable from CLI key=value pairs.
+Model/solver remain loadable from prototxt data files (capability parity).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from ..solver import SolverConfig
+
+
+@dataclass
+class RunConfig:
+    # model
+    model: str = "cifar10_quick"        # zoo name, or path to a .prototxt
+    n_classes: int = 10
+    # solver (inline or from solver_prototxt)
+    solver: SolverConfig = field(default_factory=SolverConfig)
+    solver_prototxt: Optional[str] = None
+    # data
+    data_dir: str = "data"
+    subtract_mean: bool = True
+    crop: Optional[int] = None
+    # distribution
+    n_devices: Optional[int] = None     # None = all visible
+    tau: int = 10                       # local steps per sync round
+    mode: str = "local_sgd"             # or "sync_sgd"
+    local_batch: int = 100
+    # loop
+    max_rounds: int = 100
+    eval_every: int = 5                 # rounds between evals (reference: 5/10)
+    eval_batch: int = 1000
+    # precision
+    precision: str = "float32"          # or "bfloat16"
+    # checkpoint
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 25          # rounds
+    resume: bool = True
+    # logging
+    workdir: str = "."
+    seed: int = 0
+
+    @staticmethod
+    def from_json(path: str) -> "RunConfig":
+        with open(path) as f:
+            d = json.load(f)
+        return RunConfig.from_dict(d)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "RunConfig":
+        d = dict(d)
+        if "solver" in d and isinstance(d["solver"], dict):
+            d["solver"] = SolverConfig.from_dict(d["solver"])
+        known = {f.name for f in dataclasses.fields(RunConfig)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown config keys: {sorted(unknown)}")
+        return RunConfig(**d)
+
+    def with_overrides(self, *pairs: str) -> "RunConfig":
+        """Apply CLI 'key=value' overrides (JSON-parsed values)."""
+        d = dataclasses.asdict(self)
+        for p in pairs:
+            k, _, v = p.partition("=")
+            if not _:
+                raise ValueError(f"override {p!r} is not key=value")
+            try:
+                d[k] = json.loads(v)
+            except json.JSONDecodeError:
+                d[k] = v
+        return RunConfig.from_dict(d)

@@ -1,0 +1,59 @@
+"""Training logger: reference `libs/Logger.scala` parity plus structure.
+
+The reference logged wall-clock-elapsed-prefixed lines to
+`training_log_<millis>.txt`, flushed per line, with an optional iteration
+index (`Logger.scala:5-18`). Same here, plus console echo and a JSONL twin
+for machine-readable metrics (the reference's gap, SURVEY §5.5).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+
+class Logger:
+    def __init__(self, path: Optional[str] = None, echo: bool = True,
+                 jsonl_path: Optional[str] = None):
+        self.t0 = time.time()
+        self.echo = echo
+        self._f = open(path, "a", buffering=1) if path else None
+        self._jsonl = open(jsonl_path, "a", buffering=1) if jsonl_path else None
+
+    def log(self, message: str, i: Optional[int] = None) -> None:
+        """Elapsed-seconds-prefixed line (reference `logger.log(msg, i)`)."""
+        elapsed = time.time() - self.t0
+        suffix = f", iteration = {i}" if i is not None else ""
+        line = f"[{elapsed:.3f}s] {message}{suffix}"
+        if self._f:
+            self._f.write(line + "\n")
+        if self.echo:
+            print(line, file=sys.stderr, flush=True)
+
+    def metrics(self, step: int, **kv: Any) -> None:
+        """One JSONL record: {"step": ..., "t": ..., **metrics}."""
+        if self._jsonl:
+            rec: Dict[str, Any] = {"step": step,
+                                   "t": round(time.time() - self.t0, 3)}
+            rec.update({k: (float(v) if hasattr(v, "__float__") else v)
+                        for k, v in kv.items()})
+            self._jsonl.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        for f in (self._f, self._jsonl):
+            if f:
+                f.close()
+
+
+def default_logger(workdir: Optional[str] = None, name: str = "training"
+                   ) -> Logger:
+    """Reference naming convention: training_log_<millis>.txt under the
+    framework home (`apps/CifarApp.scala:51`)."""
+    if workdir is None:
+        workdir = os.environ.get("SPARKNET_TPU_HOME", ".")
+    os.makedirs(workdir, exist_ok=True)
+    ms = int(time.time() * 1000)
+    return Logger(path=os.path.join(workdir, f"{name}_log_{ms}.txt"),
+                  jsonl_path=os.path.join(workdir, f"{name}_metrics_{ms}.jsonl"))

@@ -1,0 +1,63 @@
+"""Metrics registry + phase timers.
+
+Replaces the reference's ad-hoc stdout spans (`transformInto took ...`,
+`ForwardBackward took ...` at `libs/CaffeNet.scala:113-120`; `stuff took /
+iters took` in the apps) with named accumulating timers and a throughput
+meter (images/sec/chip — the BASELINE.md headline unit).
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+
+class PhaseTimers:
+    """Accumulating named wall-clock spans (per-phase step breakdown)."""
+
+    def __init__(self):
+        self.total: Dict[str, float] = {}
+        self.count: Dict[str, int] = {}
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.total[name] = self.total.get(name, 0.0) + dt
+            self.count[name] = self.count.get(name, 0) + 1
+
+    def mean(self, name: str) -> float:
+        return self.total.get(name, 0.0) / max(self.count.get(name, 0), 1)
+
+    def summary(self) -> Dict[str, float]:
+        return {f"{k}_mean_s": round(self.mean(k), 6) for k in self.total}
+
+    def reset(self) -> None:
+        self.total.clear()
+        self.count.clear()
+
+
+class ThroughputMeter:
+    """images/sec (/chip if n_chips given), over a sliding accumulation."""
+
+    def __init__(self, n_chips: int = 1):
+        self.n_chips = n_chips
+        self.images = 0
+        self.seconds = 0.0
+
+    def add(self, n_images: int, seconds: float) -> None:
+        self.images += n_images
+        self.seconds += seconds
+
+    def images_per_sec(self) -> float:
+        return self.images / self.seconds if self.seconds else 0.0
+
+    def images_per_sec_per_chip(self) -> float:
+        return self.images_per_sec() / self.n_chips
+
+    def reset(self) -> None:
+        self.images = 0
+        self.seconds = 0.0

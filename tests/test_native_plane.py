@@ -1,0 +1,82 @@
+"""C++ data plane tests (skipped if the native lib can't build)."""
+import io
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data import jpeg_plane
+
+pytestmark = pytest.mark.skipif(not jpeg_plane.available(),
+                                reason="native plane unavailable")
+
+
+def make_jpeg(arr):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+    return buf.getvalue()
+
+
+def test_decode_resize_matches_pil_on_smooth_image():
+    y, x = np.mgrid[0:61, 0:83]
+    arr = np.stack([(y * 2) % 256, (x * 3) % 256, (x + y) % 256],
+                   -1).astype(np.uint8)
+    data = make_jpeg(arr)
+    got = jpeg_plane.decode_resize_chw(data, 48, 48)
+    from sparknet_tpu.data.imagenet import _decode_pil
+    ref = _decode_pil(data, 48, 48)
+    assert got.shape == (3, 48, 48)
+    assert np.abs(got.astype(int) - ref.astype(int)).mean() < 2.0
+
+
+def test_decode_corrupt_raises():
+    with pytest.raises(ValueError, match="decode failed"):
+        jpeg_plane.decode_resize_chw(b"not a jpeg", 32, 32)
+
+
+def test_batch_decode_flags_corrupt_entries():
+    arr = np.zeros((40, 40, 3), np.uint8)
+    good = make_jpeg(arr)
+    imgs, ok = jpeg_plane.decode_resize_chw_batch(
+        [good, good[: len(good) // 2], good, b""], 32, 32)
+    assert ok.tolist() == [True, False, True, False]
+    assert imgs.shape == (4, 3, 32, 32)
+    np.testing.assert_array_equal(imgs[0], imgs[2])
+
+
+def test_fused_crop_mean_nhwc_matches_numpy(rng):
+    imgs = rng.integers(0, 256, (5, 3, 20, 24), dtype=np.uint8)
+    mean = rng.standard_normal((3, 20, 24)).astype(np.float32)
+    ys = np.array([0, 1, 2, 3, 4], np.int32)
+    xs = np.array([4, 3, 2, 1, 0], np.int32)
+    got = jpeg_plane.crop_mean_nhwc(imgs, mean, ys, xs, 16)
+    for i in range(5):
+        want = (imgs[i].astype(np.float32) - mean)[
+            :, ys[i]:ys[i] + 16, xs[i]:xs[i] + 16].transpose(1, 2, 0)
+        np.testing.assert_allclose(got[i], want, rtol=1e-6)
+
+
+def test_fused_no_mean(rng):
+    imgs = rng.integers(0, 256, (2, 3, 8, 8), dtype=np.uint8)
+    got = jpeg_plane.crop_mean_nhwc(imgs, None, np.zeros(2, np.int32),
+                                    np.zeros(2, np.int32), 8)
+    np.testing.assert_array_equal(got[0],
+                                  imgs[0].astype(np.float32).transpose(1, 2, 0))
+
+
+def test_preprocessor_uses_fused_path(rng):
+    """ImagePreprocessor with uint8 CHW input routes through the native
+    kernel and matches the pure-numpy float path."""
+    from sparknet_tpu.data.preprocess import ImagePreprocessor
+    from sparknet_tpu.schema import Field, Schema
+    schema = Schema(Field("data", "float32", (3, 10, 10)),
+                    Field("label", "int32", (1,)))
+    imgs = rng.integers(0, 256, (6, 3, 14, 14), dtype=np.uint8)
+    mean = rng.standard_normal((3, 14, 14)).astype(np.float32)
+    a = ImagePreprocessor(schema, mean_image=mean, crop=10, seed=7)
+    b = ImagePreprocessor(schema, mean_image=mean, crop=10, seed=7)
+    lab = np.zeros((6, 1))
+    fused = a.convert_batch({"data": imgs, "label": lab}, train=True)
+    plain = b.convert_batch({"data": imgs.astype(np.float32), "label": lab},
+                            train=True)
+    np.testing.assert_allclose(fused["data"], plain["data"], atol=1e-5)
