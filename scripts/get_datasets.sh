@@ -1,0 +1,39 @@
+#!/bin/sh
+# Dataset fetchers — parity with the reference's data/*/get_*.sh scripts.
+# Usage: scripts/get_datasets.sh [cifar10|mnist|adult|all] [DATA_DIR]
+set -e
+WHICH="${1:-all}"
+DATA="${2:-data}"
+
+get_cifar10() {
+  mkdir -p "$DATA/cifar10"
+  wget -q -O - https://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz \
+    | tar -xz -C "$DATA/cifar10" --strip-components=1
+  echo "cifar10 -> $DATA/cifar10"
+}
+
+get_mnist() {
+  mkdir -p "$DATA/mnist"
+  for f in train-images-idx3-ubyte train-labels-idx1-ubyte \
+           t10k-images-idx3-ubyte t10k-labels-idx1-ubyte; do
+    wget -q -O "$DATA/mnist/$f.gz" \
+      "https://storage.googleapis.com/cvdf-datasets/mnist/$f.gz"
+    gunzip -f "$DATA/mnist/$f.gz"
+  done
+  echo "mnist -> $DATA/mnist"
+}
+
+get_adult() {
+  mkdir -p "$DATA/adult"
+  wget -q -O "$DATA/adult/adult.data" \
+    "https://archive.ics.uci.edu/ml/machine-learning-databases/adult/adult.data"
+  echo "adult -> $DATA/adult"
+}
+
+case "$WHICH" in
+  cifar10) get_cifar10 ;;
+  mnist)   get_mnist ;;
+  adult)   get_adult ;;
+  all)     get_cifar10; get_mnist; get_adult ;;
+  *) echo "usage: $0 [cifar10|mnist|adult|all] [DATA_DIR]" >&2; exit 1 ;;
+esac

@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""Re-shard ImageNet into shuffled tar chunks + label files.
+
+Parity with the reference's `scripts/put_imagenet_on_s3.py` (Python 2 + boto):
+reads the ILSVRC2012 training tar-of-tars and validation tar, re-shards into
+N shuffled chunks of resized JPEGs, writes `train.NNNN.tar` / `val.NNNN.tar`
+plus `train.txt` / `val.txt` "filename label" maps — into a local directory
+(sync to object storage with `gsutil -m rsync` afterwards; no cloud SDK
+dependency here).
+
+Train shards only (labels = sorted synset order); shard the validation tar
+separately with any tool and write val.txt in the same "filename label"
+format.
+
+Usage:
+  scripts/shard_imagenet.py --train-tar ILSVRC2012_img_train.tar \
+      --out data/imagenet --shards 1000 --size 256
+"""
+from __future__ import annotations
+
+import argparse
+import io
+import os
+import random
+import tarfile
+
+
+def resize_jpeg(data: bytes, size: int) -> bytes:
+    from PIL import Image
+    img = Image.open(io.BytesIO(data)).convert("RGB").resize(
+        (size, size), Image.BILINEAR)
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG", quality=90)
+    return buf.getvalue()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--train-tar", required=True,
+                   help="ILSVRC2012_img_train.tar (tar of per-class tars)")
+    p.add_argument("--out", required=True)
+    p.add_argument("--shards", type=int, default=1000)
+    p.add_argument("--size", type=int, default=256)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    os.makedirs(args.out, exist_ok=True)
+    # pass 1: class list -> labels (sorted synset order, reference convention)
+    entries = []  # (class_tar_name, member_name)
+    with tarfile.open(args.train_tar) as outer:
+        class_tars = sorted(m.name for m in outer if m.isfile())
+    label_of = {name: i for i, name in enumerate(class_tars)}
+    print(f"{len(class_tars)} classes")
+
+    # pass 2: enumerate images, assign shuffled shard ids
+    with tarfile.open(args.train_tar) as outer:
+        for m in outer:
+            if not m.isfile():
+                continue
+            inner = tarfile.open(fileobj=outer.extractfile(m))
+            for im in inner:
+                if im.isfile():
+                    entries.append((m.name, im.name))
+    rng = random.Random(args.seed)
+    rng.shuffle(entries)
+    shard_of = {e: i * args.shards // len(entries)
+                for i, e in enumerate(entries)}
+    print(f"{len(entries)} images -> {args.shards} shards")
+
+    writers = {}
+    labels = []
+    with tarfile.open(args.train_tar) as outer:
+        for m in outer:
+            if not m.isfile():
+                continue
+            inner = tarfile.open(fileobj=outer.extractfile(m))
+            for im in inner:
+                if not im.isfile():
+                    continue
+                sid = shard_of[(m.name, im.name)]
+                if sid not in writers:
+                    writers[sid] = tarfile.open(
+                        os.path.join(args.out, f"train.{sid:04d}.tar"), "w")
+                data = resize_jpeg(inner.extractfile(im).read(), args.size)
+                info = tarfile.TarInfo(name=os.path.basename(im.name))
+                info.size = len(data)
+                writers[sid].addfile(info, io.BytesIO(data))
+                labels.append(f"{os.path.basename(im.name)} "
+                              f"{label_of[m.name]}")
+    for w in writers.values():
+        w.close()
+    with open(os.path.join(args.out, "train.txt"), "w") as f:
+        f.write("\n".join(labels) + "\n")
+    print(f"wrote {len(writers)} shards + train.txt under {args.out}")
+
+
+if __name__ == "__main__":
+    main()

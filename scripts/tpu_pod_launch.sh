@@ -1,0 +1,33 @@
+#!/bin/sh
+# TPU pod bring-up + launcher — the reference's `spark-ec2`/`spark-submit`
+# analogue (reference ec2/spark_ec2.py + README.md:13-37), on gcloud TPU VMs.
+#
+#   scripts/tpu_pod_launch.sh create  NAME ZONE TYPE   # e.g. v5e-32
+#   scripts/tpu_pod_launch.sh setup   NAME ZONE        # rsync repo + deps
+#   scripts/tpu_pod_launch.sh run     NAME ZONE "python -m sparknet_tpu.apps.imagenet_app ..."
+#   scripts/tpu_pod_launch.sh delete  NAME ZONE
+#
+# `run` executes the SAME command on every worker (single-program multi-host:
+# jax.distributed.initialize autodetects the pod topology; host-sharded data
+# via sparknet_tpu.data.imagenet.host_shards keyed on jax.process_index()).
+set -e
+CMD="$1"; NAME="$2"; ZONE="$3"; ARG="$4"
+TPU="gcloud compute tpus tpu-vm"
+
+case "$CMD" in
+  create)
+    $TPU create "$NAME" --zone "$ZONE" --accelerator-type "$ARG" \
+      --version v2-alpha-tpuv5-lite ;;
+  setup)
+    $TPU scp --recurse --worker=all --zone "$ZONE" . "$NAME":~/sparknet_tpu_repo
+    $TPU ssh "$NAME" --worker=all --zone "$ZONE" --command \
+      "cd ~/sparknet_tpu_repo && pip install -q jax[tpu] flax optax && sh native/build.sh || true" ;;
+  run)
+    $TPU ssh "$NAME" --worker=all --zone "$ZONE" --command \
+      "cd ~/sparknet_tpu_repo && $ARG" ;;
+  delete)
+    $TPU delete "$NAME" --zone "$ZONE" --quiet ;;
+  *)
+    echo "usage: $0 {create|setup|run|delete} NAME ZONE [TYPE|COMMAND]" >&2
+    exit 1 ;;
+esac

@@ -1,0 +1,3 @@
+from .graphdef import GraphDef, NodeDef  # noqa: F401
+from .graph_net import GraphNet  # noqa: F401
+from .builder import GraphBuilder, build_mnist_graph  # noqa: F401

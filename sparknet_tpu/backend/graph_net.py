@@ -1,0 +1,254 @@
+"""GraphNet: execute a serialized GraphDef under the NetInterface API.
+
+Parity with reference `libs/TensorFlowNet.scala`:
+  - graph introspection discovers inputs/weights/train-op by the naming
+    convention (lines 24-49) — no side metadata;
+  - schema-columns-vs-graph-inputs validation (lines 28-31);
+  - `forward(batch, fetch_names)` fetches named tensors (73-84);
+  - `step(batch)` runs the in-graph optimizer `train//step` (86-90) —
+    momentum-SGD whose hyperparameters live in the graph node's attrs,
+    like the reference's in-graph MomentumOptimizer;
+  - `get_weights`/`set_weights` via the `//update_placeholder`/`//assign`
+    protocol (95-121), here realized as direct pytree swaps (the protocol is
+    honored at the format level: importers/exporters keep those nodes).
+
+Execution: the graph is topologically interpreted into a pure JAX function
+and jitted once per fetch-set; variables live as a flat {name: array} pytree.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..model.weights import WeightCollection
+from ..schema import Field, Schema
+from .graphdef import (ASSIGN_SUFFIX, GraphDef, INIT_ALL_VARS, NodeDef, OPS,
+                       TRAIN_STEP, UPDATE_SUFFIX)
+
+
+class GraphNet:
+    def __init__(self, graph: GraphDef, schema: Optional[Schema] = None,
+                 seed: int = 0):
+        self.graph = graph
+        self._nodes = {n.name: n for n in graph.nodes}
+        # -- introspection (TensorFlowNet.scala:24-49) --
+        self.input_names = [
+            n.name for n in graph.nodes
+            if n.op == "Placeholder" and not n.name.endswith(UPDATE_SUFFIX)]
+        self.variable_names = [n.name for n in graph.nodes
+                               if n.op == "Variable"]
+        self._train_node = self._nodes.get(TRAIN_STEP)
+        # protocol check: every variable has its update/assign pair if any do
+        for v in self.variable_names:
+            upd, asg = v + UPDATE_SUFFIX, v + ASSIGN_SUFFIX
+            if (upd in self._nodes) != (asg in self._nodes):
+                raise ValueError(f"variable {v!r}: incomplete "
+                                 f"update/assign pair in graph")
+        if schema is not None:
+            cols = set(schema.names())
+            gin = set(self.input_names)
+            if cols != gin:
+                raise ValueError(
+                    f"schema columns {sorted(cols)} != graph inputs "
+                    f"{sorted(gin)} (TensorFlowNet-parity validation)")
+        self.schema = schema
+        # -- init//all_vars (TensorFlowNet.scala:10-19) --
+        self.variables: Dict[str, jnp.ndarray] = {}
+        key = jax.random.PRNGKey(seed)
+        for v in self.variable_names:
+            node = self._nodes[v]
+            init = node.attrs.get("init")
+            if init is not None:
+                self.variables[v] = jnp.asarray(init)
+            else:
+                shape = tuple(node.attrs["shape"])
+                std = float(node.attrs.get("stddev", 0.1))
+                key, sub = jax.random.split(key)
+                self.variables[v] = std * jax.random.normal(sub, shape)
+        self._fetch_cache: Dict[Tuple[str, ...], callable] = {}
+        self._step_fn = None
+        self._step_loss: Optional[str] = None
+
+    # -- execution core ------------------------------------------------------
+
+    def _topo_order(self, fetches: Sequence[str]) -> List[NodeDef]:
+        """Topological order of the ANCESTORS of `fetches` only — lazy, like
+        a session run: unrelated subgraphs (e.g. an imported TF graph's
+        gradient machinery) are never touched."""
+        order, seen = [], set()
+
+        def visit(name: str):
+            if name in seen:
+                return
+            seen.add(name)
+            n = self._nodes.get(name)
+            if n is None:
+                raise KeyError(f"graph references unknown node {name!r}")
+            for i in n.inputs:
+                visit(i)
+            order.append(n)
+
+        for f in fetches:
+            visit(f)
+        return order
+
+    def _eval(self, variables, batch, fetches: Sequence[str]):
+        values: Dict[str, jnp.ndarray] = {}
+        for n in self._topo_order(fetches):
+            if n.op == "Placeholder":
+                if n.name in batch:
+                    values[n.name] = batch[n.name]
+                continue  # unfed update placeholders stay absent
+            if n.op == "Variable":
+                values[n.name] = variables[n.name]
+                continue
+            if n.op in ("Assign", "NoOp", "Train"):
+                continue  # protocol nodes, not part of forward dataflow
+            impl = OPS.get(n.op)
+            if impl is None:
+                raise ValueError(f"unsupported graph op {n.op!r} "
+                                 f"(node {n.name!r})")
+            try:
+                ins = [values[i] for i in n.inputs]
+            except KeyError as e:
+                raise ValueError(f"node {n.name!r}: missing input {e}") from e
+            values[n.name] = impl(n, ins)
+        return tuple(values[f] for f in fetches)
+
+    # -- NetInterface --------------------------------------------------------
+
+    def forward(self, batch: Dict[str, np.ndarray],
+                fetches: Optional[Sequence[str]] = None
+                ) -> Dict[str, np.ndarray]:
+        fetches = tuple(fetches or self.output_names())
+        if fetches not in self._fetch_cache:
+            self._fetch_cache[fetches] = jax.jit(
+                lambda v, b: self._eval(v, b, fetches))
+        vals = self._fetch_cache[fetches](self.variables,
+                                          self._prep(batch))
+        return {f: np.asarray(v) for f, v in zip(fetches, vals)}
+
+    def step(self, batch: Dict[str, np.ndarray],
+             loss_name: Optional[str] = None) -> float:
+        """Run the in-graph optimizer once (reference `step`, 86-90).
+
+        Native graphs carry a `Train` node whose input is the loss. Imported
+        TF graphs keep their original train//step (an opaque counter-bump
+        op) — for those, pass `loss_name` explicitly; autodiff does the rest.
+        """
+        if loss_name is None:
+            if self._train_node is None:
+                raise ValueError(f"graph has no {TRAIN_STEP!r} node; pass "
+                                 f"loss_name= to train an imported graph")
+            if self._train_node.op != "Train":
+                raise ValueError(
+                    f"{TRAIN_STEP!r} node has op {self._train_node.op!r} "
+                    f"(an imported optimizer subgraph, not our Train "
+                    f"protocol) — pass loss_name= explicitly, e.g. "
+                    f"step(batch, loss_name='loss')")
+            loss_name = self._train_node.inputs[0]
+        attrs = self._train_node.attrs if (
+            self._train_node is not None and self._train_node.op == "Train"
+        ) else {}
+        lr = float(attrs.get("learning_rate", 0.01))
+        momentum = float(attrs.get("momentum", 0.9))
+        if self._step_fn is not None and self._step_loss != loss_name:
+            self._step_fn = None
+        if self._step_fn is None:
+            self._step_loss = loss_name
+
+            def one_step(variables, velocity, b):
+                loss, grads = jax.value_and_grad(
+                    lambda v: self._eval(v, b, (loss_name,))[0])(variables)
+                new_vel = jax.tree.map(
+                    lambda vel, g: momentum * vel + lr * g, velocity, grads)
+                new_vars = jax.tree.map(lambda v, nv: v - nv, variables,
+                                        new_vel)
+                return new_vars, new_vel, loss
+            self._step_fn = jax.jit(one_step, donate_argnums=(0, 1))
+            self._velocity = jax.tree.map(jnp.zeros_like, self.variables)
+        self.variables, self._velocity, loss = self._step_fn(
+            self.variables, self._velocity, self._prep(batch))
+        return float(loss)
+
+    def get_weights(self) -> WeightCollection:
+        return WeightCollection(
+            {v: [np.asarray(self.variables[v])] for v in self.variable_names},
+            list(self.variable_names))
+
+    def set_weights(self, weights: WeightCollection) -> None:
+        """Honors the //assign protocol semantics: every variable swapped,
+        shapes asserted (reference 110-121)."""
+        for v in self.variable_names:
+            assert v in weights, f"weights missing variable {v!r}"
+            arr = weights[v][0]
+            assert arr.shape == tuple(self.variables[v].shape), (
+                f"{v}: {arr.shape} != {tuple(self.variables[v].shape)}")
+            self.variables[v] = jnp.asarray(arr)
+        self._velocity = None
+        self._step_fn = None  # re-init momentum against new weights
+
+    def output_names(self) -> List[str]:
+        """Terminal nodes that are actually evaluable: excludes protocol
+        nodes, opaque imported ops (TF::*), and any terminal whose ancestor
+        closure touches an opaque op or a multi-output ref ('node:1') —
+        imported gradient machinery would otherwise crash default fetches."""
+        consumed = {i for n in self.graph.nodes for i in n.inputs}
+        out = []
+        for n in self.graph.nodes:
+            if n.name in consumed or n.op in (
+                    "Placeholder", "Variable", "Assign", "NoOp", "Train"):
+                continue
+            if self._evaluable(n.name):
+                out.append(n.name)
+        return out
+
+    def _evaluable(self, name: str, _seen: Optional[set] = None) -> bool:
+        seen = _seen if _seen is not None else set()
+        if name in seen:
+            return True
+        seen.add(name)
+        n = self._nodes.get(name)
+        if n is None:  # unknown ref, e.g. 'node:1'
+            return False
+        if n.op.startswith("TF::"):
+            return False
+        if n.op in ("Placeholder", "Variable", "Const"):
+            return True
+        return all(self._evaluable(i, seen) for i in n.inputs)
+
+    def output_schema(self) -> Schema:
+        outs = self.forward_shapes(self.output_names())
+        return Schema(*[Field(name, "float32", tuple(s[1:]) if s else ())
+                        for name, s in outs.items()])
+
+    def forward_shapes(self, names: Sequence[str]) -> Dict[str, Tuple]:
+        """Shape inference via abstract evaluation."""
+        batch = {}
+        for iname in self.input_names:
+            node = self._nodes[iname]
+            shape = tuple(node.attrs["shape"])
+            dtype = node.attrs.get("dtype", "float32")
+            batch[iname] = jax.ShapeDtypeStruct(shape, dtype)
+        out = jax.eval_shape(
+            lambda v, b: self._eval(v, b, tuple(names)), self.variables, batch)
+        return {n: tuple(o.shape) for n, o in zip(names, out)}
+
+    def _prep(self, batch):
+        out = {}
+        for iname in self.input_names:
+            if iname not in batch:
+                raise ValueError(f"batch missing graph input {iname!r}")
+            node = self._nodes[iname]
+            arr = np.asarray(batch[iname])
+            want = tuple(node.attrs.get("shape", arr.shape))
+            if len(want) == 4 and arr.ndim == 4 and \
+                    tuple(arr.shape[1:]) != tuple(want[1:]) and \
+                    (arr.shape[2], arr.shape[3], arr.shape[1]) == tuple(want[1:]):
+                arr = np.transpose(arr, (0, 2, 3, 1))  # NCHW -> NHWC
+            dt = node.attrs.get("dtype", "float32")
+            out[iname] = jnp.asarray(arr.astype(dt, copy=False))
+        return out

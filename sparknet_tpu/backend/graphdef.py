@@ -1,0 +1,205 @@
+"""Portable serialized dataflow-graph format + executor — the second backend.
+
+The reference proved its backend abstraction by running a *serialized graph*
+engine (TensorFlow C++: `Session` over a frozen `GraphDef`) behind the same
+`NetInterface` as Caffe (`libs/TensorFlowNet.scala`). This module is the
+TPU-native equivalent: a JSON graph of primitive dataflow ops, interpreted
+into a pure JAX function and jitted — so a net can be *defined by a data
+file produced elsewhere*, not only by the layer IR.
+
+Format (JSON):
+    {"version": 1, "name": ...,
+     "nodes": [{"name": ..., "op": ..., "inputs": [...], "attrs": {...}}]}
+
+Conventions — the SAME naming protocol the reference's TF models used
+(`models/tensorflow/mnist/mnist_graph.py`, final block; discovered by
+introspection in `TensorFlowNet.scala:24-49`):
+  - inputs         = Placeholder nodes NOT named `*//update_placeholder`
+  - weights        = Variable nodes (attrs carry the initial value)
+  - per-variable   `<var>//update_placeholder` + `<var>//assign` pairs
+    implement set_weights through the graph
+  - `train//step`  = the in-graph optimizer application node
+  - `init//all_vars` initializes variables
+
+Layouts are TPU-native: conv2d is NHWC/HWIO, matmul is (in, out).
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import precision
+
+UPDATE_SUFFIX = "//update_placeholder"
+ASSIGN_SUFFIX = "//assign"
+TRAIN_STEP = "train//step"
+INIT_ALL_VARS = "init//all_vars"
+
+
+@dataclass
+class NodeDef:
+    name: str
+    op: str
+    inputs: List[str] = field(default_factory=list)
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class GraphDef:
+    name: str
+    nodes: List[NodeDef]
+    version: int = 1
+
+    def node(self, name: str) -> NodeDef:
+        for n in self.nodes:
+            if n.name == name:
+                return n
+        raise KeyError(name)
+
+    def to_json(self) -> str:
+        def enc(v):
+            if isinstance(v, np.ndarray):
+                return {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+            return v
+        return json.dumps({
+            "version": self.version, "name": self.name,
+            "nodes": [{"name": n.name, "op": n.op, "inputs": n.inputs,
+                       "attrs": {k: enc(v) for k, v in n.attrs.items()}}
+                      for n in self.nodes]})
+
+    @staticmethod
+    def from_json(text: str) -> "GraphDef":
+        def dec(v):
+            if isinstance(v, dict) and "__ndarray__" in v:
+                return np.asarray(v["__ndarray__"], dtype=v["dtype"])
+            return v
+        d = json.loads(text)
+        if d.get("version") != 1:
+            raise ValueError(f"unsupported graph version {d.get('version')!r}")
+        return GraphDef(
+            name=d.get("name", "graph"),
+            nodes=[NodeDef(name=n["name"], op=n["op"],
+                           inputs=list(n.get("inputs", [])),
+                           attrs={k: dec(v)
+                                  for k, v in n.get("attrs", {}).items()})
+                   for n in d["nodes"]])
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @staticmethod
+    def load(path: str) -> "GraphDef":
+        with open(path) as f:
+            return GraphDef.from_json(f.read())
+
+
+# ---------------------------------------------------------------------------
+# Op kernels: node -> value, given evaluated inputs
+# ---------------------------------------------------------------------------
+
+def _op_conv2d(n, ins):
+    x, w = ins
+    return lax.conv_general_dilated(
+        precision.cast_in(x), precision.cast_in(w),
+        window_strides=tuple(n.attrs.get("strides", (1, 1))),
+        padding=n.attrs.get("padding", "SAME"),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=int(n.attrs.get("groups", 1)),
+        precision=precision.matmul_precision(),
+        preferred_element_type=precision.preferred_out())
+
+
+def _op_matmul(n, ins):
+    x, w = ins
+    return jnp.dot(precision.cast_in(x), precision.cast_in(w),
+                   precision=precision.matmul_precision(),
+                   preferred_element_type=precision.preferred_out())
+
+
+def _op_max_pool(n, ins):
+    (x,) = ins
+    k = int(n.attrs.get("ksize", 2))
+    s = int(n.attrs.get("strides", 2))
+    pad = n.attrs.get("padding", "SAME")
+
+    def same_pad(size):  # TF SAME semantics, per spatial dim
+        out = -(-size // s)
+        total = max((out - 1) * s + k - size, 0)
+        return (total // 2, total - total // 2)
+
+    if pad == "SAME":
+        padding = ((0, 0), same_pad(x.shape[1]), same_pad(x.shape[2]), (0, 0))
+    else:
+        padding = ((0, 0), (0, 0), (0, 0), (0, 0))
+    return lax.reduce_window(x, -jnp.inf, lax.max, (1, k, k, 1),
+                             (1, s, s, 1), padding)
+
+
+def _op_reshape(n, ins):
+    (x,) = ins
+    shape = [int(d) for d in n.attrs["shape"]]
+    total = int(np.prod([d for d in shape if d != -1]))
+    if -1 not in shape and total != x.size:
+        # serialized graphs bake the training batch size into reshape consts
+        # (e.g. [64, 3136] in the reference's mnist_graph.pb); treat the
+        # leading dim as the batch when the tail divides evenly.
+        tail = int(np.prod(shape[1:]))
+        if tail > 0 and x.size % tail == 0:
+            shape = [x.size // tail] + shape[1:]
+    return x.reshape(shape)
+
+
+def _op_sparse_softmax_ce(n, ins):
+    logits, labels = ins
+    labels = labels.astype(jnp.int32)
+    if labels.ndim == 2 and labels.shape[1] == 1:
+        labels = labels[:, 0]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0])
+
+
+def _op_accuracy(n, ins):
+    logits, labels = ins
+    labels = labels.astype(jnp.int32)
+    if labels.ndim == 2 and labels.shape[1] == 1:
+        labels = labels[:, 0]
+    pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.mean((pred == labels).astype(jnp.float32))
+
+
+OPS: Dict[str, Callable[[NodeDef, Sequence[jnp.ndarray]], jnp.ndarray]] = {
+    "Conv2D": _op_conv2d,
+    "MatMul": _op_matmul,
+    "MaxPool": _op_max_pool,
+    "BiasAdd": lambda n, ins: ins[0] + ins[1].astype(ins[0].dtype),
+    "Add": lambda n, ins: ins[0] + ins[1],
+    "Sub": lambda n, ins: ins[0] - ins[1],
+    "Mul": lambda n, ins: ins[0] * ins[1],
+    "Relu": lambda n, ins: jnp.maximum(ins[0], 0),
+    "Tanh": lambda n, ins: jnp.tanh(ins[0]),
+    "Softmax": lambda n, ins: jax.nn.softmax(ins[0], axis=-1),
+    "Reshape": lambda n, ins: _op_reshape(n, ins),
+    "Flatten": lambda n, ins: ins[0].reshape(ins[0].shape[0], -1),
+    "Dropout": lambda n, ins: ins[0],  # eval semantics; train handled by rng
+    "SparseSoftmaxCrossEntropy": _op_sparse_softmax_ce,
+    "Accuracy": _op_accuracy,
+    "Identity": lambda n, ins: ins[0],
+    "Const": lambda n, ins: jnp.asarray(n.attrs["value"]),
+    # TF-import support set; 'axis' attr baked from const operands at import
+    "Mean": lambda n, ins: ins[0] if ins[0].ndim == 0 else jnp.mean(
+        ins[0], axis=(tuple(n.attrs["axis"]) if n.attrs.get("axis")
+                      is not None else None)),
+    "L2Loss": lambda n, ins: 0.5 * jnp.sum(jnp.square(ins[0])),
+    "AddN": lambda n, ins: sum(ins[1:], start=ins[0]),
+    "ArgMax": lambda n, ins: jnp.argmax(
+        ins[0], axis=int(n.attrs.get("axis", -1))).astype(jnp.int32),
+    "Equal": lambda n, ins: ins[0] == ins[1].astype(ins[0].dtype),
+    "Cast": lambda n, ins: ins[0].astype(n.attrs.get("dtype", "float32")),
+}

@@ -23,7 +23,26 @@ from jax import lax
 # and grad-through-jit with static_argnames mis-linearizes in jax 0.9.
 def lrn(x: jnp.ndarray, local_size: int = 5, *, alpha: float = 1e-4,
         beta: float = 0.75, k: float = 1.0) -> jnp.ndarray:
-    """LRN across the channel (last) axis of an NHWC (or N...C) tensor."""
+    """LRN across the channel (last) axis of an NHWC (or N...C) tensor.
+
+    On TPU dispatches to the fused Pallas kernel (`pallas_lrn.lrn_pallas`,
+    one VMEM pass fwd + one bwd); elsewhere the XLA reduce_window path."""
+    if _use_pallas(x):
+        from .pallas_lrn import lrn_pallas
+        return lrn_pallas(x, local_size, alpha, beta, k)
+    return _lrn_xla(x, local_size, alpha=alpha, beta=beta, k=k)
+
+
+def _use_pallas(x) -> bool:
+    try:
+        return jax.default_backend() not in ("cpu", "gpu") and x.ndim >= 2
+    except Exception:
+        return False
+
+
+def _lrn_xla(x: jnp.ndarray, local_size: int = 5, *, alpha: float = 1e-4,
+             beta: float = 0.75, k: float = 1.0) -> jnp.ndarray:
+    """XLA fallback: channel-padded reduce_window normalizer."""
     half = (local_size - 1) // 2
     # Window sums accumulate in f32: better numerics, and reduce_window-add
     # on bf16 fails to linearize under jit (jax 0.9).

@@ -1,0 +1,128 @@
+"""Pallas TPU kernel for across-channel LRN (forward + custom VJP).
+
+Why a kernel: XLA lowers the LRN normalizer to a reduce_window over a
+channel-padded buffer — an extra materialized intermediate and two passes
+over HBM. This kernel fuses square -> windowed channel sum (as `local_size`
+shifted lane adds, VPU-friendly) -> scale -> x*scale^-beta into ONE VMEM
+pass, and the backward into one more. Layout: NHWC flattened to (rows,
+channels) so channels sit on lanes.
+
+Caffe gradient (LRNLayer backward, across-channel):
+    ratio = dy * x * scale^(-beta-1)
+    dx    = dy * scale^-beta - (2*alpha*beta/n) * x * window_sum(ratio)
+
+`lrn_pallas(..., interpret=True)` runs the same kernel under the Pallas
+interpreter (CPU) — used by tests; real TPU runs compile it.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK_ROWS = 256
+
+
+def _window_sum(v: jnp.ndarray, half: int) -> jnp.ndarray:
+    """Sum of `2*half+1` lane-shifted copies with zero edge padding."""
+    acc = v
+    c = v.shape[-1]
+    for k in range(1, half + 1):
+        left = jnp.pad(v[:, k:], ((0, 0), (0, k)))    # window reaches +k
+        right = jnp.pad(v[:, :c - k], ((0, 0), (k, 0)))  # window reaches -k
+        acc = acc + left + right
+    return acc
+
+
+def _fwd_kernel(x_ref, y_ref, scale_ref, *, half: int, alpha_n: float,
+                beta: float, k: float):
+    x = x_ref[:]
+    ssq = _window_sum(x * x, half)
+    scale = k + alpha_n * ssq
+    y_ref[:] = x * jnp.exp(-beta * jnp.log(scale))
+    scale_ref[:] = scale
+
+
+def _bwd_kernel(x_ref, scale_ref, dy_ref, dx_ref, *, half: int,
+                alpha_n: float, beta: float):
+    x = x_ref[:]
+    scale = scale_ref[:]
+    dy = dy_ref[:]
+    inv_beta = jnp.exp(-beta * jnp.log(scale))          # scale^-beta
+    ratio = dy * x * inv_beta / scale                   # dy*x*scale^(-beta-1)
+    acc = _window_sum(ratio, half)
+    dx_ref[:] = dy * inv_beta - (2.0 * alpha_n * beta) * x * acc
+
+
+def _pad_rows(x2: jnp.ndarray) -> Tuple[jnp.ndarray, int]:
+    m = x2.shape[0]
+    pad = (-m) % BLOCK_ROWS
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    return x2, m
+
+
+def _call(kernel, n_out: int, x2: jnp.ndarray, *others, interpret: bool):
+    c = x2.shape[-1]
+    grid = (x2.shape[0] // BLOCK_ROWS,)
+    spec = pl.BlockSpec((BLOCK_ROWS, c), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[spec] * (1 + len(others)),
+        out_specs=[spec] * n_out if n_out > 1 else spec,
+        out_shape=([jax.ShapeDtypeStruct(x2.shape, x2.dtype)] * n_out
+                   if n_out > 1 else jax.ShapeDtypeStruct(x2.shape, x2.dtype)),
+        interpret=interpret,
+    )(x2, *others)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def lrn_pallas(x: jnp.ndarray, local_size: int = 5, alpha: float = 1e-4,
+               beta: float = 0.75, k: float = 1.0,
+               interpret: bool = False) -> jnp.ndarray:
+    y, _ = _lrn_fwd_impl(x, local_size, alpha, beta, k, interpret)
+    return y
+
+
+def _lrn_fwd_impl(x, local_size, alpha, beta, k, interpret):
+    half = (local_size - 1) // 2
+    alpha_n = alpha / local_size
+    shape = x.shape
+    x2, m = _pad_rows(x.reshape(-1, shape[-1]))
+    kern = functools.partial(_fwd_kernel, half=half, alpha_n=alpha_n,
+                             beta=beta, k=k)
+    y2, scale2 = _call(kern, 2, x2, interpret=interpret)
+    return y2[:m].reshape(shape), scale2[:m].reshape(shape)
+
+
+def _lrn_vjp_fwd(x, local_size, alpha, beta, k, interpret):
+    y, scale = _lrn_fwd_impl(x, local_size, alpha, beta, k, interpret)
+    return y, (x, scale)
+
+
+def _lrn_vjp_bwd(local_size, alpha, beta, k, interpret, res, dy):
+    x, scale = res
+    half = (local_size - 1) // 2
+    alpha_n = alpha / local_size
+    shape = x.shape
+    x2, m = _pad_rows(x.reshape(-1, shape[-1]))
+    scale2, _ = _pad_rows(scale.reshape(-1, shape[-1]))
+    # padded scale rows are 0 -> log(0); pad with k instead
+    if scale2.shape[0] != m:
+        pad = scale2.shape[0] - m
+        scale2 = scale2.at[m:].set(k) if pad else scale2
+    dy2, _ = _pad_rows(dy.reshape(-1, shape[-1]))
+    kern = functools.partial(_bwd_kernel, half=half, alpha_n=alpha_n,
+                             beta=beta)
+    dx2 = _call(kern, 1, x2, scale2, dy2, interpret=interpret)
+    return (dx2[:m].reshape(shape),)
+
+
+lrn_pallas.defvjp(_lrn_vjp_fwd, _lrn_vjp_bwd)

@@ -1,0 +1,119 @@
+"""Second-backend tests — mirrors the reference's TensorFlowNetSpec
+(`src/test/scala/libs/TensorFlowNetSpec.scala`): graph load, construction,
+forward shapes, probabilities summing to 1, get/set weights roundtrip,
+forward purity, step smoke test — plus serialization roundtrip and protocol
+validation the reference never tested."""
+import numpy as np
+import pytest
+
+from sparknet_tpu.backend import GraphBuilder, GraphDef, GraphNet, \
+    build_mnist_graph
+from sparknet_tpu.backend.graphdef import TRAIN_STEP, UPDATE_SUFFIX
+from sparknet_tpu.model.weights import WeightCollection
+from sparknet_tpu.schema import Field, Schema
+
+BATCH = 8
+
+
+@pytest.fixture(scope="module")
+def mnist_graph():
+    return build_mnist_graph(batch=BATCH)
+
+
+@pytest.fixture(scope="module")
+def batch(rng):
+    return {"data": rng.standard_normal((BATCH, 28, 28, 1)).astype(np.float32),
+            "label": rng.integers(0, 10, (BATCH, 1)).astype(np.int32)}
+
+
+def test_serialize_roundtrip(mnist_graph, tmp_path):
+    p = str(tmp_path / "g.json")
+    mnist_graph.save(p)
+    g2 = GraphDef.load(p)
+    assert [n.name for n in g2.nodes] == [n.name for n in mnist_graph.nodes]
+    np.testing.assert_array_equal(g2.node("conv1_w").attrs["init"],
+                                  mnist_graph.node("conv1_w").attrs["init"])
+
+
+def test_introspection(mnist_graph):
+    net = GraphNet(mnist_graph)
+    # inputs exclude //update_placeholder (TensorFlowNet.scala:24)
+    assert set(net.input_names) == {"data", "label"}
+    assert "conv1_w" in net.variable_names
+    assert net._train_node is not None
+
+
+def test_schema_validation_mismatch(mnist_graph):
+    bad = Schema(Field("data", "float32", (28, 28, 1)))
+    with pytest.raises(ValueError, match="graph inputs"):
+        GraphNet(mnist_graph, schema=bad)
+
+
+def test_forward_shapes_and_prob(mnist_graph, batch):
+    net = GraphNet(mnist_graph)
+    shapes = net.forward_shapes(["prob", "loss"])
+    assert shapes["prob"] == (BATCH, 10)
+    out = net.forward(batch, ["prob", "accuracy", "loss"])
+    np.testing.assert_allclose(out["prob"].sum(-1), 1.0, rtol=1e-5)
+    assert 0.0 <= out["accuracy"] <= 1.0
+
+
+def test_forward_accepts_nchw(mnist_graph, batch):
+    net = GraphNet(mnist_graph)
+    nchw = {"data": np.transpose(batch["data"], (0, 3, 1, 2)),
+            "label": batch["label"]}
+    a = net.forward(batch, ["prob"])["prob"]
+    b = net.forward(nchw, ["prob"])["prob"]
+    np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_weights_roundtrip(mnist_graph):
+    net = GraphNet(mnist_graph)
+    w = net.get_weights()
+    assert "conv1_w" in w and w["conv1_w"][0].shape == (5, 5, 1, 32)
+    net2 = GraphNet(build_mnist_graph(batch=BATCH, seed=1))
+    assert not WeightCollection.check_equal(w, net2.get_weights())
+    net2.set_weights(w)
+    assert WeightCollection.check_equal(w, net2.get_weights(), tol=0.0)
+
+
+def test_forward_purity(mnist_graph, batch):
+    """forward must not change weights (TensorFlowNetSpec.scala:104-118)."""
+    net = GraphNet(mnist_graph)
+    before = net.get_weights()
+    net.forward(batch)
+    assert WeightCollection.check_equal(before, net.get_weights(), tol=0.0)
+
+
+def test_step_reduces_loss(mnist_graph, batch):
+    net = GraphNet(mnist_graph)
+    losses = [net.step(batch) for _ in range(10)]
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_unsupported_op_fails_loudly():
+    g = GraphDef(name="bad", nodes=[
+        __import__("sparknet_tpu.backend.graphdef",
+                   fromlist=["NodeDef"]).NodeDef(
+            name="x", op="Placeholder", attrs={"shape": [1], "dtype": "float32"}),
+        __import__("sparknet_tpu.backend.graphdef",
+                   fromlist=["NodeDef"]).NodeDef(
+            name="y", op="FancyOp", inputs=["x"]),
+    ])
+    net = GraphNet(g)
+    with pytest.raises(ValueError, match="FancyOp"):
+        net.forward({"x": np.zeros((1,), np.float32)}, ["y"])
+
+
+def test_incomplete_assign_pair_rejected(mnist_graph):
+    nodes = [n for n in mnist_graph.nodes
+             if n.name != "conv1_w" + UPDATE_SUFFIX]
+    with pytest.raises(ValueError, match="incomplete"):
+        GraphNet(GraphDef(name="m", nodes=nodes))
+
+
+def test_output_schema(mnist_graph):
+    net = GraphNet(mnist_graph)
+    schema = net.output_schema()
+    assert schema["prob"].shape == (10,)

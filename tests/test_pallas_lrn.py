@@ -1,0 +1,57 @@
+"""Pallas LRN kernel vs XLA/torch oracles (interpreter mode on the CPU mesh;
+the same kernel compiles for real on TPU — exercised by bench.py)."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.ops.lrn import _lrn_xla
+from sparknet_tpu.ops.pallas_lrn import lrn_pallas
+
+
+@pytest.mark.parametrize("shape", [(2, 7, 7, 96), (1, 3, 3, 5), (300, 256)])
+def test_pallas_lrn_forward_matches_xla(rng, shape):
+    x = rng.standard_normal(shape, dtype=np.float32)
+    want = np.asarray(_lrn_xla(jnp.asarray(x), 5, alpha=1e-4, beta=0.75, k=1.0))
+    got = np.asarray(lrn_pallas(jnp.asarray(x), 5, 1e-4, 0.75, 1.0, True))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_lrn_forward_matches_torch(rng):
+    x = rng.standard_normal((2, 5, 5, 16), dtype=np.float32)
+    got = np.asarray(lrn_pallas(jnp.asarray(x), 5, 1e-4, 0.75, 1.0, True))
+    want = F.local_response_norm(
+        torch.from_numpy(np.transpose(x, (0, 3, 1, 2))), size=5, alpha=1e-4,
+        beta=0.75, k=1.0).numpy()
+    np.testing.assert_allclose(got, np.transpose(want, (0, 2, 3, 1)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_lrn_gradient_matches_autodiff_of_xla(rng):
+    """Custom VJP (Caffe's closed-form backward) vs autodiff of the XLA
+    forward — must agree."""
+    x = rng.standard_normal((3, 4, 4, 32), dtype=np.float32)
+    dy = rng.standard_normal((3, 4, 4, 32), dtype=np.float32)
+
+    def f_xla(x_):
+        return jnp.vdot(_lrn_xla(x_, 5, alpha=2e-4, beta=0.75, k=1.0),
+                        jnp.asarray(dy))
+
+    def f_pal(x_):
+        return jnp.vdot(lrn_pallas(x_, 5, 2e-4, 0.75, 1.0, True),
+                        jnp.asarray(dy))
+
+    g_want = np.asarray(jax.grad(f_xla)(jnp.asarray(x)))
+    g_got = np.asarray(jax.grad(f_pal)(jnp.asarray(x)))
+    np.testing.assert_allclose(g_got, g_want, rtol=1e-4, atol=1e-6)
+
+
+def test_pallas_lrn_row_padding(rng):
+    """Row counts not divisible by BLOCK_ROWS must round-trip unchanged."""
+    x = rng.standard_normal((7, 96), dtype=np.float32)  # 7 rows << 256
+    got = np.asarray(lrn_pallas(jnp.asarray(x), 5, 1e-4, 0.75, 1.0, True))
+    want = np.asarray(_lrn_xla(jnp.asarray(x), 5))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
