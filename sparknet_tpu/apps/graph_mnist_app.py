@@ -1,0 +1,90 @@
+"""MNIST training on the SERIALIZED-GRAPH backend — the reference's
+`apps/MnistApp.scala` pairing: a TensorFlowNet-style graph (in-graph
+Momentum optimizer + exp-decay lr) trained inside the distributed
+τ-averaging loop (MnistApp.scala:98-138; batch 64, τ=10, eval every 5).
+
+The graph can be:
+  - (default) our portable generator `build_mnist_graph()` — the analogue of
+    the reference generating `mnist_graph.pb` with `mnist_graph.py`;
+  - `--graph path.json` — a portable GraphDef JSON produced elsewhere;
+  - `--graph path.pb` — a frozen TF GraphDef (e.g. the reference's own
+    `models/tensorflow/mnist/mnist_graph.pb`), trained through its imported
+    in-graph optimizer.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from ..backend import GraphDef, GraphNet, build_mnist_graph
+from ..backend.tf_import import import_tf_graphdef_file
+from ..data.dataset import ArrayDataset
+from ..data.mnist import MnistLoader
+from ..parallel import GraphTrainer, initialize_multihost, make_mesh
+from ..utils.config import RunConfig
+from ..utils.logger import Logger, default_logger
+from .train_loop import run_loop
+
+
+def default_config() -> RunConfig:
+    return RunConfig(model="graph:mnist", data_dir="data/mnist", tau=10,
+                     local_batch=64, eval_every=5, eval_batch=512,
+                     max_rounds=100)
+
+
+def load_graph(path: str | None, batch: int, train_size: int) -> GraphDef:
+    if path is None:
+        return build_mnist_graph(batch=batch, train_size=train_size)
+    if path.endswith(".pb"):
+        return import_tf_graphdef_file(path)
+    return GraphDef.load(path)
+
+
+def _nhwc(arrays):
+    """Loader emits Caffe NCHW; the graph backend is NHWC (TPU layout)."""
+    out = dict(arrays)
+    out["data"] = np.ascontiguousarray(
+        np.transpose(arrays["data"], (0, 2, 3, 1)))
+    out["label"] = arrays["label"].reshape(-1)
+    return out
+
+
+def train_graph(cfg: RunConfig, graph: GraphDef, train_ds: ArrayDataset,
+                test_ds: ArrayDataset | None = None,
+                logger: Logger | None = None):
+    """The MnistApp loop over GraphTrainer: the shared `run_loop` driver with
+    the serialized-graph backend slotted in. Returns final device state."""
+    log = logger or default_logger(cfg.workdir)
+    net = GraphNet(graph, seed=cfg.seed)
+    mesh = make_mesh(cfg.n_devices)
+    trainer = GraphTrainer(net, mesh, tau=cfg.tau)
+    log.log(f"graph backend: {len(net.variable_names)} variables; "
+            f"mesh {trainer.n_devices} devices; tau={cfg.tau} "
+            f"local_batch={cfg.local_batch}")
+    return run_loop(cfg, trainer, train_ds, test_ds, log)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", help="RunConfig JSON path")
+    p.add_argument("--graph", default=None,
+                   help=".pb (TF GraphDef) or .json (portable) graph file")
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("overrides", nargs="*")
+    args = p.parse_args(argv)
+    initialize_multihost()
+    cfg = (RunConfig.from_json(args.config) if args.config
+           else default_config())
+    if args.data_dir:
+        cfg.data_dir = args.data_dir
+    cfg = cfg.with_overrides(*args.overrides)
+    loader = MnistLoader(cfg.data_dir)
+    train_ds = ArrayDataset(_nhwc(loader.train_batch_dict()))
+    test_ds = ArrayDataset(_nhwc(loader.test_batch_dict()))
+    graph = load_graph(args.graph, cfg.local_batch, len(train_ds))
+    train_graph(cfg, graph, train_ds, test_ds)
+
+
+if __name__ == "__main__":
+    main()

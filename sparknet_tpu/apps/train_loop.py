@@ -14,7 +14,7 @@ termination condition (max_rounds instead of `while(true)`).
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import numpy as np
@@ -76,7 +76,8 @@ def train(cfg: RunConfig, spec: NetSpec, train_ds: ArrayDataset,
           logger: Optional[Logger] = None,
           round_hook: Optional[Callable[[int, TrainState], None]] = None,
           batch_transform=None) -> TrainState:
-    """Run the full distributed training loop per cfg. Returns final state."""
+    """Run the full distributed training loop per cfg (layer-IR backend).
+    Returns final state."""
     log = logger or default_logger(cfg.workdir)
     precision.set_policy(cfg.precision)
     resolve_solver(cfg)
@@ -87,11 +88,26 @@ def train(cfg: RunConfig, spec: NetSpec, train_ds: ArrayDataset,
                               mode=cfg.mode)
     log.log(f"mesh: {n_dev} devices; tau={cfg.tau} mode={cfg.mode} "
             f"local_batch={cfg.local_batch} precision={cfg.precision}")
-
     if batch_transform is None:
         train_ds = _to_device_layout(train_ds, net)
     if test_ds is not None:
         test_ds = _to_device_layout(test_ds, net)
+    return run_loop(cfg, trainer, train_ds, test_ds, log,
+                    batch_transform=batch_transform,
+                    probe=lambda s: probe_value(s, net),
+                    round_hook=round_hook)
+
+
+def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
+             test_ds: Optional[ArrayDataset], log: Logger,
+             batch_transform=None,
+             probe: Optional[Callable[[Any], float]] = None,
+             round_hook=None):
+    """The reference app loop, generic over the trainer backend: any object
+    with init_state/place/train_round/evaluate + n_devices (ParallelTrainer
+    for the layer IR, GraphTrainer for serialized graphs — the same way
+    CaffeSolver and TensorFlowNet sat behind one loop in the reference)."""
+    n_dev = trainer.n_devices
     sampler = RoundSampler(train_ds, n_dev, cfg.local_batch, cfg.tau,
                            seed=cfg.seed)
     log.log(f"train examples: {len(train_ds)} "
@@ -141,8 +157,8 @@ def train(cfg: RunConfig, spec: NetSpec, train_ds: ArrayDataset,
         round_dt = timers.total["train_round"] - before
         n_images = cfg.tau * cfg.local_batch * n_dev
         meter.add(n_images, round_dt)
-        log.log(f"round loss: {loss:.4f}  probe: "
-                f"{probe_value(state, net):.6f}", rnd)
+        probe_txt = f"  probe: {probe(state):.6f}" if probe else ""
+        log.log(f"round loss: {loss:.4f}{probe_txt}", rnd)
         log.metrics(rnd, loss=loss, images_per_sec_per_chip=round(
             meter.images_per_sec_per_chip(), 2))
 
@@ -178,8 +194,8 @@ def _to_device_layout(ds: ArrayDataset, net: CompiledNet) -> ArrayDataset:
     return ArrayDataset(arrays)
 
 
-def _evaluate(trainer: ParallelTrainer, state: TrainState,
-              test_ds: ArrayDataset, eval_batch: int, n_dev: int) -> float:
+def _evaluate(trainer, state, test_ds: ArrayDataset, eval_batch: int,
+              n_dev: int) -> float:
     """Full-coverage distributed eval (reference `CifarApp.scala:107-124`)."""
     eval_batch = min(eval_batch, len(test_ds))
     eval_batch = max(n_dev, (eval_batch // n_dev) * n_dev)

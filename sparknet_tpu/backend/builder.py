@@ -71,9 +71,17 @@ class GraphBuilder:
         return self._add(name, "Accuracy", [logits, labels])
 
     def finalize(self, loss: Optional[str] = None, learning_rate: float = 0.01,
-                 momentum: float = 0.9) -> GraphDef:
+                 momentum: float = 0.9, lr_policy: str = "fixed",
+                 decay_rate: Optional[float] = None,
+                 decay_steps: Optional[float] = None,
+                 staircase: bool = True) -> GraphDef:
         """Inject the update/assign/init/train protocol nodes (the reference
-        generators' final block) and return the GraphDef."""
+        generators' final block) and return the GraphDef.
+
+        lr_policy="exp_decay" declares an in-graph schedule
+        lr(it) = learning_rate * decay_rate^(it/decay_steps) (floored when
+        staircase), matching the reference mnist graph's
+        tf.train.exponential_decay optimizer block."""
         variables = [n.name for n in self.nodes if n.op == "Variable"]
         for v in variables:
             shape = self.nodes[[n.name for n in self.nodes].index(v)].attrs[
@@ -84,16 +92,29 @@ class GraphBuilder:
                       [v, v + UPDATE_SUFFIX])
         self._add(INIT_ALL_VARS, "NoOp", [])
         if loss is not None:
-            self._add(TRAIN_STEP, "Train", [loss],
-                      learning_rate=learning_rate, momentum=momentum)
+            attrs = dict(learning_rate=learning_rate, momentum=momentum,
+                         lr_policy=lr_policy)
+            if lr_policy == "exp_decay":
+                if decay_rate is None or decay_steps is None:
+                    raise ValueError(
+                        "exp_decay needs decay_rate and decay_steps")
+                attrs.update(decay_rate=decay_rate, decay_steps=decay_steps,
+                             staircase=staircase)
+            elif lr_policy != "fixed":
+                raise ValueError(f"unknown lr_policy {lr_policy!r}")
+            self._add(TRAIN_STEP, "Train", [loss], **attrs)
         return GraphDef(name=self.name, nodes=self.nodes)
 
 
 def build_mnist_graph(batch: int = 64, seed: int = 66478,
-                      learning_rate: float = 0.01) -> GraphDef:
+                      learning_rate: float = 0.01,
+                      train_size: int = 60000) -> GraphDef:
     """LeNet-style MNIST convnet graph — mirrors the reference's
     `mnist_graph.py` architecture (conv5x5x32 SAME + pool2, conv5x5x64 SAME +
-    pool2, fc512, fc10; Momentum optimizer)."""
+    pool2, fc512, fc10) and its Momentum optimizer INCLUDING the in-graph
+    exponential_decay(0.01, it*batch, train_size, 0.95, staircase) lr
+    schedule — expressed as Train-node attrs with decay_steps in iteration
+    units (train_size/batch iters per decay = identical lr(it) curve)."""
     r = np.random.default_rng(seed)
     g = GraphBuilder("mnist")
     g.placeholder("data", (batch, 28, 28, 1))
@@ -121,4 +142,6 @@ def build_mnist_graph(batch: int = 64, seed: int = 66478,
     g.softmax("prob", logits)
     g.accuracy("accuracy", logits, "label")
     loss = g.sparse_softmax_ce("loss", logits, "label")
-    return g.finalize(loss=loss, learning_rate=learning_rate, momentum=0.9)
+    return g.finalize(loss=loss, learning_rate=learning_rate, momentum=0.9,
+                      lr_policy="exp_decay", decay_rate=0.95,
+                      decay_steps=train_size / batch, staircase=True)

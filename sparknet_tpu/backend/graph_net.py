@@ -5,19 +5,33 @@ Parity with reference `libs/TensorFlowNet.scala`:
     convention (lines 24-49) — no side metadata;
   - schema-columns-vs-graph-inputs validation (lines 28-31);
   - `forward(batch, fetch_names)` fetches named tensors (73-84);
-  - `step(batch)` runs the in-graph optimizer `train//step` (86-90) —
-    momentum-SGD whose hyperparameters live in the graph node's attrs,
-    like the reference's in-graph MomentumOptimizer;
-  - `get_weights`/`set_weights` via the `//update_placeholder`/`//assign`
-    protocol (95-121), here realized as direct pytree swaps (the protocol is
-    honored at the format level: importers/exporters keep those nodes).
+  - `step(batch)` runs the in-graph optimizer (86-90): hyperparameters —
+    including lr *schedules* like the reference mnist graph's
+    tf.train.exponential_decay — live inside the graph and are honored here
+    by evaluating the graph's own lr subgraph each step;
+  - `get_weights` fetches every FLOAT variable (95-108) — for an imported TF
+    graph that includes the `<var>/Momentum` slot variables, exactly as the
+    reference's averaging loop did (`apps/MnistApp.scala:135-136`); non-float
+    variables (the global-step counter) are skipped like the reference's
+    DT_FLOAT filter;
+  - `set_weights` assigns exactly the variables named in the collection via
+    the `//update_placeholder`/`//assign` protocol semantics (110-121) and
+    touches NOTHING else — in particular it never resets optimizer slots:
+    in the reference only assign ops run; momentum accumulators persist.
 
 Execution: the graph is topologically interpreted into a pure JAX function
-and jitted once per fetch-set; variables live as a flat {name: array} pytree.
+and jitted once per fetch-set. Training state is an explicit pytree
+  {"variables": {name: array}, "slots": {name: array}, "it": int32}
+so the same pure step function drives both the single-device `step()` API
+and the distributed τ-averaging trainer (`parallel/graph_trainer.py`).
+For imported TF graphs `slots` is empty — momentum accumulators ARE graph
+variables (`<var>/Momentum`); for native `Train`-protocol graphs the slots
+pytree holds them (they are not part of the weight exchange, Caffe-style).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +41,33 @@ from ..model.weights import WeightCollection
 from ..schema import Field, Schema
 from .graphdef import (ASSIGN_SUFFIX, GraphDef, INIT_ALL_VARS, NodeDef, OPS,
                        TRAIN_STEP, UPDATE_SUFFIX)
+
+PyTree = Any
+
+
+@dataclass
+class GraphOptimizer:
+    """Introspected in-graph optimizer description.
+
+    For imported TF graphs this mirrors the ApplyMomentum nodes: `slot_of`
+    names the `<var>/Momentum` accumulator VARIABLE per trainable var, and
+    `counter` is the global-step variable bumped by `train//step`
+    (TF::AssignAdd). For native `Train`-protocol graphs the accumulators
+    live in the train state's `slots` dict and `counter` is None (the state
+    carries `it` instead).
+
+    Update rule is TF MomentumOptimizer semantics (the engine the reference
+    embedded): accum' = momentum·accum + grad; var' = var − lr·accum'.
+    """
+
+    trainable: List[str]
+    slot_of: Dict[str, str] = field(default_factory=dict)
+    momentum: float = 0.0
+    counter: Optional[str] = None
+    counter_inc: int = 1
+    # lr_fn(variables, it) -> scalar; evaluates the graph's own lr subgraph
+    # for imported graphs, or the Train node's declared policy for native.
+    lr_fn: Callable[[Dict[str, jnp.ndarray], jnp.ndarray], jnp.ndarray] = None
 
 
 class GraphNet:
@@ -61,16 +102,40 @@ class GraphNet:
         for v in self.variable_names:
             node = self._nodes[v]
             init = node.attrs.get("init")
+            if init is None:
+                init = self._resolve_initializer(v)
             if init is not None:
                 self.variables[v] = jnp.asarray(init)
             else:
                 shape = tuple(node.attrs["shape"])
-                std = float(node.attrs.get("stddev", 0.1))
-                key, sub = jax.random.split(key)
-                self.variables[v] = std * jax.random.normal(sub, shape)
+                dtype = str(node.attrs.get("dtype", "float32"))
+                if dtype.startswith(("int", "uint")):
+                    self.variables[v] = jnp.zeros(shape, dtype)
+                else:
+                    std = float(node.attrs.get("stddev", 0.1))
+                    key, sub = jax.random.split(key)
+                    self.variables[v] = std * jax.random.normal(sub, shape)
         self._fetch_cache: Dict[Tuple[str, ...], callable] = {}
         self._step_fn = None
         self._step_loss: Optional[str] = None
+        self._slots: Optional[Dict[str, jnp.ndarray]] = None
+        self._it = jnp.zeros((), jnp.int32)
+
+    def _resolve_initializer(self, v: str) -> Optional[np.ndarray]:
+        """Imported graphs carry initial values as `<var>/Assign <- const`
+        subgraphs (tf.zeros / tf.constant); evaluate those eagerly. Random
+        initializers (TruncatedNormal) are opaque -> None (fallback rng)."""
+        asg = self._nodes.get(v + "/Assign")
+        if asg is None or len(asg.inputs) != 2:
+            return None
+        try:
+            val = np.asarray(self._eval({}, {}, (asg.inputs[1],))[0])
+        except Exception:
+            return None
+        shape = tuple(self._nodes[v].attrs.get("shape", ()))
+        if shape and val.shape != shape:
+            return None
+        return val
 
     # -- execution core ------------------------------------------------------
 
@@ -118,6 +183,163 @@ class GraphNet:
             values[n.name] = impl(n, ins)
         return tuple(values[f] for f in fetches)
 
+    # -- optimizer introspection --------------------------------------------
+
+    def resolve_loss(self, loss_name: Optional[str] = None) -> str:
+        """The node to differentiate. Explicit name wins; a native `Train`
+        node declares its loss input; otherwise fall back to the `loss`
+        naming convention both reference graph generators used
+        (`models/tensorflow/{mnist,alexnet}/*_graph.py`: `name="loss"`)."""
+        if loss_name is not None:
+            if loss_name not in self._nodes:
+                raise ValueError(f"loss node {loss_name!r} not in graph")
+            return loss_name
+        if self._train_node is not None and self._train_node.op == "Train":
+            return self._train_node.inputs[0]
+        if "loss" in self._nodes:
+            return "loss"
+        raise ValueError(
+            f"graph has no native {TRAIN_STEP!r} Train node and no node "
+            f"named 'loss' — pass loss_name= to train it")
+
+    def _float_variables(self) -> List[str]:
+        return [v for v in self.variable_names
+                if jnp.issubdtype(self.variables[v].dtype, jnp.floating)]
+
+    def discover_optimizer(self, loss_name: Optional[str] = None
+                           ) -> GraphOptimizer:
+        loss = self.resolve_loss(loss_name)
+        apply_nodes = [n for n in self.graph.nodes
+                       if n.op in ("TF::ApplyMomentum",
+                                   "TF::ApplyGradientDescent")]
+        if apply_nodes:
+            return self._discover_imported(apply_nodes)
+        if self._train_node is not None and self._train_node.op == "Train":
+            return self._discover_native(loss)
+        raise ValueError(
+            "graph has neither a Train protocol node nor imported "
+            "Apply{Momentum,GradientDescent} nodes — cannot infer an "
+            "optimizer; supported graphs carry one in-graph "
+            "(TensorFlowNet parity: the optimizer lives in the graph)")
+
+    def _discover_imported(self, apply_nodes) -> GraphOptimizer:
+        trainable, slot_of = [], {}
+        lr_nodes = set()
+        momentum_nodes = set()
+        for n in apply_nodes:
+            if n.op == "TF::ApplyMomentum":
+                var, slot, lr, _grad, mom = n.inputs[:5]
+                slot_of[var] = slot
+                momentum_nodes.add(mom)
+            else:  # ApplyGradientDescent: var, alpha, delta
+                var, lr = n.inputs[0], n.inputs[1]
+            trainable.append(var)
+            lr_nodes.add(lr)
+        if len(lr_nodes) != 1:
+            raise ValueError(f"multiple lr subgraphs {sorted(lr_nodes)} — "
+                             f"unsupported")
+        lr_node = next(iter(lr_nodes))
+        momentum = 0.0
+        if momentum_nodes:
+            if len(momentum_nodes) != 1:
+                raise ValueError("per-variable momentum values unsupported")
+            momentum = float(np.asarray(
+                self._eval({}, {}, (next(iter(momentum_nodes)),))[0]))
+        counter, counter_inc = None, 1
+        if self._train_node is not None and \
+                self._train_node.op == "TF::AssignAdd":
+            counter = self._train_node.inputs[0]
+            try:
+                counter_inc = int(np.asarray(self._eval(
+                    {}, {}, (self._train_node.inputs[1],))[0]))
+            except Exception:
+                counter_inc = 1
+
+        def lr_fn(variables, it):
+            return self._eval(variables, {}, (lr_node,))[0]
+
+        return GraphOptimizer(trainable=trainable, slot_of=slot_of,
+                              momentum=momentum, counter=counter,
+                              counter_inc=counter_inc, lr_fn=lr_fn)
+
+    def _discover_native(self, loss: str) -> GraphOptimizer:
+        attrs = self._train_node.attrs
+        base_lr = float(attrs.get("learning_rate", 0.01))
+        momentum = float(attrs.get("momentum", 0.9))
+        policy = str(attrs.get("lr_policy", "fixed"))
+        if policy == "fixed":
+            def lr_fn(variables, it):
+                return jnp.asarray(base_lr, jnp.float32)
+        elif policy == "exp_decay":
+            decay_rate = float(attrs["decay_rate"])
+            decay_steps = float(attrs["decay_steps"])
+            staircase = bool(attrs.get("staircase", True))
+
+            def lr_fn(variables, it):
+                p = it.astype(jnp.float32) / decay_steps
+                if staircase:
+                    p = jnp.floor(p)
+                return base_lr * decay_rate ** p
+        else:
+            raise ValueError(f"unknown Train lr_policy {policy!r} "
+                             f"(expected 'fixed' or 'exp_decay')")
+        return GraphOptimizer(trainable=self._float_variables(),
+                              momentum=momentum, lr_fn=lr_fn)
+
+    # -- pure training step --------------------------------------------------
+
+    def init_train_state(self, loss_name: Optional[str] = None) -> PyTree:
+        """{"variables", "slots", "it"} pytree seeded from current variables.
+        Slots start at zero for native graphs; imported graphs keep their
+        accumulators inside `variables` (they ARE `<var>/Momentum` vars)."""
+        opt = self.discover_optimizer(loss_name)
+        slots = {v: jnp.zeros_like(self.variables[v])
+                 for v in opt.trainable if v not in opt.slot_of}
+        return {"variables": dict(self.variables), "slots": slots,
+                "it": jnp.zeros((), jnp.int32)}
+
+    def make_train_step(self, loss_name: Optional[str] = None
+                        ) -> Callable[[PyTree, Dict], Tuple[PyTree, Any]]:
+        """Pure (state, batch) -> (state, loss): ONE optimizer application,
+        exactly what one reference `session.Run([train//step])` did. Safe to
+        jit / scan / shard_map — used by both `step()` and the distributed
+        trainer."""
+        loss_name = self.resolve_loss(loss_name)
+        opt = self.discover_optimizer(loss_name)
+
+        def step_fn(state, batch):
+            variables, slots, it = (state["variables"], state["slots"],
+                                    state["it"])
+            lr = opt.lr_fn(variables, it)
+            train_vars = {v: variables[v] for v in opt.trainable}
+
+            def loss_of(tv):
+                merged = dict(variables)
+                merged.update(tv)
+                return self._eval(merged, batch, (loss_name,))[0]
+
+            loss, grads = jax.value_and_grad(loss_of)(train_vars)
+            new_vars = dict(variables)
+            new_slots = dict(slots)
+            for v in opt.trainable:
+                g = grads[v]
+                slot_var = opt.slot_of.get(v)
+                accum = (variables[slot_var] if slot_var is not None
+                         else slots[v])  # per-var: mixed Apply* graphs OK
+                accum = opt.momentum * accum + g
+                if slot_var is not None:
+                    new_vars[slot_var] = accum
+                else:
+                    new_slots[v] = accum
+                new_vars[v] = variables[v] - lr * accum
+            if opt.counter is not None:
+                new_vars[opt.counter] = (
+                    variables[opt.counter] + opt.counter_inc)
+            return ({"variables": new_vars, "slots": new_slots,
+                     "it": it + 1}, loss)
+
+        return step_fn
+
     # -- NetInterface --------------------------------------------------------
 
     def forward(self, batch: Dict[str, np.ndarray],
@@ -133,63 +355,58 @@ class GraphNet:
 
     def step(self, batch: Dict[str, np.ndarray],
              loss_name: Optional[str] = None) -> float:
-        """Run the in-graph optimizer once (reference `step`, 86-90).
-
-        Native graphs carry a `Train` node whose input is the loss. Imported
-        TF graphs keep their original train//step (an opaque counter-bump
-        op) — for those, pass `loss_name` explicitly; autodiff does the rest.
-        """
-        if loss_name is None:
-            if self._train_node is None:
-                raise ValueError(f"graph has no {TRAIN_STEP!r} node; pass "
-                                 f"loss_name= to train an imported graph")
-            if self._train_node.op != "Train":
-                raise ValueError(
-                    f"{TRAIN_STEP!r} node has op {self._train_node.op!r} "
-                    f"(an imported optimizer subgraph, not our Train "
-                    f"protocol) — pass loss_name= explicitly, e.g. "
-                    f"step(batch, loss_name='loss')")
-            loss_name = self._train_node.inputs[0]
-        attrs = self._train_node.attrs if (
-            self._train_node is not None and self._train_node.op == "Train"
-        ) else {}
-        lr = float(attrs.get("learning_rate", 0.01))
-        momentum = float(attrs.get("momentum", 0.9))
-        if self._step_fn is not None and self._step_loss != loss_name:
+        """Run the in-graph optimizer once (reference `step`, 86-90),
+        honoring the graph's own hyperparameters and lr schedule."""
+        key = self.resolve_loss(loss_name)
+        if self._step_fn is not None and self._step_loss != key:
             self._step_fn = None
         if self._step_fn is None:
-            self._step_loss = loss_name
-
-            def one_step(variables, velocity, b):
-                loss, grads = jax.value_and_grad(
-                    lambda v: self._eval(v, b, (loss_name,))[0])(variables)
-                new_vel = jax.tree.map(
-                    lambda vel, g: momentum * vel + lr * g, velocity, grads)
-                new_vars = jax.tree.map(lambda v, nv: v - nv, variables,
-                                        new_vel)
-                return new_vars, new_vel, loss
-            self._step_fn = jax.jit(one_step, donate_argnums=(0, 1))
-            self._velocity = jax.tree.map(jnp.zeros_like, self.variables)
-        self.variables, self._velocity, loss = self._step_fn(
-            self.variables, self._velocity, self._prep(batch))
+            self._step_loss = key
+            self._step_fn = jax.jit(self.make_train_step(key),
+                                    donate_argnums=(0,))
+            if self._slots is None:
+                self._slots = self.init_train_state(key)["slots"]
+        state = {"variables": dict(self.variables), "slots": self._slots,
+                 "it": self._it}
+        state, loss = self._step_fn(state, self._prep(batch))
+        self.variables = dict(state["variables"])
+        self._slots = state["slots"]
+        self._it = state["it"]
         return float(loss)
 
+    def train_state(self, loss_name: Optional[str] = None) -> PyTree:
+        """Current state as the pure-step pytree (for external trainers)."""
+        if self._slots is None:
+            return self.init_train_state(loss_name)
+        return {"variables": dict(self.variables), "slots": self._slots,
+                "it": self._it}
+
+    def load_train_state(self, state: PyTree) -> None:
+        self.variables = dict(state["variables"])
+        self._slots = dict(state["slots"])
+        self._it = state["it"]
+
     def get_weights(self) -> WeightCollection:
+        """Every float variable — including, for imported TF graphs, the
+        `<var>/Momentum` slots (reference getWeights DT_FLOAT filter,
+        TensorFlowNet.scala:95-108: slots are plain float Variables and DID
+        cross the wire; the int global-step counter did not)."""
+        names = self._float_variables()
         return WeightCollection(
-            {v: [np.asarray(self.variables[v])] for v in self.variable_names},
-            list(self.variable_names))
+            {v: [np.asarray(self.variables[v])] for v in names}, names)
 
     def set_weights(self, weights: WeightCollection) -> None:
-        """Honors the //assign protocol semantics: every variable swapped,
-        shapes asserted (reference 110-121)."""
-        for v in self.variable_names:
-            assert v in weights, f"weights missing variable {v!r}"
+        """Assign exactly the named variables (reference setWeights runs one
+        `//assign` per key, 110-121). Optimizer slots that are NOT in the
+        collection — native-graph velocity, or imported slots the caller
+        chose to exclude — keep their values: nothing is reset."""
+        for v in weights.layer_names:
+            if v not in self.variables:
+                raise KeyError(f"graph has no variable {v!r}")
             arr = weights[v][0]
             assert arr.shape == tuple(self.variables[v].shape), (
                 f"{v}: {arr.shape} != {tuple(self.variables[v].shape)}")
             self.variables[v] = jnp.asarray(arr)
-        self._velocity = None
-        self._step_fn = None  # re-init momentum against new weights
 
     def output_names(self) -> List[str]:
         """Terminal nodes that are actually evaluable: excludes protocol

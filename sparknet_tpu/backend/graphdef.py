@@ -202,4 +202,15 @@ OPS: Dict[str, Callable[[NodeDef, Sequence[jnp.ndarray]], jnp.ndarray]] = {
         ins[0], axis=int(n.attrs.get("axis", -1))).astype(jnp.int32),
     "Equal": lambda n, ins: ins[0] == ins[1].astype(ins[0].dtype),
     "Cast": lambda n, ins: ins[0].astype(n.attrs.get("dtype", "float32")),
+    # scalar/elementwise math — enough to evaluate in-graph optimizer
+    # hyperparameter subgraphs (e.g. the reference mnist graph's
+    # tf.train.exponential_decay: Cast/Div/Floor/Pow/Mul chain)
+    "Div": lambda n, ins: ins[0] / ins[1],
+    "Floor": lambda n, ins: jnp.floor(ins[0]),
+    "Pow": lambda n, ins: jnp.power(ins[0], ins[1]),
+    "Maximum": lambda n, ins: jnp.maximum(ins[0], ins[1]),
+    "Minimum": lambda n, ins: jnp.minimum(ins[0], ins[1]),
+    "Neg": lambda n, ins: -ins[0],
+    "Exp": lambda n, ins: jnp.exp(ins[0]),
+    "Sqrt": lambda n, ins: jnp.sqrt(ins[0]),
 }

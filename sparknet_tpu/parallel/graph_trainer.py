@@ -1,0 +1,164 @@
+"""Distributed τ-averaging for the serialized-graph backend.
+
+This closes the loop the reference proved with its SECOND backend: TF nets
+trained *inside* the distributed averaging loop (`apps/MnistApp.scala:98-138`
+— per-worker `TensorFlowNet.step` τ times, then `TensorFlowWeightCollection`
+averaging). Here the same thing is one XLA program per round, built from
+`GraphNet.make_train_step` (the pure in-graph-optimizer step) scanned τ times
+inside shard_map, with the averaging as an on-mesh collective.
+
+Averaging semantics — exactly what the reference's weight exchange did:
+  - FLOAT variables are pmean'd across workers. For an imported TF graph
+    that includes the `<var>/Momentum` slot variables (reference getWeights
+    fetched every DT_FLOAT Variable, `TensorFlowNet.scala:95-108`, and
+    MnistApp averaged all of them, `MnistApp.scala:135-136`).
+  - INT variables (the global-step counter) stay local — the reference's
+    DT_FLOAT filter excluded them from the wire. They are replica-identical
+    anyway (same τ increments everywhere).
+  - `slots` (native-graph velocity) stays worker-local and is NEVER reset —
+    only variables cross the "wire", Caffe-style (SURVEY §7 hard-part #2).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..backend.graph_net import GraphNet
+from .mesh import DATA_AXIS
+
+PyTree = Any
+
+
+class GraphTrainer:
+    """τ-local-step parameter-averaging trainer over a 1-D (data,) mesh for
+    a GraphNet (serialized/imported graph with in-graph optimizer).
+
+    State layout matches ParallelTrainer: every leaf carries a leading
+    [n_devices] axis sharded over the data axis — each device holds its own
+    (possibly diverged-during-τ) replica; after a round the float variables
+    are numerically identical again.
+    """
+
+    def __init__(self, net: GraphNet, mesh: Mesh, tau: int = 10,
+                 loss_name: Optional[str] = None,
+                 acc_name: Optional[str] = "accuracy"):
+        self.net = net
+        self.mesh = mesh
+        self.tau = tau
+        self.loss_name = net.resolve_loss(loss_name)
+        self.acc_name = acc_name
+        self.n_devices = int(np.prod(mesh.devices.shape))
+        self._step = net.make_train_step(self.loss_name)
+
+        dev = P(DATA_AXIS)
+        batch_spec = P(None, DATA_AXIS)  # [tau, global_batch, ...]
+        self._round = jax.jit(
+            shard_map(self._round_impl, mesh=mesh,
+                      in_specs=(dev, batch_spec),
+                      out_specs=(dev, P())),
+            donate_argnums=(0,))
+        self._eval = jax.jit(
+            shard_map(self._eval_impl, mesh=mesh,
+                      in_specs=(dev, P(DATA_AXIS)),
+                      out_specs=P()))
+
+    # -- state ---------------------------------------------------------------
+
+    def init_state(self, key=None) -> PyTree:
+        """Tile the net's current train state across devices (the reference
+        seeds all workers identically from worker-0, MnistApp.scala:88).
+        `key` is accepted for trainer-interface parity and ignored: graph
+        variable initializers are seeded at GraphNet construction."""
+        state = self.net.init_train_state(self.loss_name)
+
+        def tile(x):
+            x = jnp.asarray(x)
+            return jnp.broadcast_to(x[None], (self.n_devices,) + x.shape)
+
+        return self.place(jax.tree.map(tile, state))
+
+    def place(self, state: PyTree) -> PyTree:
+        return jax.device_put(state, NamedSharding(self.mesh, P(DATA_AXIS)))
+
+    def averaged_state(self, state: PyTree) -> PyTree:
+        """Single-replica view (device 0's copy) for checkpoint/export."""
+        return jax.tree.map(lambda x: x[0], state)
+
+    def load_into_net(self, state: PyTree) -> None:
+        self.net.load_train_state(self.averaged_state(state))
+
+    # -- round (runs INSIDE shard_map) ---------------------------------------
+
+    def _round_impl(self, state, batches):
+        local = jax.tree.map(lambda x: x[0], state)
+
+        def local_step(carry, batch):
+            carry, loss = self._step(carry, batch)
+            return carry, loss
+
+        local, losses = lax.scan(local_step, local, batches)
+
+        # THE sync: float variables pmean'd, ints + slots stay local.
+        def avg(x):
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                return lax.pmean(x, DATA_AXIS)
+            return x
+
+        local["variables"] = {k: avg(v)
+                              for k, v in local["variables"].items()}
+        mean_loss = lax.pmean(jnp.mean(losses), DATA_AXIS)
+        return jax.tree.map(lambda x: x[None], local), mean_loss
+
+    def _eval_impl(self, state, batch):
+        variables = jax.tree.map(lambda x: x[0], state["variables"])
+        (acc,) = self.net._eval(variables, batch, (self.acc_name,))
+        n = jnp.asarray(next(iter(batch.values())).shape[0], jnp.float32)
+        return lax.psum(acc * n, DATA_AXIS) / lax.psum(n, DATA_AXIS)
+
+    # -- public API ----------------------------------------------------------
+
+    def train_round(self, state: PyTree, batches: Dict[str, np.ndarray],
+                    rng=None) -> Tuple[PyTree, float]:
+        """One outer round: τ in-graph-optimizer steps per device, then the
+        averaging collective. batches[input]: [tau, global_batch, ...].
+        `rng` is accepted for trainer-interface parity and ignored (graph
+        execution is deterministic; dropout-free eval semantics)."""
+        new_state, loss = self._round(state, self._shard_batches(batches))
+        return new_state, float(loss)
+
+    def evaluate(self, state: PyTree, batch: Dict[str, np.ndarray]) -> float:
+        sharded = {
+            k: jax.device_put(jnp.asarray(v),
+                              NamedSharding(self.mesh, P(DATA_AXIS)))
+            for k, v in self._cast(batch).items()}
+        return float(self._eval(state, sharded))
+
+    def _cast(self, batch):
+        """Host-side dtype casts per the graph's placeholder attrs (the
+        layout/NCHW handling of GraphNet._prep is for single batches; the
+        trainer requires device layout (NHWC) already)."""
+        out = {}
+        for iname in self.net.input_names:
+            if iname not in batch:
+                raise ValueError(f"batch missing graph input {iname!r}")
+            dt = self.net._nodes[iname].attrs.get("dtype", "float32")
+            out[iname] = np.asarray(batch[iname]).astype(dt, copy=False)
+        return out
+
+    def _shard_batches(self, batches):
+        out = {}
+        for k, v in self._cast(batches).items():
+            assert v.shape[0] == self.tau, (
+                f"{k}: leading dim {v.shape[0]} != tau {self.tau}")
+            assert v.shape[1] % self.n_devices == 0, (
+                f"{k}: global batch {v.shape[1]} not divisible by "
+                f"{self.n_devices} devices")
+            out[k] = jax.device_put(
+                jnp.asarray(v), NamedSharding(self.mesh, P(None, DATA_AXIS)))
+        return out

@@ -39,8 +39,19 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(arr, axis_names=tuple(axis_names))
 
 
-_MULTIHOST_ENV_HINTS = ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
-                        "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES")
+_COORDINATOR_ENV_HINTS = ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+                          "MEGASCALE_COORDINATOR_ADDRESS")
+
+
+def _multihost_configured() -> bool:
+    """True only when the environment describes a >1-host world: an explicit
+    coordinator address, or a TPU hostname list with MULTIPLE entries
+    (single-host TPU VMs set TPU_WORKER_HOSTNAMES=localhost — that is a
+    1-host world and must not trigger distributed init)."""
+    if any(os.environ.get(k) for k in _COORDINATOR_ENV_HINTS):
+        return True
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hosts.split(",") if h.strip()]) > 1
 
 
 def initialize_multihost(coordinator: Optional[str] = None,
@@ -58,7 +69,7 @@ def initialize_multihost(coordinator: Optional[str] = None,
     if os.environ.get("SPARKNET_TPU_DIST_INIT"):
         return True
     explicit = coordinator is not None
-    configured = explicit or any(os.environ.get(k) for k in _MULTIHOST_ENV_HINTS)
+    configured = explicit or _multihost_configured()
     if not configured:
         return False  # single-process (tests, single TPU VM)
     kwargs = {}

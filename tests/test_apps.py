@@ -119,3 +119,31 @@ def test_checkpoint_retention(tmp_path):
     checkpoint.retain(str(tmp_path / "ck"), keep=2)
     assert checkpoint.latest_step(str(tmp_path / "ck")) == 4
     assert sorted(os.listdir(tmp_path / "ck")) == ["step-3", "step-4"]
+
+
+def test_graph_mnist_app_loop(tmp_path):
+    """MnistApp pairing: serialized-graph backend inside the distributed
+    τ-round (the reference's apps/MnistApp.scala shape), incl. checkpoint
+    round-trip of the graph train state."""
+    from sparknet_tpu.apps.graph_mnist_app import _nhwc, train_graph
+    from sparknet_tpu.backend import build_mnist_graph
+    d = str(tmp_path / "gm")
+    mnist.write_synthetic(d, n_train=256, n_test=64)
+    loader = mnist.MnistLoader(d)
+    train_ds = ArrayDataset(_nhwc(loader.train_batch_dict()))
+    test_ds = ArrayDataset(_nhwc(loader.test_batch_dict()))
+    cfg = RunConfig(tau=2, local_batch=4, eval_every=2, eval_batch=32,
+                    max_rounds=4, workdir=str(tmp_path), seed=0,
+                    checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2)
+    log_path = str(tmp_path / "glog.txt")
+    graph = build_mnist_graph(batch=cfg.local_batch, train_size=256)
+    state = train_graph(cfg, graph, train_ds, test_ds,
+                        logger=Logger(log_path, echo=False))
+    text = open(log_path).read()
+    assert "test accuracy" in text and "round loss" in text
+    assert ckpt.latest_step(str(tmp_path / "ck")) == 4
+    # resume path restores into the same structure
+    restored, step, _ = ckpt.restore(str(tmp_path / "ck"), state)
+    assert step == 4
+    np.testing.assert_array_equal(
+        np.asarray(state["it"]), np.asarray(restored["it"]))

@@ -117,20 +117,43 @@ def test_imported_graph_default_fetches_work():
 
 
 @needs_pb
-def test_step_on_imported_graph_requires_loss_name():
-    """The pb's train//step is an opaque counter bump — step() must refuse
-    rather than silently training nothing (regression)."""
+def test_step_on_imported_graph_uses_in_graph_optimizer():
+    """step() trains the imported graph through its OWN optimizer subgraph:
+    ApplyMomentum hyperparameters, the ExponentialDecay lr schedule, and the
+    train//step counter bump all come from the graph (reference: the
+    optimizer lives inside the TF graph, `TensorFlowNet.scala:86-90`)."""
     net = GraphNet(import_tf_graphdef_file(MNIST_PB))
+    opt = net.discover_optimizer()
+    assert len(opt.trainable) == 8
+    assert opt.momentum == pytest.approx(0.9)
+    assert opt.counter == "Variable_7" and opt.counter_inc == 1
+    # lr schedule = tf.train.exponential_decay(0.01, it*64, 60000, 0.95,
+    # staircase=True), evaluated from the graph's own subgraph
+    import jax.numpy as jnp
+    variables = dict(net.variables)
+    assert float(opt.lr_fn(variables, None)) == pytest.approx(0.01)
+    variables["Variable_7"] = jnp.asarray(60000 // 64 + 1, jnp.int32)
+    assert float(opt.lr_fn(variables, None)) == pytest.approx(0.01 * 0.95)
+
     r = np.random.default_rng(0)
-    for v in net.variable_names:
-        net.variables[v] = 0.05 * r.standard_normal(
-            tuple(net.variables[v].shape)).astype(np.float32)
     batch = {"data": r.standard_normal((8, 28, 28, 1)).astype(np.float32),
              "label": r.integers(0, 10, (8,)).astype(np.int64)}
-    with pytest.raises(ValueError, match="loss_name"):
-        net.step(batch)
-    losses = [net.step(batch, loss_name="loss") for _ in range(5)]
-    assert losses[-1] < losses[0]  # real weights actually move
+    losses = [net.step(batch) for _ in range(5)]  # no loss_name needed:
+    assert losses[-1] < losses[0]                 # 'loss' convention node
+    assert int(net.variables["Variable_7"]) == 5  # counter bumped per step
+    # momentum slots accumulated INSIDE variables (they are graph variables)
+    assert float(jnp.abs(net.variables["conv1/Momentum"]).sum()) > 0
+
+
+def test_step_refuses_graph_without_optimizer_or_loss():
+    from sparknet_tpu.backend import GraphBuilder
+    g = GraphBuilder("noopt")
+    g.placeholder("x", (2, 3))
+    g.variable("w", np.ones((3, 2), np.float32))
+    g.matmul("y", "x", "w")
+    net = GraphNet(g.finalize())  # no loss -> no Train node
+    with pytest.raises(ValueError, match="loss"):
+        net.step({"x": np.zeros((2, 3), np.float32)})
 
 
 def test_maxpool_same_nonsquare():
