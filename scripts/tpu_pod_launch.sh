@@ -2,32 +2,56 @@
 # TPU pod bring-up + launcher — the reference's `spark-ec2`/`spark-submit`
 # analogue (reference ec2/spark_ec2.py + README.md:13-37), on gcloud TPU VMs.
 #
-#   scripts/tpu_pod_launch.sh create  NAME ZONE TYPE   # e.g. v5e-32
-#   scripts/tpu_pod_launch.sh setup   NAME ZONE        # rsync repo + deps
+#   scripts/tpu_pod_launch.sh create  NAME ZONE TYPE     # e.g. v5e-32
+#   scripts/tpu_pod_launch.sh setup   NAME ZONE          # rsync repo + deps
 #   scripts/tpu_pod_launch.sh run     NAME ZONE "python -m sparknet_tpu.apps.imagenet_app ..."
+#   scripts/tpu_pod_launch.sh status  NAME ZONE          # VM state
 #   scripts/tpu_pod_launch.sh delete  NAME ZONE
 #
-# `run` executes the SAME command on every worker (single-program multi-host:
-# jax.distributed.initialize autodetects the pod topology; host-sharded data
-# via sparknet_tpu.data.imagenet.host_shards keyed on jax.process_index()).
-set -e
-CMD="$1"; NAME="$2"; ZONE="$3"; ARG="$4"
+# Environment knobs:
+#   TPU_SW_VERSION   runtime image (default v2-alpha-tpuv5-lite; e.g.
+#                    tpu-ubuntu2204-base for v4, v2-alpha-tpuv6e for v6e)
+#
+# Multi-host run path: `run` executes the SAME command on every worker
+# (single-program multi-host). Inside the app:
+#   1. initialize_multihost() autodetects the pod topology
+#      (jax.distributed.initialize; no coordinator flags needed on TPU VMs);
+#   2. each host loads DISJOINT data — tar-sharded datasets take shards
+#      i::k via sparknet_tpu.data.imagenet.host_shards keyed on
+#      jax.process_index()/process_count(); in-memory datasets are sliced
+#      with ArrayDataset.host_shard(process_index, process_count);
+#   3. checkpoints are allgathered and written by process 0 — point
+#      checkpoint_dir at storage all hosts can read (GCS fuse / NFS) so
+#      resume works.
+# A failed `run` on any worker propagates a non-zero exit (no silent
+# per-host divergence).
+set -eu
+CMD="${1:?usage: $0 {create|setup|run|status|delete} NAME ZONE [TYPE|COMMAND]}"
+NAME="${2:?missing NAME}"; ZONE="${3:?missing ZONE}"; ARG="${4:-}"
 TPU="gcloud compute tpus tpu-vm"
+TPU_SW_VERSION="${TPU_SW_VERSION:-v2-alpha-tpuv5-lite}"
 
 case "$CMD" in
   create)
+    [ -n "$ARG" ] || { echo "create needs an accelerator TYPE" >&2; exit 1; }
     $TPU create "$NAME" --zone "$ZONE" --accelerator-type "$ARG" \
-      --version v2-alpha-tpuv5-lite ;;
+      --version "$TPU_SW_VERSION" ;;
   setup)
+    # jax[tpu] is the only runtime dep; native/build.sh failure is fatal by
+    # default (the C++ data plane matters at ImageNet scale) — export
+    # ALLOW_NO_NATIVE=1 to continue with the PIL fallback.
     $TPU scp --recurse --worker=all --zone "$ZONE" . "$NAME":~/sparknet_tpu_repo
     $TPU ssh "$NAME" --worker=all --zone "$ZONE" --command \
-      "cd ~/sparknet_tpu_repo && pip install -q jax[tpu] flax optax && sh native/build.sh || true" ;;
+      "cd ~/sparknet_tpu_repo && pip install -q 'jax[tpu]' && pip install -q -e . && (sh native/build.sh || [ -n '${ALLOW_NO_NATIVE:-}' ])" ;;
   run)
+    [ -n "$ARG" ] || { echo "run needs a COMMAND" >&2; exit 1; }
     $TPU ssh "$NAME" --worker=all --zone "$ZONE" --command \
       "cd ~/sparknet_tpu_repo && $ARG" ;;
+  status)
+    $TPU describe "$NAME" --zone "$ZONE" --format='value(state)' ;;
   delete)
     $TPU delete "$NAME" --zone "$ZONE" --quiet ;;
   *)
-    echo "usage: $0 {create|setup|run|delete} NAME ZONE [TYPE|COMMAND]" >&2
+    echo "usage: $0 {create|setup|run|status|delete} NAME ZONE [TYPE|COMMAND]" >&2
     exit 1 ;;
 esac

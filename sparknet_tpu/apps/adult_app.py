@@ -7,6 +7,8 @@ import argparse
 
 from ..data.adult import AdultLoader
 from ..data.dataset import ArrayDataset
+from ..parallel import initialize_multihost
+from ..parallel.mesh import host_id_count
 from ..model.spec import (Filler, InnerProductParam, InputSpec, LayerSpec,
                           NetSpec)
 from ..solver import SolverConfig
@@ -34,6 +36,7 @@ def main(argv=None) -> None:
     p.add_argument("--data", required=True, help="adult.data CSV path")
     p.add_argument("overrides", nargs="*")
     args = p.parse_args(argv)
+    initialize_multihost()  # BEFORE any other JAX use (mesh.py:49)
     cfg = RunConfig(
         model="adult",
         solver=SolverConfig(base_lr=0.01, momentum=0.9, lr_policy="fixed"),
@@ -46,6 +49,8 @@ def main(argv=None) -> None:
     split = max(1, int(n * 0.8))
     train_ds = ArrayDataset({k: v[:split] for k, v in full.items()})
     test_ds = ArrayDataset({k: v[split:] for k, v in full.items()})
+    pi, pc = host_id_count()
+    train_ds, test_ds = train_ds.host_shard(pi, pc), test_ds.host_shard(pi, pc)
     n_features = loader.features.shape[1]
     train(cfg, adult_net(cfg.local_batch, n_features), train_ds, test_ds)
 

@@ -14,6 +14,8 @@ import argparse
 
 from ..data.cifar import CifarLoader
 from ..data.dataset import ArrayDataset
+from ..parallel import initialize_multihost
+from ..parallel.mesh import host_id_count
 from ..solver import SolverConfig
 from ..utils.config import RunConfig
 from .train_loop import resolve_spec, train
@@ -40,12 +42,17 @@ def main(argv=None) -> None:
     p.add_argument("--data-dir", default=None)
     p.add_argument("overrides", nargs="*", help="key=value config overrides")
     args = p.parse_args(argv)
+    initialize_multihost()  # BEFORE any other JAX use (mesh.py:49)
     cfg = (RunConfig.from_json(args.config) if args.config
            else default_config())
     if args.data_dir:
         cfg.data_dir = args.data_dir
     cfg = cfg.with_overrides(*args.overrides)
     train_ds, test_ds = build_datasets(cfg)
+    # every host loads identically, then keeps its disjoint slice
+    # (the reference's repartition + per-executor cache)
+    pi, pc = host_id_count()
+    train_ds, test_ds = train_ds.host_shard(pi, pc), test_ds.host_shard(pi, pc)
     spec = resolve_spec(cfg, data=(cfg.local_batch, 3, 32, 32),
                         label=(cfg.local_batch, 1))
     train(cfg, spec, train_ds, test_ds)

@@ -22,6 +22,7 @@ from ..backend.tf_import import import_tf_graphdef_file
 from ..data.dataset import ArrayDataset
 from ..data.mnist import MnistLoader
 from ..parallel import GraphTrainer, initialize_multihost, make_mesh
+from ..parallel.mesh import host_id_count
 from ..utils.config import RunConfig
 from ..utils.logger import Logger, default_logger
 from .train_loop import run_loop
@@ -82,6 +83,8 @@ def main(argv=None) -> None:
     loader = MnistLoader(cfg.data_dir)
     train_ds = ArrayDataset(_nhwc(loader.train_batch_dict()))
     test_ds = ArrayDataset(_nhwc(loader.test_batch_dict()))
+    pi, pc = host_id_count()
+    train_ds, test_ds = train_ds.host_shard(pi, pc), test_ds.host_shard(pi, pc)
     graph = load_graph(args.graph, cfg.local_batch, len(train_ds))
     train_graph(cfg, graph, train_ds, test_ds)
 

@@ -24,6 +24,8 @@ import numpy as np
 from ..data import imagenet
 from ..data.dataset import ArrayDataset
 from ..data.preprocess import ImagePreprocessor, compute_mean_image
+from ..parallel import initialize_multihost
+from ..parallel.mesh import host_id_count
 from ..schema import Field, Schema
 from ..solver import SolverConfig
 from ..utils.config import RunConfig
@@ -51,6 +53,39 @@ def load_corpus(cfg: RunConfig, split_prefix: str, label_file: str,
     return loader.load_all()
 
 
+def _global_mean_image(images: np.ndarray, host_count: int) -> np.ndarray:
+    """Mean image over the GLOBAL train set. The reference reduced full
+    images across the whole RDD (`ImageNetApp.scala:66-69`); with host-
+    sharded corpora each host contributes its (sum, count) and the weighted
+    mean is identical on every host — per-host means would silently diverge
+    the preprocessing."""
+    if host_count == 1:
+        return compute_mean_image(images)
+    from jax.experimental import multihost_utils
+    local = np.stack([images.sum(axis=0, dtype=np.float64),
+                      np.full(images.shape[1:], float(len(images)))])
+    gathered = multihost_utils.process_allgather(local)  # [pc, 2, ...]
+    total, count = gathered[:, 0].sum(axis=0), gathered[:, 1].sum(axis=0)
+    return (total / count).astype(np.float32)
+
+
+def _agree_eval_dataset(test_ds, host_count: int):
+    """Make every host agree on the eval workload. trainer.evaluate is a
+    COLLECTIVE: if hosts hold different val sizes (uneven tar shards), they
+    would run different numbers of eval calls and deadlock the pod. Truncate
+    all hosts to the global minimum size; if any host has nothing, eval is
+    disabled everywhere."""
+    if host_count == 1:
+        return test_ds
+    from jax.experimental import multihost_utils
+    sizes = multihost_utils.process_allgather(
+        np.asarray(len(test_ds) if test_ds is not None else 0))
+    m = int(np.min(sizes))
+    if m == 0:
+        return None
+    return ArrayDataset({k: v[:m] for k, v in test_ds.arrays.items()})
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", help="RunConfig JSON path")
@@ -61,14 +96,19 @@ def main(argv=None) -> None:
     p.add_argument("--val-labels", default="val.txt")
     p.add_argument("overrides", nargs="*")
     args = p.parse_args(argv)
+    initialize_multihost()  # BEFORE any other JAX use (mesh.py:49)
     cfg = (RunConfig.from_json(args.config) if args.config
            else default_config())
     if args.data_dir:
         cfg.data_dir = args.data_dir
     cfg = cfg.with_overrides(*args.overrides)
 
-    images, labels = load_corpus(cfg, args.train_prefix, args.train_labels)
-    mean = compute_mean_image(images) if cfg.subtract_mean else None
+    # each host streams only ITS tar shards (shards i::k to host i of k —
+    # the reference's one-Spark-partition-per-tar, keyed by process index)
+    pi, pc = host_id_count()
+    images, labels = load_corpus(cfg, args.train_prefix, args.train_labels,
+                                 host_id=pi, host_count=pc)
+    mean = _global_mean_image(images, pc) if cfg.subtract_mean else None
     crop = cfg.crop or 227
     # schema describes the preprocessor OUTPUT: NHWC device layout
     schema = Schema(Field("data", "float32", (crop, crop, 3)),
@@ -84,11 +124,14 @@ def main(argv=None) -> None:
     train_raw = ArrayDataset({"data": images, "label": labels[:, None]})
     try:
         val_images, val_labels = load_corpus(cfg, args.val_prefix,
-                                             args.val_labels)
+                                             args.val_labels,
+                                             host_id=pi, host_count=pc)
         test_ds = ArrayDataset(pp_eval.convert_batch(
             {"data": val_images, "label": val_labels[:, None]}, train=False))
-    except FileNotFoundError:
+    except (FileNotFoundError, ValueError):
+        # no val split — or fewer val tars than hosts left THIS host empty
         test_ds = None
+    test_ds = _agree_eval_dataset(test_ds, pc)
 
     from .train_loop import resolve_spec
     cfg.crop = crop

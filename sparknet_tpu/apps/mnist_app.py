@@ -12,6 +12,8 @@ import argparse
 
 from ..data.mnist import MnistLoader
 from ..data.dataset import ArrayDataset
+from ..parallel import initialize_multihost
+from ..parallel.mesh import host_id_count
 from ..solver import SolverConfig
 from ..utils.config import RunConfig
 from ..zoo import lenet
@@ -41,12 +43,15 @@ def main(argv=None) -> None:
     p.add_argument("--data-dir", default=None)
     p.add_argument("overrides", nargs="*")
     args = p.parse_args(argv)
+    initialize_multihost()  # BEFORE any other JAX use (mesh.py:49)
     cfg = (RunConfig.from_json(args.config) if args.config
            else default_config())
     if args.data_dir:
         cfg.data_dir = args.data_dir
     cfg = cfg.with_overrides(*args.overrides)
     train_ds, test_ds = build_datasets(cfg)
+    pi, pc = host_id_count()
+    train_ds, test_ds = train_ds.host_shard(pi, pc), test_ds.host_shard(pi, pc)
     spec = resolve_spec(cfg, data=(cfg.local_batch, 1, 28, 28),
                         label=(cfg.local_batch, 1))
     train(cfg, spec, train_ds, test_ds)

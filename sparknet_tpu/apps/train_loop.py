@@ -21,7 +21,7 @@ import numpy as np
 
 from ..model.net import CompiledNet
 from ..model.spec import NetSpec
-from ..parallel.mesh import make_mesh
+from ..parallel.mesh import fetch_global, make_mesh
 from ..parallel.trainer import ParallelTrainer, TrainState
 from ..data.dataset import ArrayDataset, RoundSampler
 from ..utils import checkpoint as ckpt
@@ -66,9 +66,17 @@ def resolve_solver(cfg: RunConfig):
 
 def probe_value(state: TrainState, net: CompiledNet) -> float:
     """First scalar of the first parametric layer's weights — the reference's
-    divergence probe (`apps/CifarApp.scala:147` logged conv1 weight [0])."""
-    first = net.param_layers()[0]
-    return float(np.asarray(state.params[first]["w"]).reshape(-1)[0])
+    divergence probe (`apps/CifarApp.scala:147` logged conv1 weight [0]).
+    Reads a locally-addressable shard so it works on multi-host arrays
+    (post-round params are replica-identical, any shard's value is THE
+    value)."""
+    leaf = state.params[net.param_layers()[0]]["w"]
+    if hasattr(leaf, "addressable_shards") and not getattr(
+            leaf, "is_fully_addressable", True):
+        arr = np.asarray(leaf.addressable_shards[0].data)
+    else:
+        arr = np.asarray(leaf)
+    return float(arr.reshape(-1)[0])
 
 
 def train(cfg: RunConfig, spec: NetSpec, train_ds: ArrayDataset,
@@ -106,12 +114,23 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     """The reference app loop, generic over the trainer backend: any object
     with init_state/place/train_round/evaluate + n_devices (ParallelTrainer
     for the layer IR, GraphTrainer for serialized graphs — the same way
-    CaffeSolver and TensorFlowNet sat behind one loop in the reference)."""
+    CaffeSolver and TensorFlowNet sat behind one loop in the reference).
+
+    Multi-host: `train_ds`/`test_ds` are this HOST's shards (apps key them
+    on jax.process_index/process_count); the sampler draws windows for the
+    locally-addressable devices only, and checkpointing allgathers the
+    worker-local state so process 0 writes the global checkpoint (resume
+    expects checkpoint_dir on a filesystem all hosts can read). Eval is a
+    collective: all hosts must agree on test_ds presence and SIZE
+    (ArrayDataset.host_shard splits are exactly equal; uneven sources must
+    reconcile first — see imagenet_app._agree_eval_dataset)."""
     n_dev = trainer.n_devices
-    sampler = RoundSampler(train_ds, n_dev, cfg.local_batch, cfg.tau,
+    n_local = getattr(trainer, "n_local_devices", n_dev)
+    sampler = RoundSampler(train_ds, n_local, cfg.local_batch, cfg.tau,
                            seed=cfg.seed)
-    log.log(f"train examples: {len(train_ds)} "
-            f"({len(train_ds) // n_dev} per worker)"
+    log.log(f"train examples: {len(train_ds)} on this host "
+            f"({len(train_ds) // n_local} per worker; "
+            f"{n_dev} devices / {n_local} local)"
             + (f"; test examples: {len(test_ds)}" if test_ds else ""))
 
     state = trainer.init_state(jax.random.PRNGKey(cfg.seed))
@@ -133,7 +152,8 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         if test_ds is not None and cfg.eval_every and \
                 rnd % cfg.eval_every == 0:
             with timers.phase("eval"):
-                acc = _evaluate(trainer, state, test_ds, cfg.eval_batch, n_dev)
+                acc = _evaluate(trainer, state, test_ds, cfg.eval_batch,
+                                n_local)
             log.log(f"test accuracy: {acc:.4f}", rnd)
             log.metrics(rnd, test_accuracy=acc)
 
@@ -165,16 +185,27 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         if cfg.checkpoint_dir and cfg.checkpoint_every and \
                 (rnd + 1) % cfg.checkpoint_every == 0:
             with timers.phase("checkpoint"):
-                ckpt.save(cfg.checkpoint_dir, state, step=rnd + 1)
-                ckpt.retain(cfg.checkpoint_dir, keep=3)
+                _save_checkpoint(cfg, state, rnd + 1)
             log.log("checkpoint saved", rnd)
         if round_hook:
             round_hook(rnd, state)
 
     if cfg.checkpoint_dir:
-        ckpt.save(cfg.checkpoint_dir, state, step=cfg.max_rounds)
+        _save_checkpoint(cfg, state, cfg.max_rounds, retain=False)
     log.log(f"done; phase means: {timers.summary()}")
     return state
+
+
+def _save_checkpoint(cfg: RunConfig, state, step: int,
+                     retain: bool = True) -> None:
+    """Allgather (a collective — every host must call this) then write from
+    process 0 only. Momentum is worker-local, so the gather is substantive,
+    not a replica read."""
+    host_state = fetch_global(state)
+    if jax.process_index() == 0:
+        ckpt.save(cfg.checkpoint_dir, host_state, step=step)
+        if retain:
+            ckpt.retain(cfg.checkpoint_dir, keep=3)
 
 
 def _to_device_layout(ds: ArrayDataset, net: CompiledNet) -> ArrayDataset:

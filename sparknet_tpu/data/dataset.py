@@ -40,6 +40,19 @@ class ArrayDataset:
             raise ValueError(f"{self.size} examples < {n_workers} workers")
         return [(w * per, (w + 1) * per) for w in range(n_workers)]
 
+    def host_shard(self, host_id: int, host_count: int) -> "ArrayDataset":
+        """This host's contiguous slice of an (identically loaded) dataset —
+        the multi-host analogue of the reference's
+        `repartition(numWorkers)` + per-executor caching
+        (`apps/CifarApp.scala:65-66`): each host then trains only on its own
+        disjoint examples. No-op for a single-host world."""
+        if host_count == 1:
+            return self
+        if not (0 <= host_id < host_count):
+            raise ValueError(f"host_id {host_id} not in [0, {host_count})")
+        lo, hi = self.partition_bounds(host_count)[host_id]
+        return ArrayDataset({k: v[lo:hi] for k, v in self.arrays.items()})
+
 
 class RoundSampler:
     """Per-round τ-window sampler over worker partitions."""

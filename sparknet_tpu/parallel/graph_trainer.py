@@ -30,7 +30,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..backend.graph_net import GraphNet
-from .mesh import DATA_AXIS
+from .mesh import (DATA_AXIS, local_device_rows, place_global_state,
+                   put_device_axis)
 
 PyTree = Any
 
@@ -54,6 +55,7 @@ class GraphTrainer:
         self.loss_name = net.resolve_loss(loss_name)
         self.acc_name = acc_name
         self.n_devices = int(np.prod(mesh.devices.shape))
+        self.n_local_devices = len(local_device_rows(mesh))
         self._step = net.make_train_step(self.loss_name)
 
         dev = P(DATA_AXIS)
@@ -84,7 +86,9 @@ class GraphTrainer:
         return self.place(jax.tree.map(tile, state))
 
     def place(self, state: PyTree) -> PyTree:
-        return jax.device_put(state, NamedSharding(self.mesh, P(DATA_AXIS)))
+        """Leaves carry the GLOBAL device axis; under multi-host each
+        process contributes its own devices' rows."""
+        return place_global_state(state, self.mesh, P(DATA_AXIS))
 
     def averaged_state(self, state: PyTree) -> PyTree:
         """Single-replica view (device 0's copy) for checkpoint/export."""
@@ -134,8 +138,7 @@ class GraphTrainer:
 
     def evaluate(self, state: PyTree, batch: Dict[str, np.ndarray]) -> float:
         sharded = {
-            k: jax.device_put(jnp.asarray(v),
-                              NamedSharding(self.mesh, P(DATA_AXIS)))
+            k: put_device_axis(v, self.mesh, P(DATA_AXIS))
             for k, v in self._cast(batch).items()}
         return float(self._eval(state, sharded))
 
@@ -156,9 +159,8 @@ class GraphTrainer:
         for k, v in self._cast(batches).items():
             assert v.shape[0] == self.tau, (
                 f"{k}: leading dim {v.shape[0]} != tau {self.tau}")
-            assert v.shape[1] % self.n_devices == 0, (
-                f"{k}: global batch {v.shape[1]} not divisible by "
-                f"{self.n_devices} devices")
-            out[k] = jax.device_put(
-                jnp.asarray(v), NamedSharding(self.mesh, P(None, DATA_AXIS)))
+            assert v.shape[1] % self.n_local_devices == 0, (
+                f"{k}: host batch {v.shape[1]} not divisible by "
+                f"{self.n_local_devices} local devices")
+            out[k] = put_device_axis(v, self.mesh, P(None, DATA_AXIS))
         return out

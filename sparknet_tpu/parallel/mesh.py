@@ -81,6 +81,60 @@ def initialize_multihost(coordinator: Optional[str] = None,
     return True
 
 
+def host_id_count() -> Tuple[int, int]:
+    """(process_index, process_count): the host-sharding key. The reference's
+    analogue was the Spark partition id per executor; here every host runs
+    the same program and takes its slice by process index."""
+    return jax.process_index(), jax.process_count()
+
+
+def local_device_rows(mesh: Mesh) -> list:
+    """Positions along the flattened mesh device axis owned by THIS process
+    (not assumed contiguous — TPU mesh construction may reorder devices for
+    ICI topology)."""
+    pi = jax.process_index()
+    return [i for i, d in enumerate(mesh.devices.flat)
+            if d.process_index == pi]
+
+
+def put_device_axis(arr, mesh: Mesh, spec: P):
+    """Place a host array onto the mesh with `spec`.
+
+    Single-process: plain device_put. Multi-host: `arr` is this process's
+    LOCAL slice along the sharded axis and the global array is assembled via
+    jax.make_array_from_process_local_data — each host contributes only the
+    rows its devices own (disjoint host data, the multi-host data path)."""
+    sh = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sh)
+    return jax.make_array_from_process_local_data(sh, np.asarray(arr))
+
+
+def place_global_state(tree, mesh: Mesh, spec: P):
+    """Place a pytree whose leaves carry a leading GLOBAL device axis (shape
+    [n_global_devices, ...], identical on every host — e.g. a freshly tiled
+    or checkpoint-restored TrainState). Multi-host: each host slices out its
+    own devices' rows and contributes only those."""
+    if jax.process_count() == 1:
+        return jax.device_put(tree, NamedSharding(mesh, spec))
+    rows = local_device_rows(mesh)
+
+    def put(x):
+        return put_device_axis(np.asarray(x)[rows], mesh, spec)
+
+    return jax.tree.map(put, tree)
+
+
+def fetch_global(tree):
+    """Materialize (possibly multi-host-sharded) arrays as host numpy on
+    EVERY process — the collective the checkpoint writer needs (momentum is
+    worker-local state, so this is a real allgather, not a replica read)."""
+    if jax.process_count() == 1:
+        return jax.tree.map(np.asarray, tree)
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(tree, tiled=True)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

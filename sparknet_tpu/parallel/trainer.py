@@ -42,7 +42,8 @@ from jax import shard_map
 
 from ..model.net import CompiledNet, PyTree
 from ..solver import SgdSolver, SolverConfig, SolverState
-from .mesh import DATA_AXIS
+from .mesh import (DATA_AXIS, local_device_rows, place_global_state,
+                   put_device_axis)
 
 
 @jax.tree_util.register_dataclass
@@ -77,6 +78,7 @@ class ParallelTrainer:
         self.loss_blob = loss_blob
         self.acc_blob = acc_blob
         self.n_devices = int(np.prod(mesh.devices.shape))
+        self.n_local_devices = len(local_device_rows(mesh))
 
         dev = P(DATA_AXIS)  # leading device axis
         batch_spec = P(None, DATA_AXIS)  # [tau, global_batch, ...] -> shard batch
@@ -111,8 +113,10 @@ class ParallelTrainer:
     def place(self, state: TrainState) -> TrainState:
         """Re-place a (possibly host/numpy) TrainState onto the mesh sharding
         the jitted round expects — required after checkpoint restore, else
-        every subsequent round recompiles for the foreign layout."""
-        return jax.device_put(state, NamedSharding(self.mesh, P(DATA_AXIS)))
+        every subsequent round recompiles for the foreign layout. Leaves
+        carry the GLOBAL device axis; under multi-host each process
+        contributes its own devices' rows."""
+        return place_global_state(state, self.mesh, P(DATA_AXIS))
 
     def averaged_params(self, state: TrainState) -> PyTree:
         """Single copy of the (already synchronized) params: device 0's."""
@@ -180,11 +184,14 @@ class ParallelTrainer:
                     rng: jax.Array) -> Tuple[TrainState, float]:
         """One outer round: τ local steps per device + averaging.
 
-        `batches[input]` has shape [tau, global_batch, ...] with
-        global_batch = n_devices × per-device batch; it is sharded over
-        devices along axis 1.
+        `batches[input]` has shape [tau, host_batch, ...] with host_batch =
+        (locally-addressable devices) × per-device batch; sharded over
+        devices along axis 1. Single-process, host_batch == the global
+        batch; multi-host, each process passes only its own hosts' examples
+        (disjoint data — the reference's per-executor partitions).
         """
-        rngs = jax.random.split(rng, self.n_devices)
+        rngs = jax.random.split(rng, self.n_devices)  # same on every host
+        rngs = place_global_state(rngs, self.mesh, P(DATA_AXIS))
         new_state, loss = self._round(state, self._shard_batches(batches), rngs)
         return new_state, loss
 
@@ -192,22 +199,20 @@ class ParallelTrainer:
         """Distributed accuracy over one global batch (psum of correct/count —
         reference's eval reduce, `apps/CifarApp.scala:107-124`)."""
         sharded = {
-            k: jax.device_put(jnp.asarray(v),
-                              NamedSharding(self.mesh, P(DATA_AXIS)))
+            k: put_device_axis(np.asarray(v), self.mesh, P(DATA_AXIS))
             for k, v in batch.items()}
         return float(self._eval(state.params, sharded))
 
     def _shard_batches(self, batches):
         out = {}
         for k, v in batches.items():
-            arr = jnp.asarray(v)
+            arr = np.asarray(v)
             assert arr.shape[0] == self.tau, (
                 f"{k}: leading dim {arr.shape[0]} != tau {self.tau}")
-            assert arr.shape[1] % self.n_devices == 0, (
-                f"{k}: global batch {arr.shape[1]} not divisible by "
-                f"{self.n_devices} devices")
-            out[k] = jax.device_put(
-                arr, NamedSharding(self.mesh, P(None, DATA_AXIS)))
+            assert arr.shape[1] % self.n_local_devices == 0, (
+                f"{k}: host batch {arr.shape[1]} not divisible by "
+                f"{self.n_local_devices} local devices")
+            out[k] = put_device_axis(arr, self.mesh, P(None, DATA_AXIS))
         return out
 
 

@@ -1,0 +1,137 @@
+"""REAL multi-host tests: two OS processes form a jax.distributed world
+(2 hosts × 4 virtual CPU devices = 8-device global mesh, Gloo collectives)
+and run the actual training loop on disjoint host data — the coverage the
+reference validated only empirically on EC2 (SURVEY §4: "no multi-node
+tests").
+
+Plus single-process unit tests of the host-sharding math.
+"""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data.dataset import ArrayDataset
+from sparknet_tpu.data.imagenet import host_shards
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- host-sharding math (single-process) ------------------------------------
+
+def test_host_shards_disjoint_cover():
+    shards = [f"s{i}.tar" for i in range(10)]
+    parts = [host_shards(shards, h, 3) for h in range(3)]
+    flat = [s for p in parts for s in p]
+    assert sorted(flat) == sorted(shards)          # cover
+    assert len(set(flat)) == len(flat)             # disjoint
+    assert parts[0] == ["s0.tar", "s3.tar", "s6.tar", "s9.tar"]
+
+
+def test_array_dataset_host_shard():
+    ds = ArrayDataset({"x": np.arange(10)[:, None]})
+    a, b = ds.host_shard(0, 2), ds.host_shard(1, 2)
+    np.testing.assert_array_equal(a.arrays["x"][:, 0], np.arange(5))
+    np.testing.assert_array_equal(b.arrays["x"][:, 0], np.arange(5, 10))
+    assert ds.host_shard(0, 1) is ds               # single-host no-op
+    with pytest.raises(ValueError):
+        ds.host_shard(2, 2)
+
+
+# -- 2-process end-to-end ----------------------------------------------------
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    pid, nproc, port, workdir = (int(sys.argv[1]), int(sys.argv[2]),
+                                 sys.argv[3], sys.argv[4])
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from sparknet_tpu.parallel import initialize_multihost
+    initialize_multihost(coordinator=f"127.0.0.1:{port}",
+                         num_processes=nproc, process_id=pid)
+    assert jax.process_count() == nproc and len(jax.devices()) == 4 * nproc
+
+    import numpy as np
+    from sparknet_tpu.data.dataset import ArrayDataset
+    from sparknet_tpu.parallel.mesh import host_id_count
+    from sparknet_tpu.utils.config import RunConfig
+    from sparknet_tpu.utils.logger import Logger
+    from sparknet_tpu.solver import SolverConfig
+    from sparknet_tpu.apps.train_loop import train, probe_value
+    from sparknet_tpu.zoo import lenet
+    from sparknet_tpu import CompiledNet
+
+    # identical corpus on every host (seeded), then disjoint host shards
+    r = np.random.default_rng(0)
+    n = 256
+    labels = r.integers(0, 10, (n, 1)).astype(np.int32)
+    data = 0.1 * r.standard_normal((n, 1, 28, 28)).astype(np.float32)
+    for i in range(n):
+        c = int(labels[i, 0])
+        data[i, 0, c:(c + 6), c:(c + 6)] += 1.0
+    ds = ArrayDataset({"data": data, "label": labels})
+    pi, pc = host_id_count()
+    train_ds = ds.host_shard(pi, pc)
+
+    cfg = RunConfig(model="lenet",
+                    solver=SolverConfig(base_lr=0.01, momentum=0.9,
+                                        lr_policy="fixed"),
+                    tau=2, local_batch=4, eval_every=0, max_rounds=3,
+                    workdir=workdir, seed=0,
+                    checkpoint_dir=os.path.join(workdir, "ck"),
+                    checkpoint_every=2)
+    state = train(cfg, lenet(batch=cfg.local_batch), train_ds,
+                  logger=Logger(os.path.join(workdir, f"log{pid}.txt"),
+                                echo=False))
+    probe = probe_value(state, CompiledNet.compile(lenet(batch=4)))
+    print(f"RESULT pid={pid} probe={probe:.8f}", flush=True)
+""")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_training_world(tmp_path):
+    """Both hosts run the full app loop (disjoint data, τ-rounds, allreduce
+    sync, multi-host checkpointing) and must agree bit-for-bit on the final
+    averaged params (the probe)."""
+    port = _free_port()
+    script = str(tmp_path / "worker.py")
+    with open(script, "w") as f:
+        f.write(_WORKER)
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    for pid in range(2):
+        os.makedirs(tmp_path / f"w{pid}", exist_ok=True)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, script, str(pid), "2", str(port),
+             str(tmp_path / f"w{pid}")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for pid in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+    probes = sorted(
+        ln.split("probe=")[1] for out in outs for ln in out.splitlines()
+        if ln.startswith("RESULT"))
+    assert len(probes) == 2
+    assert probes[0] == probes[1], f"hosts diverged: {probes}"
+    # process 0 (and only process 0) wrote the checkpoint
+    assert os.path.isdir(tmp_path / "w0" / "ck" / "step-3")
+    assert not os.path.isdir(tmp_path / "w1" / "ck")
