@@ -1,20 +1,25 @@
 #!/usr/bin/env python3
 """Re-shard ImageNet into shuffled tar chunks + label files.
 
-Parity with the reference's `scripts/put_imagenet_on_s3.py` (Python 2 + boto):
-reads the ILSVRC2012 training tar-of-tars and validation tar, re-shards into
-N shuffled chunks of resized JPEGs, writes `train.NNNN.tar` / `val.NNNN.tar`
-plus `train.txt` / `val.txt` "filename label" maps — into a local directory
-(sync to object storage with `gsutil -m rsync` afterwards; no cloud SDK
-dependency here).
+Parity with the reference's `scripts/put_imagenet_on_s3.py` (Python 2 +
+boto): reads the ILSVRC2012 training tar-of-tars and/or the flat validation
+tar, re-shards into N shuffled chunks of resized JPEGs, and writes
+`train.NNNN.tar` / `val.NNNN.tar` plus `train.txt` / `val.txt`
+"filename label" maps — into a local directory (sync to object storage with
+`gsutil -m rsync` afterwards; no cloud SDK dependency here).
 
-Train shards only (labels = sorted synset order); shard the validation tar
-separately with any tool and write val.txt in the same "filename label"
-format.
+Train labels come from the sorted synset order (reference convention);
+validation labels come from a provided `--val-label-file` in the standard
+"ILSVRC2012_val_XXXXXXXX.JPEG <label>" format (the reference fetched the
+same file from caffe_ilsvrc12.tar.gz; reference `process_val_files`,
+put_imagenet_on_s3.py:64-77).
 
 Usage:
-  scripts/shard_imagenet.py --train-tar ILSVRC2012_img_train.tar \
-      --out data/imagenet --shards 1000 --size 256
+  scripts/shard_imagenet.py --out data/imagenet \
+      [--train-tar ILSVRC2012_img_train.tar --shards 1000] \
+      [--val-tar ILSVRC2012_img_val.tar --val-label-file val_truth.txt \
+       --val-shards 50] \
+      [--size 256]
 """
 from __future__ import annotations
 
@@ -23,6 +28,7 @@ import io
 import os
 import random
 import tarfile
+from typing import Dict, List
 
 
 def resize_jpeg(data: bytes, size: int) -> bytes:
@@ -34,26 +40,46 @@ def resize_jpeg(data: bytes, size: int) -> bytes:
     return buf.getvalue()
 
 
-def main() -> None:
-    p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--train-tar", required=True,
-                   help="ILSVRC2012_img_train.tar (tar of per-class tars)")
-    p.add_argument("--out", required=True)
-    p.add_argument("--shards", type=int, default=1000)
-    p.add_argument("--size", type=int, default=256)
-    p.add_argument("--seed", type=int, default=0)
-    args = p.parse_args()
+class ShardWriters:
+    """Lazily-opened `<split>.NNNN.tar` writers."""
 
-    os.makedirs(args.out, exist_ok=True)
+    def __init__(self, out_dir: str, split: str):
+        self.out_dir = out_dir
+        self.split = split
+        self.writers: Dict[int, tarfile.TarFile] = {}
+
+    def add(self, shard_id: int, name: str, data: bytes) -> None:
+        w = self.writers.get(shard_id)
+        if w is None:
+            w = tarfile.open(os.path.join(
+                self.out_dir, f"{self.split}.{shard_id:04d}.tar"), "w")
+            self.writers[shard_id] = w
+        info = tarfile.TarInfo(name=name)
+        info.size = len(data)
+        w.addfile(info, io.BytesIO(data))
+
+    def close(self) -> int:
+        for w in self.writers.values():
+            w.close()
+        return len(self.writers)
+
+
+def write_labels(out_dir: str, split: str, lines: List[str]) -> None:
+    with open(os.path.join(out_dir, f"{split}.txt"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def shard_train(train_tar: str, out: str, shards: int, size: int,
+                seed: int) -> None:
     # pass 1: class list -> labels (sorted synset order, reference convention)
-    entries = []  # (class_tar_name, member_name)
-    with tarfile.open(args.train_tar) as outer:
+    with tarfile.open(train_tar) as outer:
         class_tars = sorted(m.name for m in outer if m.isfile())
     label_of = {name: i for i, name in enumerate(class_tars)}
     print(f"{len(class_tars)} classes")
 
     # pass 2: enumerate images, assign shuffled shard ids
-    with tarfile.open(args.train_tar) as outer:
+    entries = []  # (class_tar_name, member_name)
+    with tarfile.open(train_tar) as outer:
         for m in outer:
             if not m.isfile():
                 continue
@@ -61,15 +87,14 @@ def main() -> None:
             for im in inner:
                 if im.isfile():
                     entries.append((m.name, im.name))
-    rng = random.Random(args.seed)
+    rng = random.Random(seed)
     rng.shuffle(entries)
-    shard_of = {e: i * args.shards // len(entries)
-                for i, e in enumerate(entries)}
-    print(f"{len(entries)} images -> {args.shards} shards")
+    shard_of = {e: i * shards // len(entries) for i, e in enumerate(entries)}
+    print(f"{len(entries)} train images -> {shards} shards")
 
-    writers = {}
+    writers = ShardWriters(out, "train")
     labels = []
-    with tarfile.open(args.train_tar) as outer:
+    with tarfile.open(train_tar) as outer:
         for m in outer:
             if not m.isfile():
                 continue
@@ -77,21 +102,76 @@ def main() -> None:
             for im in inner:
                 if not im.isfile():
                     continue
-                sid = shard_of[(m.name, im.name)]
-                if sid not in writers:
-                    writers[sid] = tarfile.open(
-                        os.path.join(args.out, f"train.{sid:04d}.tar"), "w")
-                data = resize_jpeg(inner.extractfile(im).read(), args.size)
-                info = tarfile.TarInfo(name=os.path.basename(im.name))
-                info.size = len(data)
-                writers[sid].addfile(info, io.BytesIO(data))
-                labels.append(f"{os.path.basename(im.name)} "
-                              f"{label_of[m.name]}")
-    for w in writers.values():
-        w.close()
-    with open(os.path.join(args.out, "train.txt"), "w") as f:
-        f.write("\n".join(labels) + "\n")
-    print(f"wrote {len(writers)} shards + train.txt under {args.out}")
+                base = os.path.basename(im.name)
+                data = resize_jpeg(inner.extractfile(im).read(), size)
+                writers.add(shard_of[(m.name, im.name)], base, data)
+                labels.append(f"{base} {label_of[m.name]}")
+    n = writers.close()
+    write_labels(out, "train", labels)
+    print(f"wrote {n} train shards + train.txt under {out}")
+
+
+def shard_val(val_tar: str, val_label_file: str, out: str, shards: int,
+              size: int, seed: int) -> None:
+    """Reference `process_val_files` (put_imagenet_on_s3.py:64-77): split
+    the shuffled label list into chunks, write one resized tar per chunk."""
+    with open(val_label_file) as f:
+        pairs = [ln.split() for ln in f if ln.strip()]
+    rng = random.Random(seed)
+    rng.shuffle(pairs)
+    shard_of = {name: i % shards for i, (name, _) in enumerate(pairs)}
+
+    writers = ShardWriters(out, "val")
+    labels = []
+    found = set()
+    with tarfile.open(val_tar) as tar:
+        label_map = {name: lbl for name, lbl in pairs}
+        for m in tar:
+            if not m.isfile():
+                continue
+            base = os.path.basename(m.name)
+            lbl = label_map.get(base)
+            if lbl is None:
+                print(f"warning: {base} not in {val_label_file}, skipped")
+                continue
+            data = resize_jpeg(tar.extractfile(m).read(), size)
+            writers.add(shard_of[base], base, data)
+            labels.append(f"{base} {lbl}")
+            found.add(base)
+    missing = [n for n, _ in pairs if n not in found]
+    if missing:
+        print(f"warning: {len(missing)} labeled files not in the val tar "
+              f"(first: {missing[0]})")
+    n = writers.close()
+    write_labels(out, "val", labels)
+    print(f"wrote {n} val shards + val.txt under {out}")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--train-tar",
+                   help="ILSVRC2012_img_train.tar (tar of per-class tars)")
+    p.add_argument("--val-tar", help="ILSVRC2012_img_val.tar (flat JPEGs)")
+    p.add_argument("--val-label-file",
+                   help="'filename label' ground truth for the val tar")
+    p.add_argument("--out", required=True)
+    p.add_argument("--shards", type=int, default=1000)
+    p.add_argument("--val-shards", type=int, default=50)
+    p.add_argument("--size", type=int, default=256)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    if not args.train_tar and not args.val_tar:
+        p.error("nothing to do: pass --train-tar and/or --val-tar")
+    if args.val_tar and not args.val_label_file:
+        p.error("--val-tar needs --val-label-file (ground-truth labels)")
+    os.makedirs(args.out, exist_ok=True)
+    if args.train_tar:
+        shard_train(args.train_tar, args.out, args.shards, args.size,
+                    args.seed)
+    if args.val_tar:
+        shard_val(args.val_tar, args.val_label_file, args.out,
+                  args.val_shards, args.size, args.seed)
 
 
 if __name__ == "__main__":

@@ -17,6 +17,7 @@ slice RAM-sized).
 from __future__ import annotations
 
 import argparse
+import sys
 from typing import Tuple
 
 import numpy as np
@@ -128,8 +129,12 @@ def main(argv=None) -> None:
                                              host_id=pi, host_count=pc)
         test_ds = ArrayDataset(pp_eval.convert_batch(
             {"data": val_images, "label": val_labels[:, None]}, train=False))
-    except (FileNotFoundError, ValueError):
-        # no val split — or fewer val tars than hosts left THIS host empty
+    except (FileNotFoundError, ValueError) as e:
+        # no val split — or fewer val tars than hosts left THIS host empty.
+        # Say WHY: a malformed val.txt also lands here and must not look
+        # like "no val data" on a multi-day run.
+        print(f"imagenet_app: eval disabled on host {pi}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
         test_ds = None
     test_ds = _agree_eval_dataset(test_ds, pc)
 

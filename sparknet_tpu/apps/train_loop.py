@@ -227,7 +227,11 @@ def _to_device_layout(ds: ArrayDataset, net: CompiledNet) -> ArrayDataset:
 
 def _evaluate(trainer, state, test_ds: ArrayDataset, eval_batch: int,
               n_dev: int) -> float:
-    """Full-coverage distributed eval (reference `CifarApp.scala:107-124`)."""
+    """Distributed eval (reference `CifarApp.scala:107-124`), covering every
+    example except at most n_dev-1 trailing ones (batches must split evenly
+    across devices): the tail past the last full eval_batch is evaluated as
+    one smaller batch (a second compiled shape, amortized across rounds) and
+    weighted by its real size."""
     eval_batch = min(eval_batch, len(test_ds))
     eval_batch = max(n_dev, (eval_batch // n_dev) * n_dev)
     if len(test_ds) < eval_batch:
@@ -235,9 +239,15 @@ def _evaluate(trainer, state, test_ds: ArrayDataset, eval_batch: int,
             f"test set ({len(test_ds)}) smaller than {n_dev} devices' "
             f"minimum eval batch")
     total, count = 0.0, 0
-    n = (len(test_ds) // eval_batch) * eval_batch
-    for i in range(0, n, eval_batch):
+    n_full = (len(test_ds) // eval_batch) * eval_batch
+    for i in range(0, n_full, eval_batch):
         batch = {k: v[i:i + eval_batch] for k, v in test_ds.arrays.items()}
         total += trainer.evaluate(state, batch) * eval_batch
         count += eval_batch
+    tail = ((len(test_ds) - n_full) // n_dev) * n_dev
+    if tail:
+        batch = {k: v[n_full:n_full + tail]
+                 for k, v in test_ds.arrays.items()}
+        total += trainer.evaluate(state, batch) * tail
+        count += tail
     return total / max(count, 1)

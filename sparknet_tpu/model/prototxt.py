@@ -156,6 +156,21 @@ def _filler(msg: Message | None) -> Filler:
     )
 
 
+def _reject_unimplemented(block: "Message", layer_name: str, block_name: str,
+                          fields: Tuple[str, ...]) -> None:
+    """Fail loudly on RECOGNIZED Caffe fields this importer does not
+    implement (e.g. rectangular kernel_h/kernel_w geometry): a prototxt
+    using them would otherwise import with defaults and train a structurally
+    wrong net — same fail-loudly stance as unknown layer types and non-SGD
+    solvers."""
+    present = [f for f in fields if _one(block, f) is not None]
+    if present:
+        raise ValueError(
+            f"layer {layer_name!r}: {block_name} field(s) {present} are "
+            f"recognized but not implemented (square geometry only) — "
+            f"refusing to import a structurally different net silently")
+
+
 def _layer_from_msg(m: Message) -> LayerSpec:
     name = _one(m, "name", "")
     ltype = _one(m, "type", "")
@@ -177,6 +192,14 @@ def _layer_from_msg(m: Message) -> LayerSpec:
     kw: Dict[str, Any] = {}
     cp = _one(m, "convolution_param")
     if cp:
+        _reject_unimplemented(cp, name, "convolution_param",
+                              ("kernel_h", "kernel_w", "stride_h", "stride_w",
+                               "pad_h", "pad_w"))
+        if int(_one(cp, "dilation", 1)) != 1:
+            raise ValueError(
+                f"layer {name!r}: convolution_param.dilation is recognized "
+                f"but not implemented — refusing to import a structurally "
+                f"different net silently")
         kw["conv"] = ConvolutionParam(
             num_output=int(_one(cp, "num_output", 0)),
             kernel_size=int(_one(cp, "kernel_size", 1)),
@@ -189,6 +212,9 @@ def _layer_from_msg(m: Message) -> LayerSpec:
         )
     pp = _one(m, "pooling_param")
     if pp:
+        _reject_unimplemented(pp, name, "pooling_param",
+                              ("kernel_h", "kernel_w", "stride_h", "stride_w",
+                               "pad_h", "pad_w"))
         kw["pool"] = PoolingParam(
             pool=str(_one(pp, "pool", "MAX")),
             kernel_size=int(_one(pp, "kernel_size", 1)),
@@ -224,6 +250,14 @@ def _layer_from_msg(m: Message) -> LayerSpec:
         kw["dropout"] = DropoutParam()
     if ltype == "Accuracy" and "accuracy" not in kw:
         kw["accuracy"] = AccuracyParam()
+    ccp = _one(m, "concat_param")
+    if ccp:
+        axis = _one(ccp, "axis", _one(ccp, "concat_dim", 1))
+        if int(axis) != 1:
+            raise ValueError(
+                f"layer {name!r}: Concat axis {axis} is recognized but only "
+                f"channel concat (axis 1) is implemented — refusing to "
+                f"import a structurally different net silently")
 
     return LayerSpec(
         name=name,

@@ -149,10 +149,21 @@ class JaxNet:
 
     def save_weights(self, path: str) -> None:
         """Weight-only export (parity `saveWeightsToFile`,
-        `libs/CaffeNet.scala:159-165`)."""
-        self.get_weights().save(path)
+        `libs/CaffeNet.scala:159-165`). `.caffemodel` suffix writes binary
+        Caffe NetParameter; anything else our npz format."""
+        if path.endswith(".caffemodel"):
+            from .model.caffemodel import save_caffemodel
+            save_caffemodel(self.get_weights(), path,
+                            net_name=self.net.spec.name)
+        else:
+            self.get_weights().save(path)
 
     def load_weights(self, path: str) -> None:
         """Weight-only import (parity `copyTrainedLayersFrom`,
-        `libs/CaffeNet.scala:152-157`)."""
-        self.set_weights(WeightCollection.load(path))
+        `libs/CaffeNet.scala:152-157`). Reads binary `.caffemodel`
+        (trained Caffe nets import directly) or our npz format."""
+        if path.endswith(".caffemodel"):
+            from .model.caffemodel import load_caffemodel_file
+            self.set_weights(load_caffemodel_file(path))
+        else:
+            self.set_weights(WeightCollection.load(path))

@@ -34,8 +34,10 @@ def lrn(x: jnp.ndarray, local_size: int = 5, *, alpha: float = 1e-4,
 
 
 def _use_pallas(x) -> bool:
+    """Affirmative TPU check — an unknown future backend gets the portable
+    XLA path, not the TPU Pallas kernel (the axon tunnel reports 'tpu')."""
     try:
-        return jax.default_backend() not in ("cpu", "gpu") and x.ndim >= 2
+        return jax.default_backend() == "tpu" and x.ndim >= 2
     except Exception:
         return False
 

@@ -70,6 +70,12 @@ class ParallelTrainer:
         assert mode in ("local_sgd", "sync_sgd")
         if mode == "sync_sgd":
             assert tau == 1, "sync_sgd averages every step; tau must be 1"
+        if solver_cfg.iter_size != 1:
+            raise ValueError(
+                "iter_size > 1 is a single-net accumulation feature "
+                "(SgdSolver.step); in the distributed trainer scale "
+                "local_batch or tau instead — failing loudly rather than "
+                "silently ignoring it")
         self.net = net
         self.solver = SgdSolver(net, solver_cfg, loss_blob=loss_blob)
         self.mesh = mesh

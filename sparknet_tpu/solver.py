@@ -150,9 +150,32 @@ class SgdSolver:
         return new_params, SolverState(momentum=new_mom, it=state.it + 1)
 
     def _step_impl(self, params, state, batch, rng):
-        (loss, blobs), grads = jax.value_and_grad(
-            lambda p: self.net.loss_fn(self.loss_blob)(p, batch, rng),
-            has_aux=True)(params)
+        k = self.cfg.iter_size
+        if k == 1:
+            (loss, blobs), grads = jax.value_and_grad(
+                lambda p: self.net.loss_fn(self.loss_blob)(p, batch, rng),
+                has_aux=True)(params)
+        else:
+            # Caffe iter_size semantics (SGDSolver::Step): accumulate grads
+            # over iter_size micro-batches, normalize by 1/iter_size, ONE
+            # ApplyUpdate, ONE iteration-counter bump. The incoming batch
+            # carries iter_size × net-batch examples on the leading axis.
+            micro = {kk: v.reshape((k, v.shape[0] // k) + v.shape[1:])
+                     for kk, v in batch.items()}
+            rngs = jax.random.split(rng, k)
+
+            def accum(carry, xs):
+                mb, sub = xs
+                l, g = jax.value_and_grad(
+                    lambda p: self.net.loss_fn(self.loss_blob)(
+                        p, mb, sub)[0])(params)
+                acc_l, acc_g = carry
+                return (acc_l + l / k,
+                        jax.tree.map(lambda a, b: a + b / k, acc_g, g)), None
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (loss, grads), _ = jax.lax.scan(
+                accum, (jnp.zeros((), jnp.float32), zeros), (micro, rngs))
         new_params, new_state = self.update(params, state, grads)
         return new_params, new_state, loss
 
@@ -161,9 +184,19 @@ class SgdSolver:
     def step(self, params: PyTree, state: SolverState,
              batch: Dict[str, jnp.ndarray], rng: Optional[jax.Array] = None
              ) -> Tuple[PyTree, SolverState, jnp.ndarray]:
-        """One jitted train step. Returns (params, state, loss)."""
+        """One jitted train step (one UPDATE: with iter_size=k the batch
+        must hold k x net-batch examples — k accumulation micro-batches).
+        Returns (params, state, loss)."""
         if rng is None:
             rng = jax.random.fold_in(jax.random.PRNGKey(0), int(state.it))
+        k = self.cfg.iter_size
+        if k > 1:
+            for kk, v in batch.items():
+                if v.shape[0] % k:
+                    raise ValueError(
+                        f"{kk}: batch dim {v.shape[0]} not divisible by "
+                        f"iter_size {k} (pass iter_size x net-batch "
+                        f"examples per step)")
         return self._step(params, state, batch, rng)
 
 

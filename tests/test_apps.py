@@ -147,3 +147,26 @@ def test_graph_mnist_app_loop(tmp_path):
     assert step == 4
     np.testing.assert_array_equal(
         np.asarray(state["it"]), np.asarray(restored["it"]))
+
+
+def test_evaluate_covers_tail(tmp_path):
+    """_evaluate weights the non-multiple tail (ADVICE r1: full coverage was
+    documented but tail examples were dropped)."""
+    from sparknet_tpu.apps.train_loop import _evaluate
+
+    class FakeTrainer:
+        def __init__(self):
+            self.calls = []
+
+        def evaluate(self, state, batch):
+            n = len(next(iter(batch.values())))
+            self.calls.append(n)
+            return 1.0 if n == 32 else 0.0
+
+    # 50 examples, eval_batch 32, 2 devices: one full batch of 32 (acc 1.0)
+    # + tail of 18 (acc 0.0) -> weighted 32/50
+    ds = ArrayDataset({"x": np.zeros((50, 3), np.float32)})
+    t = FakeTrainer()
+    acc = _evaluate(t, None, ds, eval_batch=32, n_dev=2)
+    assert t.calls == [32, 18]
+    assert acc == pytest.approx(32 / 50)

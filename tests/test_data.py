@@ -209,3 +209,46 @@ def test_eval_batches_cover():
     batches = list(s.eval_batches(4))
     assert len(batches) == 4
     assert sum(len(b["x"]) for b in batches) == 16
+
+
+def test_shard_imagenet_val_split(tmp_path):
+    """scripts/shard_imagenet.py val path (reference process_val_files,
+    put_imagenet_on_s3.py:64-77): flat val tar + ground-truth labels ->
+    val.NNNN.tar shards + val.txt, loadable by ShardedTarLoader."""
+    import io
+    import os
+    import sys
+    import tarfile
+    from PIL import Image
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    import shard_imagenet
+
+    r = np.random.default_rng(0)
+    val_tar = str(tmp_path / "ILSVRC2012_img_val.tar")
+    truth = str(tmp_path / "truth.txt")
+    names = [f"ILSVRC2012_val_{i:08d}.JPEG" for i in range(12)]
+    with tarfile.open(val_tar, "w") as tar:
+        for name in names:
+            arr = r.integers(0, 256, (48, 48, 3), dtype=np.uint8)
+            buf = io.BytesIO()
+            Image.fromarray(arr).save(buf, format="JPEG")
+            info = tarfile.TarInfo(name=name)
+            info.size = len(buf.getvalue())
+            tar.addfile(info, io.BytesIO(buf.getvalue()))
+    with open(truth, "w") as f:
+        f.write("\n".join(f"{n} {i % 5}" for i, n in enumerate(names)) + "\n")
+
+    out = str(tmp_path / "out")
+    os.makedirs(out)
+    shard_imagenet.shard_val(val_tar, truth, out, shards=3, size=32, seed=0)
+
+    shards = imagenet.list_shards(out, prefix="val.")
+    assert len(shards) == 3
+    labels = imagenet.load_label_map(os.path.join(out, "val.txt"))
+    assert len(labels) == 12
+    loader = imagenet.ShardedTarLoader(shards, labels, height=32, width=32)
+    images, lbls = loader.load_all()
+    assert images.shape == (12, 3, 32, 32)
+    # labels survive the reshard: every (name, label) pair intact
+    assert sorted(lbls.tolist()) == sorted(int(v) for v in labels.values())

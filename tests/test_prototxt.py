@@ -107,3 +107,51 @@ def test_reference_caffenet_prototxt():
     assert conv2.conv.group == 2
     norm1 = spec.layer_by_name("norm1")
     assert norm1.lrn.local_size == 5 and norm1.lrn.alpha == 0.0001
+
+
+def test_unimplemented_geometry_fields_rejected():
+    """Recognized-but-unimplemented Caffe fields must fail loudly, not
+    import a structurally different net with defaults."""
+    import pytest
+    from sparknet_tpu.model.prototxt import net_from_prototxt
+    base = """
+    name: "g"
+    input: "data"
+    input_shape { dim: 1 dim: 3 dim: 8 dim: 8 }
+    layer {
+      name: "c" type: "Convolution" bottom: "data" top: "c"
+      convolution_param { num_output: 4 %s }
+    }
+    """
+    for bad in ("kernel_h: 3 kernel_w: 5", "stride_h: 2", "pad_w: 1",
+                "dilation: 2"):
+        with pytest.raises(ValueError, match="not implemented|dilation"):
+            net_from_prototxt(base % bad)
+    # square geometry still imports
+    net_from_prototxt(base % "kernel_size: 3 pad: 1")
+
+    pool_bad = """
+    name: "g"
+    input: "data"
+    input_shape { dim: 1 dim: 3 dim: 8 dim: 8 }
+    layer {
+      name: "p" type: "Pooling" bottom: "data" top: "p"
+      pooling_param { pool: MAX kernel_h: 2 }
+    }
+    """
+    with pytest.raises(ValueError, match="not implemented"):
+        net_from_prototxt(pool_bad)
+
+    concat_bad = """
+    name: "g"
+    input: "a"
+    input_shape { dim: 1 dim: 4 }
+    input: "b"
+    input_shape { dim: 1 dim: 4 }
+    layer {
+      name: "cat" type: "Concat" bottom: "a" bottom: "b" top: "cat"
+      concat_param { axis: 2 }
+    }
+    """
+    with pytest.raises(ValueError, match="Concat axis"):
+        net_from_prototxt(concat_bad)

@@ -84,3 +84,53 @@ def test_training_reduces_loss():
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.7, losses[::10]
     assert np.isfinite(losses).all()
+
+
+def test_iter_size_accumulation_matches_full_batch(rng):
+    """Caffe iter_size semantics: k accumulation micro-batches + one update
+    == one update on the concatenated batch (loss is a batch mean, so
+    grad-mean over micro-batches equals the full-batch grad)."""
+    from sparknet_tpu.apps.adult_app import adult_net
+    data = rng.standard_normal((8, 16)).astype(np.float32)
+    label = rng.integers(0, 2, (8, 1)).astype(np.int32)
+
+    full = CompiledNet.compile(adult_net(batch=8, n_features=16))
+    p0 = full.init_params(jax.random.PRNGKey(0))
+    s_full = SgdSolver(full, SolverConfig(base_lr=0.1, momentum=0.9,
+                                          weight_decay=0.01, iter_size=1))
+    st = s_full.init_state(p0)
+    pf, stf, loss_f = s_full.step(p0, st, {"C0": data, "label": label})
+
+    half = CompiledNet.compile(adult_net(batch=4, n_features=16))
+    p1 = half.init_params(jax.random.PRNGKey(0))
+    s_acc = SgdSolver(half, SolverConfig(base_lr=0.1, momentum=0.9,
+                                         weight_decay=0.01, iter_size=2))
+    st2 = s_acc.init_state(p1)
+    pa, sta, loss_a = s_acc.step(p1, st2, {"C0": data, "label": label})
+
+    assert float(loss_a) == pytest.approx(float(loss_f), rel=1e-5)
+    assert int(sta.it) == int(stf.it) == 1  # ONE iteration per k micro-batches
+    for lname in pf:
+        for pname in pf[lname]:
+            np.testing.assert_allclose(
+                np.asarray(pa[lname][pname]), np.asarray(pf[lname][pname]),
+                rtol=1e-5, atol=1e-6, err_msg=f"{lname}/{pname}")
+
+
+def test_iter_size_indivisible_batch_rejected(rng):
+    from sparknet_tpu.apps.adult_app import adult_net
+    net = CompiledNet.compile(adult_net(batch=3, n_features=16))
+    p = net.init_params(jax.random.PRNGKey(0))
+    s = SgdSolver(net, SolverConfig(iter_size=2))
+    with pytest.raises(ValueError, match="iter_size"):
+        s.step(p, s.init_state(p),
+               {"C0": np.zeros((7, 16), np.float32),
+                "label": np.zeros((7, 1), np.int32)})
+
+
+def test_iter_size_rejected_in_distributed_trainer():
+    from sparknet_tpu.parallel import ParallelTrainer, make_mesh
+    from sparknet_tpu.apps.adult_app import adult_net
+    net = CompiledNet.compile(adult_net(batch=4, n_features=16))
+    with pytest.raises(ValueError, match="iter_size"):
+        ParallelTrainer(net, SolverConfig(iter_size=2), make_mesh(2))
