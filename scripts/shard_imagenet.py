@@ -116,7 +116,18 @@ def shard_val(val_tar: str, val_label_file: str, out: str, shards: int,
     """Reference `process_val_files` (put_imagenet_on_s3.py:64-77): split
     the shuffled label list into chunks, write one resized tar per chunk."""
     with open(val_label_file) as f:
-        pairs = [ln.split() for ln in f if ln.strip()]
+        pairs = []
+        for lineno, ln in enumerate(f, 1):
+            if not ln.strip():
+                continue
+            toks = ln.split()
+            if len(toks) != 2 or not toks[1].lstrip("-").isdigit():
+                raise SystemExit(
+                    f"{val_label_file}:{lineno}: expected 'filename label' "
+                    f"(caffe_ilsvrc12 val.txt format), got {ln.strip()!r} — "
+                    "the ILSVRC devkit ground-truth file (label-only lines) "
+                    "must be joined with filenames first")
+            pairs.append((toks[0], toks[1]))
     rng = random.Random(seed)
     rng.shuffle(pairs)
     shard_of = {name: i % shards for i, (name, _) in enumerate(pairs)}

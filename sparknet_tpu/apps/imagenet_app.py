@@ -8,11 +8,18 @@ solver.prototxt`).
 
 Ingest: sharded-tar loader (host-sharded), native C++ JPEG plane when built.
 Mean image is computed over the decoded corpus (the reference did a
-full-image RDD reduce, `ImageNetApp.scala:66-69`). The decoded uint8 corpus
-is cached in host RAM and rounds sample windows from it — suitable up to
-RAM-sized subsets; a streaming re-decode path for full-ImageNet-on-one-host
-is future work (at pod scale, per-host shard assignment keeps each host's
-slice RAM-sized).
+full-image RDD reduce, `ImageNetApp.scala:66-69`).
+
+Two corpus modes, chosen by `--stream {auto,always,never}` against
+`--ram-budget-mb`:
+  - cached: decode this host's shards once into RAM; rounds draw random
+    windows (reference `repartition().cache()` semantics). Fast resample,
+    RAM-bounded.
+  - streaming: never materialize — a background thread decodes the shard
+    stream round-by-round (`data.streaming.StreamingRoundSource`), the
+    reference's actual ImageNet data motion (one-partition-per-tar,
+    `loaders/ImageNetLoader.scala:59-91`); host RAM holds ~3 rounds of
+    pixels regardless of corpus size, and decode overlaps device compute.
 """
 from __future__ import annotations
 
@@ -25,6 +32,7 @@ import numpy as np
 from ..data import imagenet
 from ..data.dataset import ArrayDataset
 from ..data.preprocess import ImagePreprocessor, compute_mean_image
+from ..data.streaming import StreamingRoundSource, streaming_sum_count
 from ..parallel import initialize_multihost
 from ..parallel.mesh import host_id_count
 from ..schema import Field, Schema
@@ -43,31 +51,58 @@ def default_config() -> RunConfig:
         eval_every=10, max_rounds=1000, precision="bfloat16")
 
 
-def load_corpus(cfg: RunConfig, split_prefix: str, label_file: str,
+def host_loader(cfg: RunConfig, split_prefix: str, label_file: str,
                 host_id: int = 0, host_count: int = 1
-                ) -> Tuple[np.ndarray, np.ndarray]:
+                ) -> imagenet.ShardedTarLoader:
     shards = imagenet.host_shards(
         imagenet.list_shards(cfg.data_dir, prefix=split_prefix),
         host_id, host_count)
     labels = imagenet.load_label_map(f"{cfg.data_dir}/{label_file}")
-    loader = imagenet.ShardedTarLoader(shards, labels, height=256, width=256)
-    return loader.load_all()
+    return imagenet.ShardedTarLoader(shards, labels, height=256, width=256)
 
 
-def _global_mean_image(images: np.ndarray, host_count: int) -> np.ndarray:
-    """Mean image over the GLOBAL train set. The reference reduced full
-    images across the whole RDD (`ImageNetApp.scala:66-69`); with host-
-    sharded corpora each host contributes its (sum, count) and the weighted
-    mean is identical on every host — per-host means would silently diverge
-    the preprocessing."""
+def load_corpus(cfg: RunConfig, split_prefix: str, label_file: str,
+                host_id: int = 0, host_count: int = 1
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    return host_loader(cfg, split_prefix, label_file,
+                       host_id, host_count).load_all()
+
+
+def _should_stream(mode: str, n_host_images: float, budget_mb: int,
+                   height: int = 256, width: int = 256) -> bool:
+    """auto: estimate this host's decoded-corpus peak RAM. Force-resize
+    makes every decoded image exactly height*width*3 bytes regardless of
+    its JPEG size, and load_all's list-then-stack doubles the peak, so the
+    estimate is (images/host) * bytes/image * 2 — grounded in the label
+    map's own image count, not tar byte sizes (JPEG compression ratios vary
+    4-8x across quality settings)."""
+    if mode in ("always", "never"):
+        return mode == "always"
+    decoded = n_host_images * (height * width * 3) * 2
+    return decoded > budget_mb * (1 << 20)
+
+
+def _combine_mean(local_sum: np.ndarray, local_count: float,
+                  host_count: int) -> np.ndarray:
+    """Global mean image from per-host (sum, count). The reference reduced
+    full images across the whole RDD (`ImageNetApp.scala:66-69`); per-host
+    means would silently diverge the preprocessing, so hosts combine the
+    weighted sums."""
     if host_count == 1:
-        return compute_mean_image(images)
+        return (local_sum / local_count).astype(np.float32)
     from jax.experimental import multihost_utils
-    local = np.stack([images.sum(axis=0, dtype=np.float64),
-                      np.full(images.shape[1:], float(len(images)))])
+    local = np.stack([local_sum,
+                      np.full(local_sum.shape, float(local_count))])
     gathered = multihost_utils.process_allgather(local)  # [pc, 2, ...]
     total, count = gathered[:, 0].sum(axis=0), gathered[:, 1].sum(axis=0)
     return (total / count).astype(np.float32)
+
+
+def _global_mean_image(images: np.ndarray, host_count: int) -> np.ndarray:
+    if host_count == 1:
+        return compute_mean_image(images)
+    return _combine_mean(images.sum(axis=0, dtype=np.float64),
+                         float(len(images)), host_count)
 
 
 def _agree_eval_dataset(test_ds, host_count: int):
@@ -95,6 +130,14 @@ def main(argv=None) -> None:
     p.add_argument("--val-prefix", default="val.")
     p.add_argument("--train-labels", default="train.txt")
     p.add_argument("--val-labels", default="val.txt")
+    p.add_argument("--stream", choices=("auto", "always", "never"),
+                   default="auto", help="corpus mode: stream shards vs "
+                   "cache decoded pixels in RAM (auto: by --ram-budget-mb)")
+    p.add_argument("--ram-budget-mb", type=int, default=8192,
+                   help="decoded-corpus RAM budget per host for --stream=auto")
+    p.add_argument("--val-limit", type=int, default=0,
+                   help="cap resident val examples per host (0 = all); the "
+                   "val split is held as uint8, ~192 KiB per image")
     p.add_argument("overrides", nargs="*")
     args = p.parse_args(argv)
     initialize_multihost()  # BEFORE any other JAX use (mesh.py:49)
@@ -107,9 +150,25 @@ def main(argv=None) -> None:
     # each host streams only ITS tar shards (shards i::k to host i of k —
     # the reference's one-Spark-partition-per-tar, keyed by process index)
     pi, pc = host_id_count()
-    images, labels = load_corpus(cfg, args.train_prefix, args.train_labels,
-                                 host_id=pi, host_count=pc)
-    mean = _global_mean_image(images, pc) if cfg.subtract_mean else None
+    train_loader = host_loader(cfg, args.train_prefix, args.train_labels,
+                               host_id=pi, host_count=pc)
+    streaming = _should_stream(args.stream,
+                               len(train_loader.label_map) / pc,
+                               args.ram_budget_mb)
+    if streaming:
+        images = labels = None
+        if cfg.subtract_mean:
+            # one extra streaming pass for the global mean reduce; never
+            # holds more than one decoded image + the float64 accumulator
+            s, n = streaming_sum_count(train_loader)
+            mean = _combine_mean(s, float(n), pc)
+        else:
+            mean = None
+        print(f"imagenet_app: streaming corpus on host {pi} "
+              f"({len(train_loader.shard_paths)} shards)", file=sys.stderr)
+    else:
+        images, labels = train_loader.load_all()
+        mean = _global_mean_image(images, pc) if cfg.subtract_mean else None
     crop = cfg.crop or 227
     # schema describes the preprocessor OUTPUT: NHWC device layout
     schema = Schema(Field("data", "float32", (crop, crop, 3)),
@@ -120,15 +179,32 @@ def main(argv=None) -> None:
                                 seed=cfg.seed)
 
     # Preprocessing happens per-round on the sampled window (crop is
-    # per-epoch random); wrap the sampler output via a dataset of raw uint8
-    # and a round_transform in the loop by pre-transforming eagerly here.
-    train_raw = ArrayDataset({"data": images, "label": labels[:, None]})
+    # per-epoch random): the loop's prefetch thread applies pp_train to each
+    # round while the previous round trains. Streaming mode swaps the RAM
+    # dataset for the background-decode source; the loop is identical.
+    if streaming:
+        import jax
+        n_local = (jax.local_device_count() if cfg.n_devices is None
+                   else max(1, cfg.n_devices // pc))
+        train_raw = StreamingRoundSource(
+            imagenet.ShardedTarLoader(  # fresh stream (mean pass consumed one)
+                train_loader.shard_paths, train_loader.label_map,
+                height=256, width=256),
+            n_local, cfg.local_batch, cfg.tau)
+    else:
+        train_raw = ArrayDataset({"data": images, "label": labels[:, None]})
     try:
         val_images, val_labels = load_corpus(cfg, args.val_prefix,
                                              args.val_labels,
                                              host_id=pi, host_count=pc)
-        test_ds = ArrayDataset(pp_eval.convert_batch(
-            {"data": val_images, "label": val_labels[:, None]}, train=False))
+        if args.val_limit:
+            val_images = val_images[:args.val_limit]
+            val_labels = val_labels[:args.val_limit]
+        # RAW uint8 — pp_eval runs per eval batch inside the loop, so the
+        # resident val cost is bounded by the uint8 pixels (the float32
+        # conversion of the whole split would be ~6x larger)
+        test_ds = ArrayDataset({"data": val_images,
+                                "label": val_labels[:, None]})
     except (FileNotFoundError, ValueError) as e:
         # no val split — or fewer val tars than hosts left THIS host empty.
         # Say WHY: a malformed val.txt also lands here and must not look
@@ -142,7 +218,8 @@ def main(argv=None) -> None:
     cfg.crop = crop
     spec = resolve_spec(cfg, data=(cfg.local_batch, 3, crop, crop),
                         label=(cfg.local_batch, 1))
-    train(cfg, spec, train_raw, test_ds, batch_transform=pp_train)
+    train(cfg, spec, train_raw, test_ds, batch_transform=pp_train,
+          eval_transform=pp_eval)
 
 
 if __name__ == "__main__":

@@ -14,6 +14,7 @@ termination condition (max_rounds instead of `while(true)`).
 """
 from __future__ import annotations
 
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -83,7 +84,7 @@ def train(cfg: RunConfig, spec: NetSpec, train_ds: ArrayDataset,
           test_ds: Optional[ArrayDataset] = None,
           logger: Optional[Logger] = None,
           round_hook: Optional[Callable[[int, TrainState], None]] = None,
-          batch_transform=None) -> TrainState:
+          batch_transform=None, eval_transform=None) -> TrainState:
     """Run the full distributed training loop per cfg (layer-IR backend).
     Returns final state."""
     log = logger or default_logger(cfg.workdir)
@@ -98,17 +99,18 @@ def train(cfg: RunConfig, spec: NetSpec, train_ds: ArrayDataset,
             f"local_batch={cfg.local_batch} precision={cfg.precision}")
     if batch_transform is None:
         train_ds = _to_device_layout(train_ds, net)
-    if test_ds is not None:
+    if test_ds is not None and eval_transform is None:
         test_ds = _to_device_layout(test_ds, net)
     return run_loop(cfg, trainer, train_ds, test_ds, log,
                     batch_transform=batch_transform,
+                    eval_transform=eval_transform,
                     probe=lambda s: probe_value(s, net),
                     round_hook=round_hook)
 
 
 def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
              test_ds: Optional[ArrayDataset], log: Logger,
-             batch_transform=None,
+             batch_transform=None, eval_transform=None,
              probe: Optional[Callable[[Any], float]] = None,
              round_hook=None):
     """The reference app loop, generic over the trainer backend: any object
@@ -123,15 +125,30 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     expects checkpoint_dir on a filesystem all hosts can read). Eval is a
     collective: all hosts must agree on test_ds presence and SIZE
     (ArrayDataset.host_shard splits are exactly equal; uneven sources must
-    reconcile first — see imagenet_app._agree_eval_dataset)."""
+    reconcile first — see imagenet_app._agree_eval_dataset).
+
+    `train_ds` may instead be any round SOURCE — an object with
+    `next_round(round_index=...)` (e.g. `data.streaming.StreamingRoundSource`
+    for corpora larger than host RAM); sampling/decoding then happens in the
+    source's own pipeline. Either way, host-side round preparation (sampling
+    + `batch_transform` preprocessing) for round R+1 is overlapped with
+    round R's device compute via a one-deep prefetch thread — the reference
+    prepared batches inline on each executor and stalled the GPU every
+    round."""
     n_dev = trainer.n_devices
     n_local = getattr(trainer, "n_local_devices", n_dev)
-    sampler = RoundSampler(train_ds, n_local, cfg.local_batch, cfg.tau,
-                           seed=cfg.seed)
-    log.log(f"train examples: {len(train_ds)} on this host "
-            f"({len(train_ds) // n_local} per worker; "
-            f"{n_dev} devices / {n_local} local)"
-            + (f"; test examples: {len(test_ds)}" if test_ds else ""))
+    if hasattr(train_ds, "next_round"):
+        source = train_ds
+        log.log(f"train source: streaming ({n_dev} devices / {n_local} "
+                f"local)" + (f"; test examples: {len(test_ds)}"
+                             if test_ds else ""))
+    else:
+        source = RoundSampler(train_ds, n_local, cfg.local_batch, cfg.tau,
+                              seed=cfg.seed)
+        log.log(f"train examples: {len(train_ds)} on this host "
+                f"({len(train_ds) // n_local} per worker; "
+                f"{n_dev} devices / {n_local} local)"
+                + (f"; test examples: {len(test_ds)}" if test_ds else ""))
 
     state = trainer.init_state(jax.random.PRNGKey(cfg.seed))
     start_round = 0
@@ -148,47 +165,66 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     # schedule exactly (reference had no resume at all, SURVEY §5.3)
     base_rng = jax.random.PRNGKey(cfg.seed ^ 0xABCD)
 
-    for rnd in range(start_round, cfg.max_rounds):
-        if test_ds is not None and cfg.eval_every and \
-                rnd % cfg.eval_every == 0:
-            with timers.phase("eval"):
-                acc = _evaluate(trainer, state, test_ds, cfg.eval_batch,
-                                n_local)
-            log.log(f"test accuracy: {acc:.4f}", rnd)
-            log.metrics(rnd, test_accuracy=acc)
+    def prepare_round(rnd: int) -> Dict[str, np.ndarray]:
+        batches = source.next_round(round_index=rnd)
+        if batch_transform is not None:
+            # per-τ-slice preprocessing (e.g. fresh random crops): each
+            # slice is one (N, ...) global batch to the preprocessor.
+            # Round-keyed rng so resume reproduces identical crops.
+            slices = [batch_transform.convert_batch(
+                {k: v[t] for k, v in batches.items()}, train=True,
+                rng=np.random.default_rng((cfg.seed, rnd, t)))
+                for t in range(cfg.tau)]
+            batches = {k: np.stack([s[k] for s in slices])
+                       for k in slices[0]}
+        return batches
 
-        with timers.phase("sample"):
-            batches = sampler.next_round(round_index=rnd)
-            if batch_transform is not None:
-                # per-τ-slice preprocessing (e.g. fresh random crops): each
-                # slice is one (N, ...) global batch to the preprocessor.
-                # Round-keyed rng so resume reproduces identical crops.
-                slices = [batch_transform.convert_batch(
-                    {k: v[t] for k, v in batches.items()}, train=True,
-                    rng=np.random.default_rng((cfg.seed, rnd, t)))
-                    for t in range(cfg.tau)]
-                batches = {k: np.stack([s[k] for s in slices])
-                           for k in slices[0]}
-        sub = jax.random.fold_in(base_rng, rnd)
-        before = timers.total.get("train_round", 0.0)
-        with timers.phase("train_round"):
-            state, loss = trainer.train_round(state, batches, sub)
-            loss = float(loss)  # D2H fetch = real synchronization
-        round_dt = timers.total["train_round"] - before
-        n_images = cfg.tau * cfg.local_batch * n_dev
-        meter.add(n_images, round_dt)
-        probe_txt = f"  probe: {probe(state):.6f}" if probe else ""
-        log.log(f"round loss: {loss:.4f}{probe_txt}", rnd)
-        log.metrics(rnd, loss=loss, images_per_sec_per_chip=round(
-            meter.images_per_sec_per_chip(), 2))
+    # one-deep host prefetch: round R+1 is sampled/decoded/preprocessed on
+    # this thread pool while round R's XLA program runs. The "sample" phase
+    # then measures only the residual WAIT — ~0 when prep fully overlaps.
+    prefetch = ThreadPoolExecutor(1, thread_name_prefix="round-prep")
+    pending: Optional[Any] = None
+    try:
+        for rnd in range(start_round, cfg.max_rounds):
+            if test_ds is not None and cfg.eval_every and \
+                    rnd % cfg.eval_every == 0:
+                with timers.phase("eval"):
+                    acc = _evaluate(trainer, state, test_ds, cfg.eval_batch,
+                                    n_local, transform=eval_transform)
+                log.log(f"test accuracy: {acc:.4f}", rnd)
+                log.metrics(rnd, test_accuracy=acc)
 
-        if cfg.checkpoint_dir and cfg.checkpoint_every and \
-                (rnd + 1) % cfg.checkpoint_every == 0:
-            with timers.phase("checkpoint"):
-                _save_checkpoint(cfg, state, rnd + 1)
-            log.log("checkpoint saved", rnd)
-        if round_hook:
-            round_hook(rnd, state)
+            with timers.phase("sample"):
+                batches = (pending.result() if pending is not None
+                           else prepare_round(rnd))
+            if rnd + 1 < cfg.max_rounds:
+                pending = prefetch.submit(prepare_round, rnd + 1)
+            sub = jax.random.fold_in(base_rng, rnd)
+            before = timers.total.get("train_round", 0.0)
+            with timers.phase("train_round"):
+                state, loss = trainer.train_round(state, batches, sub)
+                loss = float(loss)  # D2H fetch = real synchronization
+            round_dt = timers.total["train_round"] - before
+            n_images = cfg.tau * cfg.local_batch * n_dev
+            meter.add(n_images, round_dt)
+            probe_txt = f"  probe: {probe(state):.6f}" if probe else ""
+            log.log(f"round loss: {loss:.4f}{probe_txt}", rnd)
+            log.metrics(rnd, loss=loss, images_per_sec_per_chip=round(
+                meter.images_per_sec_per_chip(), 2))
+
+            if cfg.checkpoint_dir and cfg.checkpoint_every and \
+                    (rnd + 1) % cfg.checkpoint_every == 0:
+                with timers.phase("checkpoint"):
+                    _save_checkpoint(cfg, state, rnd + 1)
+                log.log("checkpoint saved", rnd)
+            if round_hook:
+                round_hook(rnd, state)
+    finally:
+        if pending is not None:
+            pending.cancel()
+        prefetch.shutdown(wait=False, cancel_futures=True)
+        if hasattr(source, "close"):
+            source.close()
 
     if cfg.checkpoint_dir:
         _save_checkpoint(cfg, state, cfg.max_rounds, retain=False)
@@ -226,28 +262,37 @@ def _to_device_layout(ds: ArrayDataset, net: CompiledNet) -> ArrayDataset:
 
 
 def _evaluate(trainer, state, test_ds: ArrayDataset, eval_batch: int,
-              n_dev: int) -> float:
+              n_dev: int, transform=None) -> float:
     """Distributed eval (reference `CifarApp.scala:107-124`), covering every
     example except at most n_dev-1 trailing ones (batches must split evenly
     across devices): the tail past the last full eval_batch is evaluated as
     one smaller batch (a second compiled shape, amortized across rounds) and
-    weighted by its real size."""
+    weighted by its real size.
+
+    `transform` preprocesses each eval batch lazily (train=False — e.g.
+    center crop + mean subtract on raw uint8 pixels), so only one batch of
+    float32 pixels ever exists at a time — the whole-split float32
+    materialization would be ~6x the uint8 corpus."""
     eval_batch = min(eval_batch, len(test_ds))
     eval_batch = max(n_dev, (eval_batch // n_dev) * n_dev)
     if len(test_ds) < eval_batch:
         raise ValueError(
             f"test set ({len(test_ds)}) smaller than {n_dev} devices' "
             f"minimum eval batch")
+
+    def run(lo: int, n: int) -> float:
+        batch = {k: v[lo:lo + n] for k, v in test_ds.arrays.items()}
+        if transform is not None:
+            batch = transform.convert_batch(batch, train=False)
+        return trainer.evaluate(state, batch) * n
+
     total, count = 0.0, 0
     n_full = (len(test_ds) // eval_batch) * eval_batch
     for i in range(0, n_full, eval_batch):
-        batch = {k: v[i:i + eval_batch] for k, v in test_ds.arrays.items()}
-        total += trainer.evaluate(state, batch) * eval_batch
+        total += run(i, eval_batch)
         count += eval_batch
     tail = ((len(test_ds) - n_full) // n_dev) * n_dev
     if tail:
-        batch = {k: v[n_full:n_full + tail]
-                 for k, v in test_ds.arrays.items()}
-        total += trainer.evaluate(state, batch) * tail
+        total += run(n_full, tail)
         count += tail
     return total / max(count, 1)

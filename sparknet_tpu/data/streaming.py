@@ -1,0 +1,155 @@
+"""Streaming round source: train from tar shards larger than host RAM.
+
+The reference never materialized ImageNet — each Spark task streamed its tar
+and trained on what it read (`loaders/ImageNetLoader.scala:59-91`, one
+partition per tar). This is that data motion, mesh-native: a background
+thread streams + decodes this HOST's shards (via `ShardedTarLoader`, which
+already fans decode out over OpenMP) and assembles τ-round batch arrays into
+a bounded queue, so round R+1's window is decoded while round R trains on
+device. Host RAM holds only `prefetch_rounds + 1` rounds of decoded pixels,
+never the corpus.
+
+Semantics vs the in-RAM `RoundSampler`:
+  - windows are consecutive stream positions, not random offsets into a
+    cached partition — exactly the reference's behavior for its streamed
+    (non-cached) datasets; shards cycle forever (epoch boundaries are
+    invisible, like the reference's `.repeat()`-style requeue).
+  - `round_index` is accepted for API compatibility but does not key the
+    sampling: a resumed run re-streams from shard 0 rather than seeking to
+    the interrupted stream position (the reference had no resume at all).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .imagenet import ShardedTarLoader
+
+
+def streaming_sum_count(loader: ShardedTarLoader
+                        ) -> Tuple[np.ndarray, int]:
+    """One streaming pass over the shards -> (per-pixel float64 sum CHW,
+    count). The mean-image reduce (`ImageNetApp.scala:66-69`) without ever
+    materializing the corpus; hosts combine (sum, count) pairs for the
+    global mean."""
+    total: Optional[np.ndarray] = None
+    count = 0
+    for img, _ in loader:
+        if total is None:
+            total = np.zeros(img.shape, np.float64)
+        total += img
+        count += 1
+    if count == 0:
+        raise ValueError(f"no decodable labeled images in "
+                         f"{loader.shard_paths}")
+    return total, count
+
+
+class StreamingRoundSource:
+    """Bounded-prefetch producer of τ-round batches from tar shards.
+
+    `next_round()` returns the same layout `RoundSampler.next_round` does —
+    {field: [tau, n_workers*local_batch, ...]} with the batch axis blocked by
+    worker, each worker's block a consecutive run of tau*local_batch stream
+    examples (its "window"). Raw uint8 CHW + int32 labels; per-round
+    preprocessing (mean/crop/NHWC) stays in the training loop.
+    """
+
+    def __init__(self, loader: ShardedTarLoader, n_workers: int,
+                 local_batch: int, tau: int, prefetch_rounds: int = 2):
+        self.loader = loader
+        self.n_workers = n_workers
+        self.local_batch = local_batch
+        self.tau = tau
+        self.round_examples = n_workers * local_batch * tau
+        self.epochs = 0  # completed passes over the shard set
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, prefetch_rounds))
+        self._stop = threading.Event()
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._produce, name="stream-decode", daemon=True)
+        self._thread.start()
+
+    # -- producer (background thread) ---------------------------------------
+
+    def _produce(self) -> None:
+        try:
+            imgs, lbls = [], []
+            while not self._stop.is_set():
+                n_before = 0
+                for img, label in self.loader:
+                    n_before += 1
+                    imgs.append(img)
+                    lbls.append(label)
+                    if len(imgs) == self.round_examples:
+                        if not self._put(self._assemble(imgs, lbls)):
+                            return
+                        imgs, lbls = [], []
+                    if self._stop.is_set():
+                        return
+                if n_before == 0:
+                    raise ValueError(
+                        f"no decodable labeled images in "
+                        f"{self.loader.shard_paths}")
+                self.epochs += 1  # wrap: stream the shards again
+        except BaseException as e:  # surface in the consumer
+            self._err = e
+            self._stop.set()
+
+    def _assemble(self, imgs, lbls) -> Dict[str, np.ndarray]:
+        # consecutive tau*B run per worker -> [W, tau, B, ...] -> [tau, W*B, ...]
+        w, b, t = self.n_workers, self.local_batch, self.tau
+        data = np.stack(imgs).reshape((w, t, b) + imgs[0].shape)
+        labels = np.asarray(lbls, np.int32).reshape(w, t, b)
+        return {
+            "data": np.ascontiguousarray(
+                data.transpose((1, 0, 2) + tuple(range(3, data.ndim)))
+                .reshape((t, w * b) + imgs[0].shape)),
+            "label": labels.transpose(1, 0, 2).reshape(t, w * b, 1),
+        }
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # -- consumer ------------------------------------------------------------
+
+    def next_round(self, round_index: Optional[int] = None
+                   ) -> Dict[str, np.ndarray]:
+        while True:
+            if self._err is not None:
+                raise RuntimeError("streaming decode thread failed") \
+                    from self._err
+            try:
+                return self._q.get(timeout=0.1)
+            except queue.Empty:
+                if self._stop.is_set() and self._err is None:
+                    raise RuntimeError("streaming source closed")
+
+    @property
+    def skipped(self) -> int:
+        return self.loader.skipped
+
+    def close(self) -> None:
+        self._stop.set()
+        # drain so a blocked producer put() sees the stop promptly
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "StreamingRoundSource":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

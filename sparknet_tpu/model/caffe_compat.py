@@ -50,12 +50,24 @@ def collection_to_params(net: CompiledNet, coll: WeightCollection) -> PyTree:
         lp: Dict[str, jnp.ndarray] = {}
         w = blobs[0]
         if layer.type == "Convolution":
+            if w.ndim != 4:
+                raise ValueError(f"{layer.name}: conv weight must be 4-D "
+                                 f"OIHW, got {w.shape}")
             lp["w"] = jnp.asarray(np.transpose(w, (2, 3, 1, 0)))  # OIHW -> HWIO
         elif layer.type == "InnerProduct":
+            # legacy .caffemodel IP weights arrive 4-D (1,1,out,in); a
+            # num_output=1 legacy blob canonicalized to a (in,) vector
+            if w.ndim == 4:
+                if w.shape[:2] != (1, 1):
+                    raise ValueError(f"{layer.name}: 4-D inner-product "
+                                     f"weight {w.shape} is not (1,1,out,in)")
+                w = w.reshape(w.shape[2:])
+            elif w.ndim == 1:
+                w = w.reshape(1, -1)
             lp["w"] = jnp.asarray(np.ascontiguousarray(w.T))
         else:
             lp["w"] = jnp.asarray(w)
         if len(blobs) > 1:
-            lp["b"] = jnp.asarray(blobs[1])
+            lp["b"] = jnp.asarray(blobs[1].reshape(-1))
         params[layer.name] = lp
     return params

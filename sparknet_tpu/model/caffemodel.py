@@ -72,10 +72,12 @@ def _parse_blob(buf: bytes) -> np.ndarray:
     else:
         legacy = [f.get(i) for i in (1, 2, 3, 4)]
         dims = [v[-1][1] for v in legacy if v is not None]
-        # legacy blobs are conceptually 4-D with leading 1s; drop them the
-        # way Caffe's shape() canonicalization does for vectors
-        while len(dims) > 1 and dims[0] == 1:
-            dims = dims[1:]
+        # Caffe keeps legacy blobs 4-D (Blob::FromProto); only pure VECTORS
+        # ((1,1,1,N) biases) canonicalize to (N,). Stripping leading 1s from
+        # anything wider would corrupt e.g. a num_output=1 conv (1,C,H,W) —
+        # layer-aware reshaping happens in caffe_compat, which knows types.
+        if len(dims) > 1 and int(np.prod(dims[:-1])) == 1:
+            dims = dims[-1:]
     if dims:
         if int(np.prod(dims)) != arr.size:
             raise ValueError(f"blob shape {dims} != {arr.size} values")

@@ -156,19 +156,29 @@ def _filler(msg: Message | None) -> Filler:
     )
 
 
-def _reject_unimplemented(block: "Message", layer_name: str, block_name: str,
-                          fields: Tuple[str, ...]) -> None:
-    """Fail loudly on RECOGNIZED Caffe fields this importer does not
-    implement (e.g. rectangular kernel_h/kernel_w geometry): a prototxt
-    using them would otherwise import with defaults and train a structurally
-    wrong net — same fail-loudly stance as unknown layer types and non-SGD
-    solvers."""
-    present = [f for f in fields if _one(block, f) is not None]
-    if present:
+def _square_geometry(block: "Message", layer_name: str, block_name: str,
+                     base: str, default: int) -> int:
+    """Resolve `<base>` vs `<base>_h`/`<base>_w` (Caffe allows either form).
+    Square h==w values fold into the base field; genuinely RECTANGULAR
+    geometry fails loudly — importing it with defaults would train a
+    structurally wrong net (same stance as unknown layer types and non-SGD
+    solvers)."""
+    bv = _one(block, base)
+    stem = base[:-5] if base.endswith("_size") else base  # kernel_size -> kernel_h
+    hv, wv = _one(block, f"{stem}_h"), _one(block, f"{stem}_w")
+    if hv is None and wv is None:
+        return int(bv) if bv is not None else default
+    if hv is None or wv is None or int(hv) != int(wv):
         raise ValueError(
-            f"layer {layer_name!r}: {block_name} field(s) {present} are "
-            f"recognized but not implemented (square geometry only) — "
-            f"refusing to import a structurally different net silently")
+            f"layer {layer_name!r}: {block_name} {stem}_h/{stem}_w = "
+            f"({hv}, {wv}) is rectangular — recognized but not implemented "
+            f"(square geometry only); refusing to import a structurally "
+            f"different net silently")
+    if bv is not None and int(bv) != int(hv):
+        raise ValueError(
+            f"layer {layer_name!r}: {block_name} specifies both {base}={bv} "
+            f"and {base}_h/{base}_w={hv} with conflicting values")
+    return int(hv)
 
 
 def _layer_from_msg(m: Message) -> LayerSpec:
@@ -192,9 +202,6 @@ def _layer_from_msg(m: Message) -> LayerSpec:
     kw: Dict[str, Any] = {}
     cp = _one(m, "convolution_param")
     if cp:
-        _reject_unimplemented(cp, name, "convolution_param",
-                              ("kernel_h", "kernel_w", "stride_h", "stride_w",
-                               "pad_h", "pad_w"))
         if int(_one(cp, "dilation", 1)) != 1:
             raise ValueError(
                 f"layer {name!r}: convolution_param.dilation is recognized "
@@ -202,9 +209,11 @@ def _layer_from_msg(m: Message) -> LayerSpec:
                 f"different net silently")
         kw["conv"] = ConvolutionParam(
             num_output=int(_one(cp, "num_output", 0)),
-            kernel_size=int(_one(cp, "kernel_size", 1)),
-            stride=int(_one(cp, "stride", 1)),
-            pad=int(_one(cp, "pad", 0)),
+            kernel_size=_square_geometry(cp, name, "convolution_param",
+                                         "kernel_size", 1),
+            stride=_square_geometry(cp, name, "convolution_param",
+                                    "stride", 1),
+            pad=_square_geometry(cp, name, "convolution_param", "pad", 0),
             group=int(_one(cp, "group", 1)),
             bias_term=bool(_one(cp, "bias_term", True)),
             weight_filler=_filler(_one(cp, "weight_filler")),
@@ -212,14 +221,12 @@ def _layer_from_msg(m: Message) -> LayerSpec:
         )
     pp = _one(m, "pooling_param")
     if pp:
-        _reject_unimplemented(pp, name, "pooling_param",
-                              ("kernel_h", "kernel_w", "stride_h", "stride_w",
-                               "pad_h", "pad_w"))
         kw["pool"] = PoolingParam(
             pool=str(_one(pp, "pool", "MAX")),
-            kernel_size=int(_one(pp, "kernel_size", 1)),
-            stride=int(_one(pp, "stride", 1)),
-            pad=int(_one(pp, "pad", 0)),
+            kernel_size=_square_geometry(pp, name, "pooling_param",
+                                         "kernel_size", 1),
+            stride=_square_geometry(pp, name, "pooling_param", "stride", 1),
+            pad=_square_geometry(pp, name, "pooling_param", "pad", 0),
             global_pooling=bool(_one(pp, "global_pooling", False)),
         )
     lp = _one(m, "lrn_param")

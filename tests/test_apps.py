@@ -170,3 +170,39 @@ def test_evaluate_covers_tail(tmp_path):
     acc = _evaluate(t, None, ds, eval_batch=32, n_dev=2)
     assert t.calls == [32, 18]
     assert acc == pytest.approx(32 / 50)
+
+
+def test_streaming_source_through_train_loop(tmp_path):
+    """Train the layer-IR backend from a StreamingRoundSource end to end:
+    the corpus is never materialized (decode thread feeds the loop's
+    prefetcher), preprocessing runs per round, loss is finite, and the
+    source is closed by the loop."""
+    from sparknet_tpu.data import imagenet
+    from sparknet_tpu.data.streaming import StreamingRoundSource
+    from sparknet_tpu.data.preprocess import ImagePreprocessor
+    from sparknet_tpu.schema import Field, Schema
+    from sparknet_tpu.model.spec import NetSpec
+    from sparknet_tpu import zoo
+    import jax
+
+    root = str(tmp_path / "shards")
+    label_path = imagenet.write_synthetic_shards(root, n_shards=2,
+                                                 per_shard=40, size=36)
+    loader = imagenet.ShardedTarLoader(
+        imagenet.list_shards(root), imagenet.load_label_map(label_path),
+        height=36, width=36)
+    n_local, local_b, tau = jax.local_device_count(), 1, 2
+    src = StreamingRoundSource(loader, n_local, local_b, tau)
+    crop = 32
+    schema = Schema(Field("data", "float32", (crop, crop, 3)),
+                    Field("label", "int32", (1,)))
+    pp = ImagePreprocessor(schema, mean_image=None, crop=crop, seed=0)
+    cfg = small_cfg(tmp_path, local_batch=local_b, tau=tau, max_rounds=3,
+                    eval_every=0, crop=crop)
+    log_path = str(tmp_path / "slog.txt")
+    state = train(cfg, cifar10_quick(batch=local_b), src,
+                  logger=Logger(log_path, echo=False), batch_transform=pp)
+    assert state is not None
+    text = open(log_path).read()
+    assert "streaming" in text and "round loss" in text
+    assert src._stop.is_set()  # loop closed the source

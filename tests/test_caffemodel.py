@@ -81,3 +81,30 @@ def test_shape_value_mismatch_fails_loudly():
         _len_delim(7, bad_blob)
     with pytest.raises(ValueError, match="shape"):
         load_caffemodel(_len_delim(100, layer))
+
+
+def test_legacy_num_output_one_blobs():
+    """Legacy 4-D blobs with num=1 keep their shape (a (1,C,H,W) conv head
+    must NOT collapse to 3-D); only pure vectors (1,1,1,N) canonicalize
+    (r2 review finding)."""
+    w = np.arange(12, dtype=np.float32).reshape(1, 3, 2, 2)
+    layer = (_len_delim(4, b"head") + _tag(5, 0) + _varint(4) +
+             _len_delim(6, _legacy_blob(w, (1, 3, 2, 2))))
+    coll = load_caffemodel(_len_delim(1, b"n") + _len_delim(2, layer))
+    assert coll["head"][0].shape == (1, 3, 2, 2)
+    # legacy IP weight (1,1,out,in) feeds collection_to_params as 4-D
+    from sparknet_tpu.model.caffe_compat import collection_to_params
+    from sparknet_tpu.model.net import CompiledNet
+    from sparknet_tpu.model.spec import (InnerProductParam, InputSpec,
+                                         LayerSpec, NetSpec)
+    spec = NetSpec(name="t", inputs=(InputSpec("data", (2, 5)),), layers=(
+        LayerSpec(name="ip", type="InnerProduct", bottoms=("data",),
+                  tops=("ip",),
+                  inner_product=InnerProductParam(num_output=3)),))
+    net = CompiledNet.compile(spec)
+    wip = np.arange(15, dtype=np.float32).reshape(1, 1, 3, 5)
+    params = collection_to_params(net, WeightCollection(
+        {"ip": [wip, np.zeros(3, np.float32)]}, ["ip"]))
+    assert params["ip"]["w"].shape == (5, 3)  # (out,in) -> (in,out)
+    np.testing.assert_array_equal(np.asarray(params["ip"]["w"]),
+                                  wip.reshape(3, 5).T)

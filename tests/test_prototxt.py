@@ -155,3 +155,25 @@ def test_unimplemented_geometry_fields_rejected():
     """
     with pytest.raises(ValueError, match="Concat axis"):
         net_from_prototxt(concat_bad)
+
+
+def test_square_h_w_geometry_accepted():
+    """kernel_h==kernel_w (etc.) is the SAME square geometry as kernel_size
+    and must import, not be rejected (r2 review finding); conflicting
+    base-vs-h/w values still fail."""
+    from sparknet_tpu.model.prototxt import net_from_prototxt
+    base = """
+    name: "g"
+    input: "data"
+    input_shape { dim: 1 dim: 3 dim: 8 dim: 8 }
+    layer {
+      name: "c" type: "Convolution" bottom: "data" top: "c"
+      convolution_param { num_output: 4 %s }
+    }
+    """
+    spec = net_from_prototxt(base % "kernel_h: 3 kernel_w: 3 pad_h: 1 pad_w: 1")
+    conv = [l for l in spec.layers if l.name == "c"][0]
+    assert conv.conv.kernel_size == 3 and conv.conv.pad == 1
+    import pytest
+    with pytest.raises(ValueError, match="conflicting"):
+        net_from_prototxt(base % "kernel_size: 5 kernel_h: 3 kernel_w: 3")
