@@ -62,10 +62,10 @@ def host_loader(cfg: RunConfig, split_prefix: str, label_file: str,
 
 
 def load_corpus(cfg: RunConfig, split_prefix: str, label_file: str,
-                host_id: int = 0, host_count: int = 1
-                ) -> Tuple[np.ndarray, np.ndarray]:
+                host_id: int = 0, host_count: int = 1,
+                limit: int = 0) -> Tuple[np.ndarray, np.ndarray]:
     return host_loader(cfg, split_prefix, label_file,
-                       host_id, host_count).load_all()
+                       host_id, host_count).load_all(limit or None)
 
 
 def _should_stream(mode: str, n_host_images: float, budget_mb: int,
@@ -186,20 +186,19 @@ def main(argv=None) -> None:
         import jax
         n_local = (jax.local_device_count() if cfg.n_devices is None
                    else max(1, cfg.n_devices // pc))
-        train_raw = StreamingRoundSource(
-            imagenet.ShardedTarLoader(  # fresh stream (mean pass consumed one)
-                train_loader.shard_paths, train_loader.label_map,
-                height=256, width=256),
-            n_local, cfg.local_batch, cfg.tau)
+        # the loader re-opens its tars on each iteration, so the mean pass
+        # and the training stream share it (and its skipped counter)
+        train_raw = StreamingRoundSource(train_loader, n_local,
+                                         cfg.local_batch, cfg.tau)
     else:
         train_raw = ArrayDataset({"data": images, "label": labels[:, None]})
     try:
+        # --val-limit caps DECODING, not just the slice: a post-hoc [:n]
+        # view would pin the fully decoded split in RAM
         val_images, val_labels = load_corpus(cfg, args.val_prefix,
                                              args.val_labels,
-                                             host_id=pi, host_count=pc)
-        if args.val_limit:
-            val_images = val_images[:args.val_limit]
-            val_labels = val_labels[:args.val_limit]
+                                             host_id=pi, host_count=pc,
+                                             limit=args.val_limit)
         # RAW uint8 — pp_eval runs per eval batch inside the loop, so the
         # resident val cost is bounded by the uint8 pixels (the float32
         # conversion of the whole split would be ~6x larger)

@@ -26,6 +26,7 @@ from ..parallel.mesh import fetch_global, make_mesh
 from ..parallel.trainer import ParallelTrainer, TrainState
 from ..data.dataset import ArrayDataset, RoundSampler
 from ..utils import checkpoint as ckpt
+from ..utils import profiling
 from ..utils.config import RunConfig
 from ..utils.logger import Logger, default_logger
 from ..utils.metrics import PhaseTimers, ThroughputMeter
@@ -201,9 +202,15 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
                 pending = prefetch.submit(prepare_round, rnd + 1)
             sub = jax.random.fold_in(base_rng, rnd)
             before = timers.total.get("train_round", 0.0)
-            with timers.phase("train_round"):
-                state, loss = trainer.train_round(state, batches, sub)
-                loss = float(loss)  # D2H fetch = real synchronization
+            # trace ONE steady-state round (the first would trace compile)
+            profile_this = cfg.profile_dir and rnd == start_round + 1
+            with profiling.maybe_trace(cfg.profile_dir if profile_this
+                                       else None):
+                with timers.phase("train_round"):
+                    state, loss = trainer.train_round(state, batches, sub)
+                    loss = float(loss)  # D2H fetch = real synchronization
+            if profile_this:
+                log.log(f"profiler trace written to {cfg.profile_dir}", rnd)
             round_dt = timers.total["train_round"] - before
             n_images = cfg.tau * cfg.local_batch * n_dev
             meter.add(n_images, round_dt)

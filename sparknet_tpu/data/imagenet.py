@@ -135,12 +135,17 @@ class ShardedTarLoader:
                 self.skipped += 1
 
 
-    def load_all(self) -> Tuple[np.ndarray, np.ndarray]:
-        """Materialize every example (use for shard-sized chunks)."""
+    def load_all(self, limit: Optional[int] = None
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        """Materialize examples (use for shard-sized chunks). `limit` stops
+        DECODING at that many examples — a true RAM cap, not a post-hoc
+        slice of a fully decoded corpus."""
         images, labels = [], []
         for img, label in self:
             images.append(img)
             labels.append(label)
+            if limit is not None and len(images) >= limit:
+                break
         if not images:
             raise ValueError(f"no decodable labeled images in "
                              f"{self.shard_paths}")

@@ -67,18 +67,32 @@ def _pad_rows(x2: jnp.ndarray) -> Tuple[jnp.ndarray, int]:
     return x2, m
 
 
+def _out_struct(x2: jnp.ndarray) -> jax.ShapeDtypeStruct:
+    """Output aval matching x2 — including its varying-across-mesh-axes set
+    (vma), which shard_map's check_vma requires on pallas_call outputs: the
+    trainer runs this kernel INSIDE shard_map, where plain ShapeDtypeStruct
+    (vma=None) is rejected."""
+    try:
+        vma = jax.typeof(x2).vma
+    except AttributeError:
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct(x2.shape, x2.dtype, vma=vma)
+    return jax.ShapeDtypeStruct(x2.shape, x2.dtype)
+
+
 def _call(kernel, n_out: int, x2: jnp.ndarray, *others, interpret: bool):
     c = x2.shape[-1]
     grid = (x2.shape[0] // BLOCK_ROWS,)
     spec = pl.BlockSpec((BLOCK_ROWS, c), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
+    out = _out_struct(x2)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[spec] * (1 + len(others)),
         out_specs=[spec] * n_out if n_out > 1 else spec,
-        out_shape=([jax.ShapeDtypeStruct(x2.shape, x2.dtype)] * n_out
-                   if n_out > 1 else jax.ShapeDtypeStruct(x2.shape, x2.dtype)),
+        out_shape=[out] * n_out if n_out > 1 else out,
         interpret=interpret,
     )(x2, *others)
 

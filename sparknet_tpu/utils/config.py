@@ -46,6 +46,9 @@ class RunConfig:
     # logging
     workdir: str = "."
     seed: int = 0
+    # jax.profiler capture: trace ONE steady-state round (start_round+1,
+    # skipping the compile round) into this directory (SURVEY §5.1)
+    profile_dir: Optional[str] = None
 
     @staticmethod
     def from_json(path: str) -> "RunConfig":

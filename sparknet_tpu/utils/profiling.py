@@ -1,0 +1,30 @@
+"""jax.profiler capture hook (SURVEY §5.1 tracing/profiling subsystem).
+
+The reference had per-phase wall timers only (`apps/CifarApp.scala` logged
+driver-side elapsed times); PhaseTimers reproduces those. This adds the
+device-level view the reference could not see: a TensorBoard-loadable XLA
+trace (op-by-op device timeline, HBM usage) captured around a bounded window
+of work. Use `RunConfig.profile_dir` to trace one mid-training round, or
+`bench.py --profile DIR` to trace the benchmark's timed section.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def maybe_trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """Capture a jax.profiler trace into `trace_dir` for the with-block;
+    no-op when trace_dir is falsy. View with TensorBoard's profile plugin
+    (`tensorboard --logdir <trace_dir>`) or xprof."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

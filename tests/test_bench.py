@@ -1,0 +1,74 @@
+"""Benchmark harness pieces: analytic FLOPs, MFU peak lookup, the shared
+round-timing core, and the jax.profiler capture hook (SURVEY §5.1)."""
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sparknet_tpu import CompiledNet
+from sparknet_tpu.utils import flops
+from sparknet_tpu.zoo import caffenet, cifar10_quick
+
+
+def test_caffenet_forward_flops_match_alexnet_ballpark():
+    """CaffeNet == AlexNet: published conv+fc forward cost is ~1.4-1.5
+    GFLOP/image (2x ~720M MACs). The analytic count must land there —
+    a wrong blob-shape or group factor would be off by 2x or more."""
+    net = CompiledNet.compile(caffenet(batch=1, crop=227, n_classes=1000))
+    f = flops.forward_flops_per_image(net)
+    assert 1.3e9 < f < 1.6e9, f
+    assert flops.train_flops_per_image(net) == pytest.approx(3 * f)
+
+
+def test_conv_flops_shape_math():
+    """cifar10_quick conv1: 32x32 out, 5x5 kernel, 3->32 channels."""
+    net = CompiledNet.compile(cifar10_quick(batch=1))
+    f = flops.forward_flops_per_image(net)
+    conv1 = 2 * 32 * 32 * 5 * 5 * 3 * 32
+    assert f > conv1  # contains at least conv1 + the rest
+    # recompute by hand over all conv/ip layers and compare exactly
+    total = 0.0
+    for layer in net.spec.layers:
+        if layer.type == "Convolution":
+            _, h, w, co = net.blob_shapes[layer.tops[0]]
+            ci = net.blob_shapes[layer.bottoms[0]][-1]
+            k, g = layer.conv.kernel_size, layer.conv.group
+            total += 2 * h * w * k * k * (ci // g) * co
+        elif layer.type == "InnerProduct":
+            of = net.blob_shapes[layer.tops[0]][-1]
+            inf = int(np.prod(net.blob_shapes[layer.bottoms[0]][1:]))
+            total += 2 * inf * of
+    assert f == pytest.approx(total)
+
+
+def test_peak_lookup():
+    assert flops.peak_bf16_flops("TPU v5 lite") == pytest.approx(197e12)
+    assert flops.peak_bf16_flops("TPU v4") == pytest.approx(275e12)
+    assert flops.peak_bf16_flops("cpu") == 0.0  # unknown -> omit MFU
+
+
+def test_bench_round_timing_core():
+    """bench._build/_device_batches/_time_rounds run the real trainer round
+    on the test mesh and return a positive time."""
+    import bench
+    net, trainer, state = bench._build(2, 2, crop=35, n_classes=8,
+                                       n_devices=2)
+    batches = bench._device_batches(trainer, 2, 2, 35, 8)
+    t = bench._time_rounds(trainer, state, batches, trials=1)
+    assert t > 0
+
+
+def test_profiler_trace_capture(tmp_path):
+    """maybe_trace writes a TensorBoard-loadable capture; None is a no-op."""
+    import jax
+    import jax.numpy as jnp
+    from sparknet_tpu.utils.profiling import maybe_trace
+    with maybe_trace(None):
+        pass
+    d = str(tmp_path / "trace")
+    with maybe_trace(d):
+        float(jax.jit(lambda x: x * 2)(jnp.ones(8)).sum())
+    files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+    assert any(os.path.isfile(f) for f in files), "no trace files written"

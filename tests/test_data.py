@@ -336,3 +336,16 @@ def test_shard_val_rejects_label_only_file(tmp_path):
         f.write("490\n361\n171\n")
     with pytest.raises(SystemExit, match="filename label"):
         shard_imagenet.shard_val("unused.tar", bad, str(tmp_path), 2, 32, 0)
+
+
+def test_load_all_limit_caps_decoding(tmp_path):
+    """load_all(limit=n) stops DECODING at n examples (a real RAM cap, not
+    a slice of a fully materialized corpus — r2 review)."""
+    root = str(tmp_path / "shards")
+    label_path = imagenet.write_synthetic_shards(root, n_shards=2,
+                                                 per_shard=8, size=48)
+    loader = imagenet.ShardedTarLoader(
+        imagenet.list_shards(root), imagenet.load_label_map(label_path),
+        height=32, width=32)
+    images, labels = loader.load_all(5)
+    assert len(images) == 5 and len(labels) == 5
