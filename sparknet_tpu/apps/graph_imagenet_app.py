@@ -1,0 +1,86 @@
+"""ImageNet training on the SERIALIZED-GRAPH backend — the reference's
+`apps/TFImageNetApp.scala`: an AlexNet graph with in-graph Momentum
+optimizer trained inside the distributed τ-averaging loop (batch 256, τ=10
+at TFImageNetApp.scala:119, eval every 10), fed by the sharded-tar ImageNet
+ingest with mean-subtract + random-crop + CHW->HWC preprocessing
+(the reference's ImageNetTensorFlowPreprocessor, Preprocessor.scala:150-178).
+
+The graph can be:
+  - (default) our portable generator `build_alexnet_graph()` — the analogue
+    of the reference generating `alexnet_graph.pb` with `alexnet_graph.py`;
+  - `--graph path.pb` — a frozen TF GraphDef (e.g. the reference's own
+    `models/tensorflow/alexnet/alexnet_graph.pb`), trained through its
+    imported in-graph optimizer;
+  - `--graph path.json` — a portable GraphDef JSON produced elsewhere.
+
+Corpus modes (cache vs stream) and multi-host sharding are shared with
+`imagenet_app` — same --stream/--ram-budget-mb/--val-limit knobs.
+"""
+from __future__ import annotations
+
+import argparse
+
+from ..backend import GraphDef, GraphNet, build_alexnet_graph
+from ..backend.tf_import import import_tf_graphdef_file
+from ..parallel import GraphTrainer, initialize_multihost, make_mesh
+from ..utils.config import RunConfig
+from ..utils.logger import Logger, default_logger
+from .imagenet_app import add_data_args, prepare_data
+from .train_loop import run_loop
+
+
+def default_config() -> RunConfig:
+    return RunConfig(model="graph:alexnet", n_classes=1000,
+                     data_dir="data/imagenet", crop=227, tau=10,
+                     local_batch=256, eval_every=10, max_rounds=1000)
+
+
+def load_graph(path: str | None, batch: int, n_classes: int) -> GraphDef:
+    if path is None:
+        return build_alexnet_graph(batch=batch, n_classes=n_classes)
+    if path.endswith(".pb"):
+        return import_tf_graphdef_file(path)
+    return GraphDef.load(path)
+
+
+def train_graph(cfg: RunConfig, graph: GraphDef, train_ds, test_ds=None,
+                logger: Logger | None = None, batch_transform=None,
+                eval_transform=None):
+    """The TFImageNetApp loop over GraphTrainer: the shared `run_loop`
+    driver with the serialized-graph backend slotted in."""
+    log = logger or default_logger(cfg.workdir)
+    net = GraphNet(graph, seed=cfg.seed)
+    mesh = make_mesh(cfg.n_devices)
+    trainer = GraphTrainer(net, mesh, tau=cfg.tau)
+    log.log(f"graph backend: {len(net.variable_names)} variables; "
+            f"mesh {trainer.n_devices} devices; tau={cfg.tau} "
+            f"local_batch={cfg.local_batch}")
+    return run_loop(cfg, trainer, train_ds, test_ds, log,
+                    batch_transform=batch_transform,
+                    eval_transform=eval_transform)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--graph", default=None,
+                   help=".pb (TF GraphDef) or .json (portable) graph file")
+    add_data_args(p)
+    args = p.parse_args(argv)
+    initialize_multihost()  # BEFORE any other JAX use
+    cfg = (RunConfig.from_json(args.config) if args.config
+           else default_config())
+    if args.data_dir:
+        cfg.data_dir = args.data_dir
+    cfg = cfg.with_overrides(*args.overrides)
+    # label_shape=() -> (B,) flat int labels (the TF-graph convention; the
+    # Caffe path uses (1,) -> (B,1))
+    train_raw, test_ds, pp_train, pp_eval = prepare_data(
+        cfg, args, label_shape=(), app_name="graph_imagenet_app")
+
+    graph = load_graph(args.graph, cfg.local_batch, cfg.n_classes)
+    train_graph(cfg, graph, train_raw, test_ds, batch_transform=pp_train,
+                eval_transform=pp_eval)
+
+
+if __name__ == "__main__":
+    main()

@@ -122,8 +122,8 @@ def _agree_eval_dataset(test_ds, host_count: int):
     return ArrayDataset({k: v[:m] for k, v in test_ds.arrays.items()})
 
 
-def main(argv=None) -> None:
-    p = argparse.ArgumentParser(description=__doc__)
+def add_data_args(p: argparse.ArgumentParser) -> None:
+    """The ImageNet corpus CLI surface, shared with graph_imagenet_app."""
     p.add_argument("--config", help="RunConfig JSON path")
     p.add_argument("--data-dir", default=None)
     p.add_argument("--train-prefix", default="train.")
@@ -139,16 +139,20 @@ def main(argv=None) -> None:
                    help="cap resident val examples per host (0 = all); the "
                    "val split is held as uint8, ~192 KiB per image")
     p.add_argument("overrides", nargs="*")
-    args = p.parse_args(argv)
-    initialize_multihost()  # BEFORE any other JAX use (mesh.py:49)
-    cfg = (RunConfig.from_json(args.config) if args.config
-           else default_config())
-    if args.data_dir:
-        cfg.data_dir = args.data_dir
-    cfg = cfg.with_overrides(*args.overrides)
 
-    # each host streams only ITS tar shards (shards i::k to host i of k —
-    # the reference's one-Spark-partition-per-tar, keyed by process index)
+
+def prepare_data(cfg: RunConfig, args, label_shape: Tuple[int, ...] = (1,),
+                 app_name: str = "imagenet_app"):
+    """Everything between the parsed CLI and the training loop, shared by
+    the layer-IR and serialized-graph ImageNet apps: host-sharded loaders
+    (shards i::k to host i of k — the reference's one-Spark-partition-per-
+    tar, keyed by process index), the cache-vs-stream decision, the global
+    mean reduce, preprocessors, the train source, and the val dataset.
+
+    label_shape: per-example label field shape — (1,) for the Caffe path
+    ((B,1) batches), () for TF-convention graphs ((B,) flat labels).
+    Returns (train_source, test_ds, pp_train, pp_eval).
+    """
     pi, pc = host_id_count()
     train_loader = host_loader(cfg, args.train_prefix, args.train_labels,
                                host_id=pi, host_count=pc)
@@ -164,7 +168,7 @@ def main(argv=None) -> None:
             mean = _combine_mean(s, float(n), pc)
         else:
             mean = None
-        print(f"imagenet_app: streaming corpus on host {pi} "
+        print(f"{app_name}: streaming corpus on host {pi} "
               f"({len(train_loader.shard_paths)} shards)", file=sys.stderr)
     else:
         images, labels = train_loader.load_all()
@@ -172,7 +176,7 @@ def main(argv=None) -> None:
     crop = cfg.crop or 227
     # schema describes the preprocessor OUTPUT: NHWC device layout
     schema = Schema(Field("data", "float32", (crop, crop, 3)),
-                    Field("label", "int32", (1,)))
+                    Field("label", "int32", label_shape))
     pp_train = ImagePreprocessor(schema, mean_image=mean, crop=crop,
                                  seed=cfg.seed)
     pp_eval = ImagePreprocessor(schema, mean_image=mean, crop=crop,
@@ -208,13 +212,27 @@ def main(argv=None) -> None:
         # no val split — or fewer val tars than hosts left THIS host empty.
         # Say WHY: a malformed val.txt also lands here and must not look
         # like "no val data" on a multi-day run.
-        print(f"imagenet_app: eval disabled on host {pi}: "
+        print(f"{app_name}: eval disabled on host {pi}: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
         test_ds = None
     test_ds = _agree_eval_dataset(test_ds, pc)
+    return train_raw, test_ds, pp_train, pp_eval
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_data_args(p)
+    args = p.parse_args(argv)
+    initialize_multihost()  # BEFORE any other JAX use (mesh.py:49)
+    cfg = (RunConfig.from_json(args.config) if args.config
+           else default_config())
+    if args.data_dir:
+        cfg.data_dir = args.data_dir
+    cfg = cfg.with_overrides(*args.overrides)
+    train_raw, test_ds, pp_train, pp_eval = prepare_data(cfg, args)
 
     from .train_loop import resolve_spec
-    cfg.crop = crop
+    crop = cfg.crop = cfg.crop or 227
     spec = resolve_spec(cfg, data=(cfg.local_batch, 3, crop, crop),
                         label=(cfg.local_batch, 1))
     train(cfg, spec, train_raw, test_ds, batch_transform=pp_train,

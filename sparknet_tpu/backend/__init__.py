@@ -1,3 +1,4 @@
 from .graphdef import GraphDef, NodeDef  # noqa: F401
 from .graph_net import GraphNet  # noqa: F401
-from .builder import GraphBuilder, build_mnist_graph  # noqa: F401
+from .builder import (GraphBuilder, build_alexnet_graph,  # noqa: F401
+                      build_mnist_graph)

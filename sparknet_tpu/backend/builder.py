@@ -106,6 +106,56 @@ class GraphBuilder:
         return GraphDef(name=self.name, nodes=self.nodes)
 
 
+def build_alexnet_graph(batch: int = 256, n_classes: int = 1000,
+                        seed: int = 0, learning_rate: float = 0.01,
+                        momentum: float = 0.9) -> GraphDef:
+    """AlexNet graph with in-graph Momentum(0.01, 0.9) — same architecture
+    and optimizer as the reference's `alexnet_graph.py` generator (the graph
+    `TFImageNetApp.scala:80-84` trained): 227x227x3 input; conv 11x11/4
+    SAME ->57 (the reference pb's conv1 is SAME: (128,57,57,64)), pool3/2
+    ->28, conv 5x5 SAME, pool3/2 ->13, 3x conv 3x3 SAME, pool3/2 ->6,
+    fc 9216->4096->4096->n_classes; fixed-lr Momentum (that generator used
+    no lr schedule, unlike the mnist one)."""
+    r = np.random.default_rng(seed)
+
+    def w(shape, std=0.01):
+        return std * r.standard_normal(shape)
+
+    g = GraphBuilder("alexnet")
+    g.placeholder("data", (batch, 227, 227, 3))
+    g.placeholder("label", (batch,), dtype="int32")
+    chans = [(11, 3, 64, 4, "SAME"), (5, 64, 192, 1, "SAME"),
+             (3, 192, 384, 1, "SAME"), (3, 384, 256, 1, "SAME"),
+             (3, 256, 256, 1, "SAME")]
+    x = "data"
+    for i, (k, cin, cout, stride, pad) in enumerate(chans, start=1):
+        g.variable(f"conv{i}_w", w((k, k, cin, cout)))
+        g.variable(f"conv{i}_b", np.zeros(cout))
+        x = g.conv2d(f"conv{i}", x, f"conv{i}_w", stride=stride, padding=pad)
+        x = g.bias_add(f"conv{i}_biased", x, f"conv{i}_b")
+        x = g.relu(f"relu{i}", x)
+        if i in (1, 2, 5):
+            x = g.max_pool(f"pool{i}", x, ksize=3, strides=2,
+                           padding="VALID")
+    f = g.flatten("flat", x)  # 6*6*256 = 9216
+    g.variable("fc6_w", w((9216, 4096)))
+    g.variable("fc6_b", 0.1 * np.ones(4096))
+    h = g.relu("relu6", g.add("fc6_biased", g.matmul("fc6", f, "fc6_w"),
+                              "fc6_b"))
+    g.variable("fc7_w", w((4096, 4096)))
+    g.variable("fc7_b", 0.1 * np.ones(4096))
+    h = g.relu("relu7", g.add("fc7_biased", g.matmul("fc7", h, "fc7_w"),
+                              "fc7_b"))
+    g.variable("fc8_w", w((4096, n_classes)))
+    g.variable("fc8_b", np.zeros(n_classes))
+    logits = g.add("logits", g.matmul("fc8", h, "fc8_w"), "fc8_b")
+    g.softmax("prob", logits)
+    g.accuracy("accuracy", logits, "label")
+    loss = g.sparse_softmax_ce("loss", logits, "label")
+    return g.finalize(loss=loss, learning_rate=learning_rate,
+                      momentum=momentum, lr_policy="fixed")
+
+
 def build_mnist_graph(batch: int = 64, seed: int = 66478,
                       learning_rate: float = 0.01,
                       train_size: int = 60000) -> GraphDef:

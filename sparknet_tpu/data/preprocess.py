@@ -113,7 +113,10 @@ class ImagePreprocessor(DefaultPreprocessor):
         out[self.image_field] = img
         for f in self.schema.fields:
             if f.name != self.image_field and f.name in out:
-                out[f.name] = np.asarray(out[f.name]).astype(f.dtype, copy=False)
+                arr = np.asarray(out[f.name]).astype(f.dtype, copy=False)
+                # apply the schema's per-example shape, like the base class:
+                # e.g. label Field shape (1,) -> (B,1), () -> (B,) flat
+                out[f.name] = arr.reshape((arr.shape[0],) + f.shape)
         return out
 
     def _try_native_fused(self, raw: np.ndarray, train: bool,
