@@ -1,0 +1,61 @@
+"""Shared plumbing for the serialized-graph-backend apps (graph_mnist_app,
+graph_imagenet_app): graph-file dispatch, input-shape validation, and the
+GraphTrainer loop wiring — one copy, both reference pairings
+(`apps/MnistApp.scala`, `apps/TFImageNetApp.scala`)."""
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+from ..backend import GraphDef, GraphNet
+from ..backend.tf_import import import_tf_graphdef_file
+from ..parallel import GraphTrainer, make_mesh
+from ..utils.config import RunConfig
+from ..utils.logger import Logger, default_logger
+from .train_loop import run_loop
+
+
+def load_graph(path: Optional[str],
+               default_builder: Callable[[], GraphDef]) -> GraphDef:
+    """`None` -> build natively; `.pb` -> frozen TF GraphDef import;
+    anything else -> portable GraphDef JSON."""
+    if path is None:
+        return default_builder()
+    if path.endswith(".pb"):
+        return import_tf_graphdef_file(path)
+    return GraphDef.load(path)
+
+
+def check_input_shape(net: GraphNet, field: str,
+                      expect: Tuple[int, ...]) -> None:
+    """Fail fast (and name the knob) when the graph's placeholder disagrees
+    with the data pipeline's per-example shape — otherwise the mismatch
+    surfaces as a bare XLA matmul shape error deep inside the jitted round
+    that never mentions e.g. `crop`."""
+    node = net._nodes[field]
+    got = tuple(node.attrs.get("shape", ()))[1:]  # drop the batch dim
+    if got and got != tuple(expect):
+        raise ValueError(
+            f"graph input {field!r} expects per-example shape {got} but the "
+            f"data pipeline produces {tuple(expect)} — check crop/model "
+            f"settings against the graph (a natively built alexnet graph "
+            f"is fixed at 227x227x3)")
+
+
+def train_graph(cfg: RunConfig, graph: GraphDef, train_ds, test_ds=None,
+                logger: Optional[Logger] = None, batch_transform=None,
+                eval_transform=None,
+                expect_data_shape: Optional[Tuple[int, ...]] = None):
+    """The reference graph-backend loop: GraphNet -> mesh -> GraphTrainer ->
+    the shared `run_loop` driver. Returns final device state."""
+    log = logger or default_logger(cfg.workdir)
+    net = GraphNet(graph, seed=cfg.seed)
+    if expect_data_shape is not None:
+        check_input_shape(net, "data", expect_data_shape)
+    mesh = make_mesh(cfg.n_devices)
+    trainer = GraphTrainer(net, mesh, tau=cfg.tau)
+    log.log(f"graph backend: {len(net.variable_names)} variables; "
+            f"mesh {trainer.n_devices} devices; tau={cfg.tau} "
+            f"local_batch={cfg.local_batch}")
+    return run_loop(cfg, trainer, train_ds, test_ds, log,
+                    batch_transform=batch_transform,
+                    eval_transform=eval_transform)

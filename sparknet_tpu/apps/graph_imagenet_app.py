@@ -19,45 +19,19 @@ Corpus modes (cache vs stream) and multi-host sharding are shared with
 from __future__ import annotations
 
 import argparse
+import functools
 
-from ..backend import GraphDef, GraphNet, build_alexnet_graph
-from ..backend.tf_import import import_tf_graphdef_file
-from ..parallel import GraphTrainer, initialize_multihost, make_mesh
+from ..backend import build_alexnet_graph
+from ..parallel import initialize_multihost
 from ..utils.config import RunConfig
-from ..utils.logger import Logger, default_logger
+from .graph_common import load_graph, train_graph
 from .imagenet_app import add_data_args, prepare_data
-from .train_loop import run_loop
 
 
 def default_config() -> RunConfig:
     return RunConfig(model="graph:alexnet", n_classes=1000,
                      data_dir="data/imagenet", crop=227, tau=10,
                      local_batch=256, eval_every=10, max_rounds=1000)
-
-
-def load_graph(path: str | None, batch: int, n_classes: int) -> GraphDef:
-    if path is None:
-        return build_alexnet_graph(batch=batch, n_classes=n_classes)
-    if path.endswith(".pb"):
-        return import_tf_graphdef_file(path)
-    return GraphDef.load(path)
-
-
-def train_graph(cfg: RunConfig, graph: GraphDef, train_ds, test_ds=None,
-                logger: Logger | None = None, batch_transform=None,
-                eval_transform=None):
-    """The TFImageNetApp loop over GraphTrainer: the shared `run_loop`
-    driver with the serialized-graph backend slotted in."""
-    log = logger or default_logger(cfg.workdir)
-    net = GraphNet(graph, seed=cfg.seed)
-    mesh = make_mesh(cfg.n_devices)
-    trainer = GraphTrainer(net, mesh, tau=cfg.tau)
-    log.log(f"graph backend: {len(net.variable_names)} variables; "
-            f"mesh {trainer.n_devices} devices; tau={cfg.tau} "
-            f"local_batch={cfg.local_batch}")
-    return run_loop(cfg, trainer, train_ds, test_ds, log,
-                    batch_transform=batch_transform,
-                    eval_transform=eval_transform)
 
 
 def main(argv=None) -> None:
@@ -77,9 +51,11 @@ def main(argv=None) -> None:
     train_raw, test_ds, pp_train, pp_eval = prepare_data(
         cfg, args, label_shape=(), app_name="graph_imagenet_app")
 
-    graph = load_graph(args.graph, cfg.local_batch, cfg.n_classes)
+    graph = load_graph(args.graph, functools.partial(
+        build_alexnet_graph, batch=cfg.local_batch, n_classes=cfg.n_classes))
+    crop = cfg.crop or 227
     train_graph(cfg, graph, train_raw, test_ds, batch_transform=pp_train,
-                eval_transform=pp_eval)
+                eval_transform=pp_eval, expect_data_shape=(crop, crop, 3))
 
 
 if __name__ == "__main__":

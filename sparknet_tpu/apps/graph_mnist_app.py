@@ -14,32 +14,24 @@ The graph can be:
 from __future__ import annotations
 
 import argparse
+import functools
 
 import numpy as np
 
-from ..backend import GraphDef, GraphNet, build_mnist_graph
-from ..backend.tf_import import import_tf_graphdef_file
+from ..backend import build_mnist_graph
 from ..data.dataset import ArrayDataset
 from ..data.mnist import MnistLoader
-from ..parallel import GraphTrainer, initialize_multihost, make_mesh
+from ..parallel import initialize_multihost
 from ..parallel.mesh import host_id_count
 from ..utils.config import RunConfig
-from ..utils.logger import Logger, default_logger
-from .train_loop import run_loop
+from .graph_common import load_graph, train_graph  # noqa: F401 (re-export:
+# tests and callers use graph_mnist_app.train_graph for the MnistApp pairing)
 
 
 def default_config() -> RunConfig:
     return RunConfig(model="graph:mnist", data_dir="data/mnist", tau=10,
                      local_batch=64, eval_every=5, eval_batch=512,
                      max_rounds=100)
-
-
-def load_graph(path: str | None, batch: int, train_size: int) -> GraphDef:
-    if path is None:
-        return build_mnist_graph(batch=batch, train_size=train_size)
-    if path.endswith(".pb"):
-        return import_tf_graphdef_file(path)
-    return GraphDef.load(path)
 
 
 def _nhwc(arrays):
@@ -49,21 +41,6 @@ def _nhwc(arrays):
         np.transpose(arrays["data"], (0, 2, 3, 1)))
     out["label"] = arrays["label"].reshape(-1)
     return out
-
-
-def train_graph(cfg: RunConfig, graph: GraphDef, train_ds: ArrayDataset,
-                test_ds: ArrayDataset | None = None,
-                logger: Logger | None = None):
-    """The MnistApp loop over GraphTrainer: the shared `run_loop` driver with
-    the serialized-graph backend slotted in. Returns final device state."""
-    log = logger or default_logger(cfg.workdir)
-    net = GraphNet(graph, seed=cfg.seed)
-    mesh = make_mesh(cfg.n_devices)
-    trainer = GraphTrainer(net, mesh, tau=cfg.tau)
-    log.log(f"graph backend: {len(net.variable_names)} variables; "
-            f"mesh {trainer.n_devices} devices; tau={cfg.tau} "
-            f"local_batch={cfg.local_batch}")
-    return run_loop(cfg, trainer, train_ds, test_ds, log)
 
 
 def main(argv=None) -> None:
@@ -85,8 +62,10 @@ def main(argv=None) -> None:
     test_ds = ArrayDataset(_nhwc(loader.test_batch_dict()))
     pi, pc = host_id_count()
     train_ds, test_ds = train_ds.host_shard(pi, pc), test_ds.host_shard(pi, pc)
-    graph = load_graph(args.graph, cfg.local_batch, len(train_ds))
-    train_graph(cfg, graph, train_ds, test_ds)
+    graph = load_graph(args.graph, functools.partial(
+        build_mnist_graph, batch=cfg.local_batch, train_size=len(train_ds)))
+    train_graph(cfg, graph, train_ds, test_ds,
+                expect_data_shape=(28, 28, 1))
 
 
 if __name__ == "__main__":

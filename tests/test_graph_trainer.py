@@ -240,3 +240,14 @@ def test_reference_alexnet_pb_trains_distributed(tmp_path):
     # replicas identical after the averaging collective
     w = np.asarray(state["variables"]["conv1/weights"])
     np.testing.assert_array_equal(w[0], w[1])
+
+
+def test_graph_input_shape_validation():
+    """A crop/graph mismatch fails fast naming the shapes, not as a bare
+    XLA matmul error mid-round (r2 review)."""
+    from sparknet_tpu.apps.graph_common import check_input_shape
+    from sparknet_tpu.backend import GraphNet, build_mnist_graph
+    net = GraphNet(build_mnist_graph(batch=2))
+    check_input_shape(net, "data", (28, 28, 1))  # matches: no raise
+    with pytest.raises(ValueError, match="data pipeline produces"):
+        check_input_shape(net, "data", (32, 32, 1))
