@@ -71,11 +71,16 @@ def _device_batches(trainer, batch: int, tau: int, crop: int,
     from jax.sharding import NamedSharding, PartitionSpec as P
     from sparknet_tpu.parallel.mesh import DATA_AXIS
 
+    from sparknet_tpu import precision
+
     n = trainer.n_devices
     shd = NamedSharding(trainer.mesh, P(None, DATA_AXIS))
+    # data in the compute dtype, as the training apps now feed it (the
+    # host-side cast in ParallelTrainer._shard_batches)
     gen = jax.jit(
         lambda k: (jax.random.normal(
-                       k, (tau, n * batch, crop, crop, 3), jnp.float32),
+                       k, (tau, n * batch, crop, crop, 3),
+                       precision.compute_dtype()),
                    jax.random.randint(
                        jax.random.fold_in(k, 1), (tau, n * batch, 1),
                        0, n_classes, jnp.int32)),
@@ -86,10 +91,13 @@ def _device_batches(trainer, batch: int, tau: int, crop: int,
 
 def _time_rounds(trainer, state, batches, trials: int,
                  profile_dir: str | None = None) -> float:
-    """Best-of-N round time. Only a scalar D2H fetch synchronizes (the axon
-    relay treats block_until_ready as a no-op). The profiler trace covers
-    ONLY the timed trials — compile + warmup happen before it starts, else
-    the capture is dominated by compilation."""
+    """Mean steady-state round time over a PIPELINED window — the loss
+    fetch lags one round behind the dispatch, exactly as the training loop
+    runs (train_loop defers round R's log until R+1 is in flight). Only a
+    scalar D2H fetch synchronizes (the axon relay treats block_until_ready
+    as a no-op). The profiler trace covers ONLY the timed window — compile
+    + warmup happen before it starts, else the capture is dominated by
+    compilation."""
     import jax
     from jax.sharding import PartitionSpec as P
     from sparknet_tpu.parallel.mesh import DATA_AXIS, place_global_state
@@ -100,14 +108,17 @@ def _time_rounds(trainer, state, batches, trials: int,
         trainer.mesh, P(DATA_AXIS))
     state, loss = trainer._round(state, batches, rngs)  # compile + warm
     assert float(loss) > 0
-    best = float("inf")
+    # prime the pipeline: one round in flight before the clock starts
+    state, prev = trainer._round(state, batches, rngs)
     with maybe_trace(profile_dir):
+        t0 = time.perf_counter()
         for _ in range(trials):
-            t0 = time.perf_counter()
             state, loss = trainer._round(state, batches, rngs)
-            float(loss)  # D2H fetch = real synchronization
-            best = min(best, time.perf_counter() - t0)
-    return best
+            float(prev)  # sync on the PREVIOUS round; this one overlaps
+            prev = loss
+        dt = time.perf_counter() - t0
+    assert float(prev) > 0  # drain outside the timed window
+    return dt / trials
 
 
 def headline(profile_dir: str | None = None) -> None:

@@ -18,6 +18,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..model.net import CompiledNet
@@ -66,19 +67,24 @@ def resolve_solver(cfg: RunConfig):
     return cfg.solver
 
 
-def probe_value(state: TrainState, net: CompiledNet) -> float:
+def probe_value(state: TrainState, net: CompiledNet):
     """First scalar of the first parametric layer's weights — the reference's
     divergence probe (`apps/CifarApp.scala:147` logged conv1 weight [0]).
-    Reads a locally-addressable shard so it works on multi-host arrays
-    (post-round params are replica-identical, any shard's value is THE
-    value)."""
+
+    Single-process: returns a 0-d DEVICE scalar (an async slice — the loop
+    fetches it one round later, so the probe never stalls the pipeline; the
+    slice is enqueued before the next round's donation invalidates the
+    state buffers). Multi-host: reads a locally-addressable shard to a host
+    float (post-round params are replica-identical, any shard's value is
+    THE value)."""
     leaf = state.params[net.param_layers()[0]]["w"]
     if hasattr(leaf, "addressable_shards") and not getattr(
             leaf, "is_fully_addressable", True):
         arr = np.asarray(leaf.addressable_shards[0].data)
-    else:
-        arr = np.asarray(leaf)
-    return float(arr.reshape(-1)[0])
+        return float(arr.reshape(-1)[0])
+    if hasattr(leaf, "devices"):
+        return leaf[(0,) * leaf.ndim]
+    return float(np.asarray(leaf).reshape(-1)[0])
 
 
 def train(cfg: RunConfig, spec: NetSpec, train_ds: ArrayDataset,
@@ -166,6 +172,10 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     # schedule exactly (reference had no resume at all, SURVEY §5.3)
     base_rng = jax.random.PRNGKey(cfg.seed ^ 0xABCD)
 
+    # capture on the MAIN thread: the precision policy is thread-local and
+    # the prefetch thread would otherwise see the default
+    compute_dt = precision.compute_dtype()
+
     def prepare_round(rnd: int) -> Dict[str, np.ndarray]:
         batches = source.next_round(round_index=rnd)
         if batch_transform is not None:
@@ -178,17 +188,45 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
                 for t in range(cfg.tau)]
             batches = {k: np.stack([s[k] for s in slices])
                        for k in slices[0]}
-        return batches
+        # cast float inputs to the compute dtype HERE, on the prefetch
+        # thread (value-identical to the first in-net cast; halves H2D under
+        # bfloat16) — doing it at dispatch time would serialize a full-batch
+        # astype into the pipelined path
+        return {k: (np.asarray(v).astype(compute_dt)
+                    if np.asarray(v).dtype == np.float32
+                    and compute_dt != jnp.float32 else v)
+                for k, v in batches.items()}
+
+    def flush_round_log(rec) -> None:
+        """Emit round R's metrics. `float(loss)` here is the pipeline's
+        REAL synchronization — deferred one round so round R+1's dispatch
+        overlaps round R's device execution (the reference fetched loss
+        synchronously every round and stalled the accelerator; on a TPU the
+        dispatch+fetch round trip is a large fraction of a round)."""
+        rnd_, loss_, probe_ = rec
+        loss_ = float(loss_)
+        probe_txt = (f"  probe: {float(probe_):.6f}"
+                     if probe_ is not None else "")
+        log.log(f"round loss: {loss_:.4f}{probe_txt}", rnd_)
+        log.metrics(rnd_, loss=loss_, images_per_sec_per_chip=round(
+            meter.images_per_sec_per_chip(), 2))
 
     # one-deep host prefetch: round R+1 is sampled/decoded/preprocessed on
     # this thread pool while round R's XLA program runs. The "sample" phase
     # then measures only the residual WAIT — ~0 when prep fully overlaps.
     prefetch = ThreadPoolExecutor(1, thread_name_prefix="round-prep")
     pending: Optional[Any] = None
+    deferred = None  # previous round's (rnd, device_loss, device_probe)
     try:
         for rnd in range(start_round, cfg.max_rounds):
             if test_ds is not None and cfg.eval_every and \
                     rnd % cfg.eval_every == 0:
+                if deferred is not None:
+                    # keep log/JSONL round-ordered: round R-1's loss row
+                    # must precede round R's eval row (eval blocks on the
+                    # in-flight round anyway, so this costs no overlap)
+                    flush_round_log(deferred)
+                    deferred = None
                 with timers.phase("eval"):
                     acc = _evaluate(trainer, state, test_ds, cfg.eval_batch,
                                     n_local, transform=eval_transform)
@@ -208,16 +246,19 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
                                        else None):
                 with timers.phase("train_round"):
                     state, loss = trainer.train_round(state, batches, sub)
-                    loss = float(loss)  # D2H fetch = real synchronization
+                    # async probe slice MUST precede the next dispatch
+                    # (donation invalidates the old state buffers)
+                    probe_val = probe(state) if probe else None
+                    if deferred is not None:
+                        flush_round_log(deferred)  # sync on round rnd-1
             if profile_this:
                 log.log(f"profiler trace written to {cfg.profile_dir}", rnd)
+            # steady state, this measures one device round: dispatch of rnd
+            # + wait for rnd-1 (the two overlap by exactly one round)
             round_dt = timers.total["train_round"] - before
             n_images = cfg.tau * cfg.local_batch * n_dev
             meter.add(n_images, round_dt)
-            probe_txt = f"  probe: {probe(state):.6f}" if probe else ""
-            log.log(f"round loss: {loss:.4f}{probe_txt}", rnd)
-            log.metrics(rnd, loss=loss, images_per_sec_per_chip=round(
-                meter.images_per_sec_per_chip(), 2))
+            deferred = (rnd, loss, probe_val)
 
             if cfg.checkpoint_dir and cfg.checkpoint_every and \
                     (rnd + 1) % cfg.checkpoint_every == 0:
@@ -226,7 +267,15 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
                 log.log("checkpoint saved", rnd)
             if round_hook:
                 round_hook(rnd, state)
+        if deferred is not None:
+            flush_round_log(deferred)
+            deferred = None
     finally:
+        if deferred is not None:  # loop aborted: drain the pending fetch
+            try:
+                flush_round_log(deferred)
+            except Exception:
+                pass
         if pending is not None:
             pending.cancel()
         prefetch.shutdown(wait=False, cancel_futures=True)

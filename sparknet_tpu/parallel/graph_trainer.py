@@ -128,13 +128,15 @@ class GraphTrainer:
     # -- public API ----------------------------------------------------------
 
     def train_round(self, state: PyTree, batches: Dict[str, np.ndarray],
-                    rng=None) -> Tuple[PyTree, float]:
+                    rng=None) -> Tuple[PyTree, Any]:
         """One outer round: τ in-graph-optimizer steps per device, then the
         averaging collective. batches[input]: [tau, global_batch, ...].
+        Returns (state, loss) with loss a DEVICE scalar — callers fetch it
+        (`float(loss)`) when they need the synchronization, letting the
+        train loop pipeline the fetch one round behind the dispatch.
         `rng` is accepted for trainer-interface parity and ignored (graph
         execution is deterministic; dropout-free eval semantics)."""
-        new_state, loss = self._round(state, self._shard_batches(batches))
-        return new_state, float(loss)
+        return self._round(state, self._shard_batches(batches))
 
     def evaluate(self, state: PyTree, batch: Dict[str, np.ndarray]) -> float:
         sharded = {

@@ -210,9 +210,21 @@ class ParallelTrainer:
         return float(self._eval(state.params, sharded))
 
     def _shard_batches(self, batches):
+        from .. import precision
+
+        dt = precision.compute_dtype()
         out = {}
         for k, v in batches.items():
-            arr = np.asarray(v)
+            if hasattr(v, "devices"):  # already device-resident (bench path)
+                arr = v
+            else:
+                arr = np.asarray(v)
+                # cast float inputs to the compute dtype on the HOST: the
+                # first in-net op would cast anyway (cast_in), so this is
+                # value-identical — and it halves the H2D bytes and drops an
+                # in-round [tau, B, H, W, C] convert under bfloat16 policy
+                if arr.dtype == np.float32 and dt != jnp.float32:
+                    arr = arr.astype(dt)
             assert arr.shape[0] == self.tau, (
                 f"{k}: leading dim {arr.shape[0]} != tau {self.tau}")
             assert arr.shape[1] % self.n_local_devices == 0, (
