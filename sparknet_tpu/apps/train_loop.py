@@ -18,7 +18,6 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..model.net import CompiledNet
@@ -189,13 +188,10 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
             batches = {k: np.stack([s[k] for s in slices])
                        for k in slices[0]}
         # cast float inputs to the compute dtype HERE, on the prefetch
-        # thread (value-identical to the first in-net cast; halves H2D under
-        # bfloat16) — doing it at dispatch time would serialize a full-batch
-        # astype into the pipelined path
-        return {k: (np.asarray(v).astype(compute_dt)
-                    if np.asarray(v).dtype == np.float32
-                    and compute_dt != jnp.float32 else v)
-                for k, v in batches.items()}
+        # thread — doing it at dispatch time would serialize a full-batch
+        # astype into the pipelined path (compute_dt captured on the main
+        # thread; the policy is thread-local)
+        return precision.cast_host_inputs(batches, compute_dt)
 
     def flush_round_log(rec) -> None:
         """Emit round R's metrics. `float(loss)` here is the pipeline's

@@ -36,10 +36,24 @@ Params = Dict[str, jnp.ndarray]
 
 @dataclasses.dataclass
 class ApplyCtx:
-    """Per-call context threaded through layer application."""
+    """Per-call context threaded through layer application.
+
+    tp_axis/tp_size: tensor-parallel mesh axis (inside shard_map). When set,
+    InnerProduct layers whose num_output divides tp_size hold COLUMN SHARDS
+    of their weights ((in, out/tp_size), bias (out/tp_size,)) and all_gather
+    the output features; other layers are replicated. The convention must
+    match the trainer's state construction (ParallelTrainer._tp_sharded).
+    """
 
     train: bool = False
     rng: Optional[jax.Array] = None
+    tp_axis: Optional[str] = None
+    tp_size: int = 1
+
+    def tp_shards(self, layer: "LayerSpec") -> bool:
+        return (self.tp_axis is not None and self.tp_size > 1
+                and layer.type == "InnerProduct"
+                and layer.inner_product.num_output % self.tp_size == 0)
 
     def fold(self, name: str) -> jax.Array:
         assert self.rng is not None, "dropout in train mode needs an rng key"
@@ -211,6 +225,12 @@ def apply_innerproduct(layer: LayerSpec, params: Params, inputs, ctx: ApplyCtx):
                 preferred_element_type=precision.preferred_out())
     if "b" in params:
         y = y + params["b"].astype(y.dtype)
+    if ctx.tp_shards(layer):
+        # column-parallel: this device computed features
+        # [rank*out/m, (rank+1)*out/m); gather the full feature axis so
+        # downstream layers see the logical blob. autodiff turns the gather
+        # into the matching reduce-scatter of the cotangent.
+        y = jax.lax.all_gather(y, ctx.tp_axis, axis=1, tiled=True)
     return (y,)
 
 

@@ -97,16 +97,21 @@ class CompiledNet:
 
     def apply(self, params: PyTree, batch: Dict[str, jnp.ndarray], *,
               train: bool = False, rng: Optional[jax.Array] = None,
-              phase: Optional[str] = None) -> Dict[str, jnp.ndarray]:
+              phase: Optional[str] = None, tp_axis: Optional[str] = None,
+              tp_size: int = 1) -> Dict[str, jnp.ndarray]:
         """Run the net. `batch` maps input blob names to NHWC arrays.
 
         Returns every blob produced (inputs excluded), so callers can read
         hidden activations by name — parity with the reference's
         `forward(rowIt, dataBlobNames)` path (`libs/CaffeNet.scala:101-107`)
         used by FeaturizerApp.
+
+        tp_axis/tp_size: run tensor-parallel (inside shard_map over that
+        mesh axis) with column-sharded InnerProduct weights — see ApplyCtx.
         """
         phase = phase or ("TRAIN" if train else "TEST")
-        ctx = ApplyCtx(train=train, rng=rng)
+        ctx = ApplyCtx(train=train, rng=rng, tp_axis=tp_axis,
+                       tp_size=tp_size)
         blobs: Dict[str, jnp.ndarray] = dict(batch)
         all_tops = set()
         for layer in self.spec.layers_for_phase(phase):
@@ -121,11 +126,13 @@ class CompiledNet:
                 blobs.pop(name, None)
         return blobs
 
-    def loss_fn(self, loss_blob: str = "loss"):
+    def loss_fn(self, loss_blob: str = "loss",
+                tp_axis: Optional[str] = None, tp_size: int = 1):
         """Returns `f(params, batch, rng) -> (loss, aux_blobs)` for jax.grad."""
 
         def f(params, batch, rng=None):
-            blobs = self.apply(params, batch, train=True, rng=rng)
+            blobs = self.apply(params, batch, train=True, rng=rng,
+                               tp_axis=tp_axis, tp_size=tp_size)
             return blobs[loss_blob], blobs
 
         return f

@@ -42,8 +42,8 @@ from jax import shard_map
 
 from ..model.net import CompiledNet, PyTree
 from ..solver import SgdSolver, SolverConfig, SolverState
-from .mesh import (DATA_AXIS, local_device_rows, place_global_state,
-                   put_device_axis)
+from .mesh import (DATA_AXIS, MODEL_AXIS, local_device_rows,
+                   place_global_state, put_device_axis)
 
 
 @jax.tree_util.register_dataclass
@@ -58,10 +58,20 @@ class TrainState:
 
 
 class ParallelTrainer:
-    """Data-parallel trainer over a 1-D (data,) mesh.
+    """Data-parallel (optionally DPxTP hybrid) trainer.
 
     mode: "local_sgd" (τ steps then weight pmean — the reference's scheme) or
           "sync_sgd" (per-step gradient pmean, τ must be 1).
+
+    Tensor parallelism (beyond reference parity): pass a 2-D
+    ("data", "model") mesh. InnerProduct layers whose num_output divides
+    the model-axis size hold column shards of their weights (Megatron-style
+    column-parallel + feature all_gather over ICI); conv layers are
+    replicated across the model axis. Within a model group every device
+    sees the same batch and rng, so replicated params evolve identically;
+    weight averaging stays a pmean over the DATA axis only — shard
+    identity is preserved. TP is numerically exact: the (data=N, model=M)
+    trajectory equals the (data=N) one (oracle-tested).
     """
 
     def __init__(self, net: CompiledNet, solver_cfg: SolverConfig, mesh: Mesh,
@@ -76,6 +86,7 @@ class ParallelTrainer:
                 "(SgdSolver.step); in the distributed trainer scale "
                 "local_batch or tau instead — failing loudly rather than "
                 "silently ignoring it")
+        assert DATA_AXIS in mesh.axis_names, mesh.axis_names
         self.net = net
         self.solver = SgdSolver(net, solver_cfg, loss_blob=loss_blob)
         self.mesh = mesh
@@ -85,9 +96,22 @@ class ParallelTrainer:
         self.acc_blob = acc_blob
         self.n_devices = int(np.prod(mesh.devices.shape))
         self.n_local_devices = len(local_device_rows(mesh))
+        self.tp = (int(mesh.shape[MODEL_AXIS])
+                   if MODEL_AXIS in mesh.axis_names else 1)
+        self.n_data = self.n_devices // self.tp
+        self._tp_axis = MODEL_AXIS if self.tp > 1 else None
+        if self.tp > 1 and jax.process_count() > 1:
+            raise NotImplementedError(
+                "multi-host TP: per-host rng/data row slicing assumes a "
+                "1-D data mesh — keep the model axis within one host")
 
-        dev = P(DATA_AXIS)  # leading device axis
-        batch_spec = P(None, DATA_AXIS)  # [tau, global_batch, ...] -> shard batch
+        # leading device axis covers the WHOLE mesh (data-major, model-minor
+        # — matches mesh.devices.flat for a ("data","model") mesh)
+        dev = (P((DATA_AXIS, MODEL_AXIS)) if self.tp > 1 else P(DATA_AXIS))
+        self._dev_spec = dev
+        # [tau, global_batch, ...]: batch sharded over data, replicated
+        # across the model group (TP replicas consume identical examples)
+        batch_spec = P(None, DATA_AXIS)
         state_specs = TrainState(params=dev, momentum=dev, it=dev)
 
         self._round = jax.jit(
@@ -102,17 +126,37 @@ class ParallelTrainer:
 
     # -- state construction --------------------------------------------------
 
+    def _tp_sharded_layers(self) -> set:
+        """Layer names whose params are column-sharded across the model
+        axis — MUST match ApplyCtx.tp_shards."""
+        if self.tp == 1:
+            return set()
+        return {l.name for l in self.net.spec.layers
+                if l.type == "InnerProduct"
+                and l.inner_product.num_output % self.tp == 0}
+
     def init_state(self, key: jax.Array) -> TrainState:
         """Identical initial params on every device (the reference seeds all
         workers from worker-0's weights, `apps/CifarApp.scala:98`)."""
         return self.state_from_params(self.net.init_params(key))
 
     def state_from_params(self, params: PyTree) -> TrainState:
-        def tile(x):
+        tp_layers = self._tp_sharded_layers()
+
+        def expand(lname: str, pname: str, x: jnp.ndarray) -> jnp.ndarray:
+            if lname in tp_layers:
+                # device row d = (data d//tp, model d%tp): model rank takes
+                # its column shard, repeated across the data groups
+                axis = 1 if pname == "w" else 0
+                shards = jnp.split(x, self.tp, axis=axis)
+                return jnp.stack([shards[d % self.tp]
+                                  for d in range(self.n_devices)])
             return jnp.broadcast_to(x[None], (self.n_devices,) + x.shape)
-        zeros = jax.tree.map(jnp.zeros_like, params)
-        state = TrainState(params=jax.tree.map(tile, params),
-                           momentum=jax.tree.map(tile, zeros),
+
+        params_dev = {l: {p: expand(l, p, x) for p, x in lp.items()}
+                      for l, lp in params.items()}
+        state = TrainState(params=params_dev,
+                           momentum=jax.tree.map(jnp.zeros_like, params_dev),
                            it=jnp.zeros((self.n_devices,), jnp.int32))
         return self.place(state)
 
@@ -122,11 +166,26 @@ class ParallelTrainer:
         every subsequent round recompiles for the foreign layout. Leaves
         carry the GLOBAL device axis; under multi-host each process
         contributes its own devices' rows."""
-        return place_global_state(state, self.mesh, P(DATA_AXIS))
+        return place_global_state(state, self.mesh, self._dev_spec)
 
     def averaged_params(self, state: TrainState) -> PyTree:
-        """Single copy of the (already synchronized) params: device 0's."""
-        return jax.tree.map(lambda x: x[0], state.params)
+        """Single logical copy of the (already synchronized) params. Under
+        TP, the column shards of data group 0 are concatenated back into
+        full weights (export/checkpoint-compat view)."""
+        if self.tp == 1:
+            return jax.tree.map(lambda x: x[0], state.params)
+        tp_layers = self._tp_sharded_layers()
+        out: PyTree = {}
+        for lname, lp in state.params.items():
+            out[lname] = {}
+            for pname, x in lp.items():
+                if lname in tp_layers:
+                    axis = 1 if pname == "w" else 0
+                    out[lname][pname] = jnp.concatenate(
+                        [x[j] for j in range(self.tp)], axis=axis)
+                else:
+                    out[lname][pname] = x[0]
+        return out
 
     # -- one training round (runs INSIDE shard_map; axis = DATA_AXIS) --------
 
@@ -137,21 +196,35 @@ class ParallelTrainer:
         it = state.it[0]
         rng = rng[0]
 
+        loss_fn = self.net.loss_fn(self.loss_blob, tp_axis=self._tp_axis,
+                                   tp_size=self.tp)
+        tp_layers = self._tp_sharded_layers()
+
+        def fix_tp_grads(grads):
+            """SPMD autodiff of the replicated-downstream TP program sums
+            every replica's (identical) loss: column-shard grads come back
+            x tp (the gather's psum-scatter transpose), and each replica's
+            backbone grad carries ONLY its own shard's term (x tp). The
+            exact logical gradient is shards / tp and backbone pmean'd over
+            the model axis (= sum of per-shard terms / tp)."""
+            if self._tp_axis is None:
+                return grads
+            return {l: (jax.tree.map(lambda g: g / self.tp, lp)
+                        if l in tp_layers
+                        else lax.pmean(lp, self._tp_axis))
+                    for l, lp in grads.items()}
+
         def local_step(carry, inputs):
             params, sstate = carry
             batch, step_rng = inputs
+            (loss, _), grads = jax.value_and_grad(
+                lambda p: loss_fn(p, batch, step_rng),
+                has_aux=True)(params)
+            grads = fix_tp_grads(grads)
             if self.mode == "sync_sgd":
-                (loss, _), grads = jax.value_and_grad(
-                    lambda p: self.net.loss_fn(self.loss_blob)(
-                        p, batch, step_rng), has_aux=True)(params)
                 grads = lax.pmean(grads, DATA_AXIS)
                 loss = lax.pmean(loss, DATA_AXIS)
-                params, sstate = self.solver.update(params, sstate, grads)
-            else:
-                (loss, _), grads = jax.value_and_grad(
-                    lambda p: self.net.loss_fn(self.loss_blob)(
-                        p, batch, step_rng), has_aux=True)(params)
-                params, sstate = self.solver.update(params, sstate, grads)
+            params, sstate = self.solver.update(params, sstate, grads)
             return (params, sstate), loss
 
         step_rngs = jax.random.split(rng, self.tau)
@@ -160,10 +233,16 @@ class ParallelTrainer:
             (batches, step_rngs))
 
         if self.mode == "local_sgd":
-            # THE sync: weight averaging as an in-pod allreduce. Momentum is
-            # deliberately NOT averaged (reference parity, SURVEY §7).
+            # THE sync: weight averaging as an in-pod allreduce OVER THE
+            # DATA AXIS ONLY — under TP each model rank averages its own
+            # column shard with its peers. Momentum is deliberately NOT
+            # averaged (reference parity, SURVEY §7).
             params = lax.pmean(params, DATA_AXIS)
         mean_loss = lax.pmean(jnp.mean(losses), DATA_AXIS)
+        if self._tp_axis is not None:
+            # numerically a no-op (TP replicas compute identical losses);
+            # clears the model-axis vma so the P() out_spec typechecks
+            mean_loss = lax.pmean(mean_loss, self._tp_axis)
 
         new_state = TrainState(
             params=jax.tree.map(lambda x: x[None], params),
@@ -176,13 +255,17 @@ class ParallelTrainer:
 
     def _eval_impl(self, params, batch):
         params = jax.tree.map(lambda x: x[0], params)
-        blobs = self.net.apply(params, batch, train=False)
+        blobs = self.net.apply(params, batch, train=False,
+                               tp_axis=self._tp_axis, tp_size=self.tp)
         acc_blob = self.acc_blob or _find_accuracy_blob(self.net)
         n = next(iter(batch.values())).shape[0]
         correct = blobs[acc_blob] * n
         total_correct = lax.psum(correct, DATA_AXIS)
         total_n = lax.psum(jnp.asarray(n, jnp.float32), DATA_AXIS)
-        return total_correct / total_n
+        acc = total_correct / total_n
+        if self._tp_axis is not None:
+            acc = lax.pmean(acc, self._tp_axis)  # replicas agree; clears vma
+        return acc
 
     # -- public API ----------------------------------------------------------
 
@@ -196,7 +279,10 @@ class ParallelTrainer:
         batch; multi-host, each process passes only its own hosts' examples
         (disjoint data — the reference's per-executor partitions).
         """
-        rngs = jax.random.split(rng, self.n_devices)  # same on every host
+        # one rng row per DATA group, same on every host; TP replicas in a
+        # model group share the row (dropout masks must agree on the
+        # gathered activations)
+        rngs = jax.random.split(rng, self.n_data)
         rngs = place_global_state(rngs, self.mesh, P(DATA_AXIS))
         new_state, loss = self._round(state, self._shard_batches(batches), rngs)
         return new_state, loss
@@ -204,32 +290,26 @@ class ParallelTrainer:
     def evaluate(self, state: TrainState, batch: Dict[str, np.ndarray]) -> float:
         """Distributed accuracy over one global batch (psum of correct/count —
         reference's eval reduce, `apps/CifarApp.scala:107-124`)."""
+        from .. import precision
+
         sharded = {
             k: put_device_axis(np.asarray(v), self.mesh, P(DATA_AXIS))
-            for k, v in batch.items()}
+            for k, v in precision.cast_host_inputs(batch).items()}
         return float(self._eval(state.params, sharded))
 
     def _shard_batches(self, batches):
         from .. import precision
 
-        dt = precision.compute_dtype()
+        # the batch shards over the DATA axis only (TP replicas share rows)
+        local_data_groups = self.n_local_devices // self.tp
         out = {}
-        for k, v in batches.items():
-            if hasattr(v, "devices"):  # already device-resident (bench path)
-                arr = v
-            else:
-                arr = np.asarray(v)
-                # cast float inputs to the compute dtype on the HOST: the
-                # first in-net op would cast anyway (cast_in), so this is
-                # value-identical — and it halves the H2D bytes and drops an
-                # in-round [tau, B, H, W, C] convert under bfloat16 policy
-                if arr.dtype == np.float32 and dt != jnp.float32:
-                    arr = arr.astype(dt)
+        for k, v in precision.cast_host_inputs(batches).items():
+            arr = v if hasattr(v, "devices") else np.asarray(v)
             assert arr.shape[0] == self.tau, (
                 f"{k}: leading dim {arr.shape[0]} != tau {self.tau}")
-            assert arr.shape[1] % self.n_local_devices == 0, (
+            assert arr.shape[1] % local_data_groups == 0, (
                 f"{k}: host batch {arr.shape[1]} not divisible by "
-                f"{self.n_local_devices} local devices")
+                f"{local_data_groups} local data-parallel groups")
             out[k] = put_device_axis(arr, self.mesh, P(None, DATA_AXIS))
         return out
 

@@ -63,3 +63,24 @@ def cast_in(x: jnp.ndarray) -> jnp.ndarray:
     if x.dtype in (jnp.float32, jnp.bfloat16) and x.dtype != dt:
         return x.astype(dt)
     return x
+
+
+def cast_host_inputs(batch: dict, dt=None) -> dict:
+    """Cast float32 HOST arrays in a batch dict to the compute dtype —
+    value-identical to the first in-net `cast_in` (same f32->bf16 rounding)
+    and halves the host->device bytes under bfloat16. Device-resident
+    arrays pass through untouched (casting them here would round-trip
+    through the host).
+
+    `dt` overrides the policy lookup: the policy is THREAD-LOCAL, so
+    callers running on worker threads (the train loop's prefetcher) must
+    capture `compute_dtype()` on the main thread and pass it in."""
+    import numpy as np
+
+    dt = dt if dt is not None else compute_dtype()
+    if dt == jnp.float32:
+        return batch
+    return {k: (np.asarray(v).astype(dt)
+                if not hasattr(v, "devices")
+                and np.asarray(v).dtype == np.float32 else v)
+            for k, v in batch.items()}
