@@ -34,15 +34,25 @@ from .spec import Filler, LayerSpec
 Params = Dict[str, jnp.ndarray]
 
 
+def tp_shards_layer(layer: "LayerSpec", tp_size: int) -> bool:
+    """THE tensor-parallel sharding convention, shared by the forward pass
+    (ApplyCtx.tp_shards) and the trainer's state construction
+    (ParallelTrainer._tp_sharded_layers): an InnerProduct layer is
+    column-sharded iff tp_size divides its num_output; everything else is
+    replicated across the model axis."""
+    return (tp_size > 1 and layer.type == "InnerProduct"
+            and layer.inner_product.num_output % tp_size == 0)
+
+
 @dataclasses.dataclass
 class ApplyCtx:
     """Per-call context threaded through layer application.
 
     tp_axis/tp_size: tensor-parallel mesh axis (inside shard_map). When set,
-    InnerProduct layers whose num_output divides tp_size hold COLUMN SHARDS
-    of their weights ((in, out/tp_size), bias (out/tp_size,)) and all_gather
-    the output features; other layers are replicated. The convention must
-    match the trainer's state construction (ParallelTrainer._tp_sharded).
+    InnerProduct layers whose num_output is divisible by tp_size hold COLUMN
+    SHARDS of their weights ((in, out/tp_size), bias (out/tp_size,)) and
+    all_gather the output features; other layers are replicated
+    (`tp_shards_layer` is the single source of truth for the convention).
     """
 
     train: bool = False
@@ -51,9 +61,8 @@ class ApplyCtx:
     tp_size: int = 1
 
     def tp_shards(self, layer: "LayerSpec") -> bool:
-        return (self.tp_axis is not None and self.tp_size > 1
-                and layer.type == "InnerProduct"
-                and layer.inner_product.num_output % self.tp_size == 0)
+        return self.tp_axis is not None and tp_shards_layer(layer,
+                                                            self.tp_size)
 
     def fold(self, name: str) -> jax.Array:
         assert self.rng is not None, "dropout in train mode needs an rng key"

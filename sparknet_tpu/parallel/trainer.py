@@ -64,10 +64,10 @@ class ParallelTrainer:
           "sync_sgd" (per-step gradient pmean, τ must be 1).
 
     Tensor parallelism (beyond reference parity): pass a 2-D
-    ("data", "model") mesh. InnerProduct layers whose num_output divides
-    the model-axis size hold column shards of their weights (Megatron-style
-    column-parallel + feature all_gather over ICI); conv layers are
-    replicated across the model axis. Within a model group every device
+    ("data", "model") mesh. InnerProduct layers whose num_output is
+    divisible by the model-axis size hold column shards of their weights
+    (Megatron-style column-parallel + feature all_gather over ICI); conv
+    layers are replicated across the model axis. Within a model group every device
     sees the same batch and rng, so replicated params evolve identically;
     weight averaging stays a pmean over the DATA axis only — shard
     identity is preserved. TP is numerically exact: the (data=N, model=M)
@@ -86,6 +86,9 @@ class ParallelTrainer:
                 "(SgdSolver.step); in the distributed trainer scale "
                 "local_batch or tau instead — failing loudly rather than "
                 "silently ignoring it")
+        assert set(mesh.axis_names) <= {DATA_AXIS, MODEL_AXIS}, (
+            f"ParallelTrainer meshes use ('{DATA_AXIS}',) or "
+            f"('{DATA_AXIS}', '{MODEL_AXIS}'), got {mesh.axis_names}")
         assert DATA_AXIS in mesh.axis_names, mesh.axis_names
         self.net = net
         self.solver = SgdSolver(net, solver_cfg, loss_blob=loss_blob)
@@ -128,12 +131,10 @@ class ParallelTrainer:
 
     def _tp_sharded_layers(self) -> set:
         """Layer names whose params are column-sharded across the model
-        axis — MUST match ApplyCtx.tp_shards."""
-        if self.tp == 1:
-            return set()
+        axis (the shared `tp_shards_layer` convention)."""
+        from ..model.layers import tp_shards_layer
         return {l.name for l in self.net.spec.layers
-                if l.type == "InnerProduct"
-                and l.inner_product.num_output % self.tp == 0}
+                if tp_shards_layer(l, self.tp)}
 
     def init_state(self, key: jax.Array) -> TrainState:
         """Identical initial params on every device (the reference seeds all

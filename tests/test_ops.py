@@ -74,3 +74,24 @@ def test_grouped_conv_matches_torch(rng):
     want = F.conv2d(torch.from_numpy(nchw(x)), torch.from_numpy(w_oihw),
                     torch.from_numpy(b), stride=1, padding=1, groups=2)
     np.testing.assert_allclose(got, nhwc(want.numpy()), rtol=1e-4, atol=1e-4)
+
+
+# NOTE: an argmax "k*k shift" maxpool formulation (fwd = max tree of
+# strided views, bwd = argmax-routed scatter-adds, replacing XLA's
+# select-and-scatter) was implemented and benchmarked at ~0.64x the
+# reduce_window path's end-to-end throughput on v5e — the strided slices
+# and scatters lower worse than select-and-scatter. Kept: the tie-routing
+# semantics test below, which the reduce_window gradient must also satisfy.
+
+
+def test_maxpool_tie_gradient_goes_to_first_max():
+    """Caffe MaxPoolBackward routes the gradient to the FIRST max in
+    row-major window order when values tie (select-and-scatter picks the
+    same element)."""
+    import jax
+    import jax.numpy as jnp
+    from sparknet_tpu.ops.pooling import pool2d
+    x = np.zeros((1, 2, 2, 1), np.float32)  # one 2x2 window, all tied
+    g = jax.grad(lambda v: pool2d(v, "MAX", 2, 2, 0).sum())(jnp.asarray(x))
+    np.testing.assert_array_equal(
+        np.asarray(g)[0, :, :, 0], [[1.0, 0.0], [0.0, 0.0]])
