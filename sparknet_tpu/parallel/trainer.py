@@ -40,6 +40,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+from ..model.layers import tp_shards_layer
 from ..model.net import CompiledNet, PyTree
 from ..solver import SgdSolver, SolverConfig, SolverState
 from .mesh import (DATA_AXIS, MODEL_AXIS, local_device_rows,
@@ -132,7 +133,6 @@ class ParallelTrainer:
     def _tp_sharded_layers(self) -> set:
         """Layer names whose params are column-sharded across the model
         axis (the shared `tp_shards_layer` convention)."""
-        from ..model.layers import tp_shards_layer
         return {l.name for l in self.net.spec.layers
                 if tp_shards_layer(l, self.tp)}
 

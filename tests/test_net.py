@@ -141,3 +141,61 @@ def test_hidden_blob_extraction(tiny_net):
     batch = {k: np.asarray(v) for k, v in net.net.example_batch().items()}
     out = net.forward(batch, blob_names=["ip1"])
     assert out["ip1"].shape == (4, 10)
+
+
+def test_space_to_depth_conv_exact(rng):
+    """The stride-s space-to-depth conv rewrite (image-stem convs like
+    CaffeNet conv1) computes the same contraction as the direct
+    convolution — same products, channel-grouped summation order — so
+    forward values and weight gradients agree to f32 accumulation noise,
+    odd and even geometries."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from sparknet_tpu.model.layers import apply_convolution, ApplyCtx
+    from sparknet_tpu.model.spec import ConvolutionParam, LayerSpec
+
+    for h, k, s in [(227, 11, 4), (224, 7, 2), (65, 5, 3)]:
+        layer = LayerSpec(name="c", type="Convolution", bottoms=("x",),
+                          tops=("y",),
+                          conv=ConvolutionParam(num_output=32, kernel_size=k,
+                                                stride=s, pad=0))
+        x = rng.standard_normal((2, h, h, 3)).astype(np.float32)
+        w = (0.1 * rng.standard_normal((k, k, 3, 32))).astype(np.float32)
+
+        def direct(w, x):
+            return lax.conv_general_dilated(
+                x, w, (s, s), "VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                precision=lax.Precision.HIGHEST)
+
+        def rewritten(w, x):
+            (y,) = apply_convolution(layer, {"w": jnp.asarray(w)},
+                                     (jnp.asarray(x),), ApplyCtx())
+            return y
+
+        y_d = direct(jnp.asarray(w), jnp.asarray(x))
+        y_r = rewritten(w, x)
+        assert y_r.shape == y_d.shape, (h, k, s)
+        np.testing.assert_allclose(np.asarray(y_r), np.asarray(y_d),
+                                   rtol=1e-4, atol=1e-4)
+        g_d = jax.grad(lambda w: (direct(w, jnp.asarray(x)) ** 2).sum())(
+            jnp.asarray(w))
+        g_r = jax.grad(lambda w: (rewritten(w, x) ** 2).sum())(
+            jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(g_r), np.asarray(g_d),
+                                   rtol=1e-4, atol=1e-2)
+
+
+def test_space_to_depth_gate():
+    """Padded / grouped / stride-1 / wide-channel convs keep the direct
+    form."""
+    from sparknet_tpu.model.layers import _s2d_eligible
+    from sparknet_tpu.model.spec import ConvolutionParam
+    ok = ConvolutionParam(num_output=96, kernel_size=11, stride=4, pad=0)
+    assert _s2d_eligible(ok, 3)
+    import dataclasses
+    assert not _s2d_eligible(dataclasses.replace(ok, pad=1), 3)
+    assert not _s2d_eligible(dataclasses.replace(ok, group=2), 3)
+    assert not _s2d_eligible(dataclasses.replace(ok, stride=1), 3)
+    assert not _s2d_eligible(ok, 64)  # 64*16 channels: already MXU-friendly
