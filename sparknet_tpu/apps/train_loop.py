@@ -161,9 +161,33 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     if cfg.checkpoint_dir and cfg.resume:
         last = ckpt.latest_step(cfg.checkpoint_dir)
         if last is not None:
-            state, start_round, _ = ckpt.restore(cfg.checkpoint_dir, state)
-            state = trainer.place(state)
-            log.log(f"resumed from checkpoint round {start_round}")
+            flat, start_round, extra = ckpt.restore_flat(cfg.checkpoint_dir)
+            tp_now = getattr(trainer, "tp", 1)
+            # the elastic path is keyed on the SAVED topology, never on a
+            # shape error: an architecture change on the same topology must
+            # fail loudly through unflatten_like, not be silently adapted
+            same_topo = (
+                int(extra.get("n_devices", trainer.n_devices))
+                == trainer.n_devices
+                and int(extra.get("tp", tp_now)) == tp_now)
+            if same_topo:
+                state = trainer.place(ckpt.unflatten_like(state, flat))
+                log.log(f"resumed from checkpoint round {start_round}")
+            else:
+                if not hasattr(trainer, "adapt_state"):
+                    raise ValueError(
+                        f"checkpoint topology {extra} != current "
+                        f"({trainer.n_devices} devices, tp={tp_now}) and "
+                        f"this trainer cannot adapt — resume on the "
+                        f"original topology")
+                # ELASTIC resume: params re-tiled exactly, momentum
+                # averaged (ParallelTrainer.adapt_state)
+                state = trainer.adapt_state(flat,
+                                            old_tp=int(extra.get("tp", 1)))
+                log.log(f"ELASTIC resume from round {start_round}: "
+                        f"{extra.get('n_devices', '?')} devices (tp="
+                        f"{extra.get('tp', 1)}) -> {trainer.n_devices} "
+                        f"(tp={tp_now})")
 
     timers = PhaseTimers()
     meter = ThroughputMeter(n_chips=n_dev)
@@ -259,7 +283,7 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
             if cfg.checkpoint_dir and cfg.checkpoint_every and \
                     (rnd + 1) % cfg.checkpoint_every == 0:
                 with timers.phase("checkpoint"):
-                    _save_checkpoint(cfg, state, rnd + 1)
+                    _save_checkpoint(cfg, trainer, state, rnd + 1)
                 log.log("checkpoint saved", rnd)
             if round_hook:
                 round_hook(rnd, state)
@@ -279,19 +303,22 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
             source.close()
 
     if cfg.checkpoint_dir:
-        _save_checkpoint(cfg, state, cfg.max_rounds, retain=False)
+        _save_checkpoint(cfg, trainer, state, cfg.max_rounds, retain=False)
     log.log(f"done; phase means: {timers.summary()}")
     return state
 
 
-def _save_checkpoint(cfg: RunConfig, state, step: int,
+def _save_checkpoint(cfg: RunConfig, trainer, state, step: int,
                      retain: bool = True) -> None:
     """Allgather (a collective — every host must call this) then write from
     process 0 only. Momentum is worker-local, so the gather is substantive,
-    not a replica read."""
+    not a replica read. The saved topology (device count, tp) lets a
+    differently-sized job resume elastically."""
     host_state = fetch_global(state)
     if jax.process_index() == 0:
-        ckpt.save(cfg.checkpoint_dir, host_state, step=step)
+        ckpt.save(cfg.checkpoint_dir, host_state, step=step,
+                  extra={"n_devices": trainer.n_devices,
+                         "tp": getattr(trainer, "tp", 1)})
         if retain:
             ckpt.retain(cfg.checkpoint_dir, keep=3)
 

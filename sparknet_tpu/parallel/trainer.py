@@ -141,10 +141,18 @@ class ParallelTrainer:
         workers from worker-0's weights, `apps/CifarApp.scala:98`)."""
         return self.state_from_params(self.net.init_params(key))
 
-    def state_from_params(self, params: PyTree) -> TrainState:
+    def state_from_params(self, params: PyTree,
+                          momentum: Optional[PyTree] = None,
+                          it: int = 0) -> TrainState:
+        """Build a device TrainState from ONE logical (full, unsharded)
+        copy of the params — tiled across data groups and column-sharded
+        per the TP convention. `momentum`/`it` seed the optimizer state
+        (zeros / 0 for a fresh run; a reassembled average for elastic
+        resume)."""
         tp_layers = self._tp_sharded_layers()
 
         def expand(lname: str, pname: str, x: jnp.ndarray) -> jnp.ndarray:
+            x = jnp.asarray(x)
             if lname in tp_layers:
                 # device row d = (data d//tp, model d%tp): model rank takes
                 # its column shard, repeated across the data groups
@@ -154,12 +162,56 @@ class ParallelTrainer:
                                   for d in range(self.n_devices)])
             return jnp.broadcast_to(x[None], (self.n_devices,) + x.shape)
 
-        params_dev = {l: {p: expand(l, p, x) for p, x in lp.items()}
-                      for l, lp in params.items()}
-        state = TrainState(params=params_dev,
-                           momentum=jax.tree.map(jnp.zeros_like, params_dev),
-                           it=jnp.zeros((self.n_devices,), jnp.int32))
+        def expand_tree(tree):
+            return {l: {p: expand(l, p, x) for p, x in lp.items()}
+                    for l, lp in tree.items()}
+
+        params_dev = expand_tree(params)
+        state = TrainState(
+            params=params_dev,
+            momentum=(expand_tree(momentum) if momentum is not None
+                      else jax.tree.map(jnp.zeros_like, params_dev)),
+            it=jnp.full((self.n_devices,), int(it), jnp.int32))
         return self.place(state)
+
+    def adapt_state(self, flat: Dict[str, np.ndarray],
+                    old_tp: int = 1) -> TrainState:
+        """ELASTIC resume: rebuild a TrainState for THIS topology from a
+        checkpoint taken on a different one (`checkpoint.restore_flat`
+        output; keys 'params/<layer>/<blob>', 'momentum/...', 'it').
+
+        Params are exact — post-round replicas are identical, so data
+        group 0's (reassembled) copy IS the model. Momentum is worker-
+        local state with no continuity across a topology change; it is
+        averaged over the old data groups (best effort — the reference
+        had no resume at all, and momentum is stale-by-design across
+        rounds anyway, SURVEY §7 hard-part #2)."""
+        old_tp_layers = {l.name for l in self.net.spec.layers
+                         if tp_shards_layer(l, old_tp)}
+
+        def reassemble(kind: str, lname: str, pname: str,
+                       x: np.ndarray) -> np.ndarray:
+            reduce = ((lambda rows: rows[0]) if kind == "params"
+                      else (lambda rows: rows.mean(axis=0)))
+            if lname in old_tp_layers:
+                axis = 1 if pname == "w" else 0
+                return np.concatenate(
+                    [reduce(x[j::old_tp]) for j in range(old_tp)],
+                    axis=axis)
+            return reduce(x)
+
+        trees: Dict[str, PyTree] = {"params": {}, "momentum": {}}
+        it = 0
+        for key, arr in flat.items():
+            parts = key.split("/")
+            if parts[0] == "it":
+                it = int(np.asarray(arr).reshape(-1)[0])
+                continue
+            kind, lname, pname = parts
+            trees[kind].setdefault(lname, {})[pname] = reassemble(
+                kind, lname, pname, arr)
+        return self.state_from_params(trees["params"],
+                                      momentum=trees["momentum"], it=it)
 
     def place(self, state: TrainState) -> TrainState:
         """Re-place a (possibly host/numpy) TrainState onto the mesh sharding

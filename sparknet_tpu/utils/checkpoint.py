@@ -72,11 +72,40 @@ def latest_step(directory: str) -> Optional[int]:
     return max(steps) if steps else None
 
 
+def unflatten_like(template: Any, flat: Dict[str, np.ndarray]) -> Any:
+    """Rebuild `template`'s structure from a flat {path-key: array} map.
+    Shape mismatches fail loudly with the leaf path."""
+    leaves_t, _ = jax.tree_util.tree_flatten_with_path(template)
+    new_leaves = []
+    for pth, leaf in leaves_t:
+        key = "/".join(_path_str(p) for p in pth)
+        if key not in flat:
+            raise KeyError(f"checkpoint missing leaf {key!r}")
+        arr = flat[key]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"leaf {key!r}: checkpoint shape {arr.shape} != template "
+                f"{np.shape(leaf)} (device-count change? re-tile first)")
+        new_leaves.append(arr)
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(template), new_leaves)
+
+
 def restore(directory: str, template: Any, *, step: Optional[int] = None
             ) -> Tuple[Any, int, Dict[str, Any]]:
     """Restore into the structure of `template` (a pytree with correctly-
     shaped leaves, e.g. a freshly-built TrainState). Returns
     (tree, step, extra). Shape mismatches fail loudly with the leaf path."""
+    flat, step, extra = restore_flat(directory, step)
+    return unflatten_like(template, flat), step, extra
+
+
+def restore_flat(directory: str, step: Optional[int] = None
+                 ) -> Tuple[Dict[str, np.ndarray], int, Dict[str, Any]]:
+    """Restore the raw flat {path-key: array} mapping without a template —
+    for ELASTIC resume, where the saved leading device axis differs from
+    the current topology and a structural template cannot match
+    (ParallelTrainer.adapt_state re-tiles from this)."""
     if step is None:
         step = latest_step(directory)
         if step is None:
@@ -86,22 +115,7 @@ def restore(directory: str, template: Any, *, step: Optional[int] = None
         meta = json.load(f)
     with np.load(os.path.join(path, "state.npz")) as z:
         flat = {k: z[k] for k in z.files}
-
-    leaves_t, treedef = jax.tree_util.tree_flatten_with_path(template)
-    new_leaves = []
-    for pth, leaf in leaves_t:
-        key = "/".join(_path_str(p) for p in pth)
-        if key not in flat:
-            raise KeyError(f"checkpoint {path} missing leaf {key!r}")
-        arr = flat[key]
-        if tuple(arr.shape) != tuple(np.shape(leaf)):
-            raise ValueError(
-                f"leaf {key!r}: checkpoint shape {arr.shape} != template "
-                f"{np.shape(leaf)} (device-count change? re-tile first)")
-        new_leaves.append(arr)
-    tree = jax.tree_util.tree_unflatten(
-        jax.tree_util.tree_structure(template), new_leaves)
-    return tree, int(meta["step"]), meta.get("extra", {})
+    return flat, int(meta["step"]), meta.get("extra", {})
 
 
 def retain(directory: str, keep: int = 3) -> None:

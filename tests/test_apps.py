@@ -206,3 +206,101 @@ def test_streaming_source_through_train_loop(tmp_path):
     text = open(log_path).read()
     assert "streaming" in text and "round loss" in text
     assert src._stop.is_set()  # loop closed the source
+
+
+def test_elastic_resume_different_device_count(tmp_path):
+    """A checkpoint taken on 8 devices resumes on a 4-device trainer:
+    params carry over exactly (replicas are identical post-round), the
+    iteration counter continues, and the app-level loop takes the ELASTIC
+    path and trains on — elasticity the reference could not express (its
+    worker state lived in executor JVMs)."""
+    from sparknet_tpu import CompiledNet
+    from sparknet_tpu.parallel import ParallelTrainer, make_mesh
+    from sparknet_tpu.utils import checkpoint as ck
+
+    d = str(tmp_path / "c")
+    cifar.write_synthetic(d, n_per_file=40)
+    loader = cifar.CifarLoader(d)
+    train_ds = ArrayDataset(loader.train_batch_dict())
+
+    def run(n_devices, ckdir, max_rounds, log_path=None):
+        cfg = small_cfg(tmp_path, max_rounds=max_rounds, eval_every=0,
+                        n_devices=n_devices, checkpoint_dir=str(ckdir),
+                        checkpoint_every=2, resume=True)
+        return cfg, train(cfg, cifar10_quick(batch=cfg.local_batch),
+                          train_ds, logger=Logger(log_path, echo=False))
+
+    ckdir = tmp_path / "ck"
+    _, s8 = run(8, ckdir, max_rounds=2)          # writes step-2 on 8 dev
+    net = CompiledNet.compile(cifar10_quick(batch=4))
+    t8 = ParallelTrainer(net, SolverConfig(base_lr=0.01, momentum=0.9),
+                         make_mesh(8), tau=2)
+    full8 = {k: {p: np.asarray(v) for p, v in lp.items()}
+             for k, lp in t8.averaged_params(s8).items()}
+    it8 = int(np.asarray(s8.it)[0])
+
+    # adapt the 8-device checkpoint on a 4-device trainer BEFORE any
+    # 4-device run overwrites it: params and counter must carry exactly
+    t4 = ParallelTrainer(net, SolverConfig(base_lr=0.01, momentum=0.9),
+                         make_mesh(4), tau=2)
+    flat, step, extra = ck.restore_flat(str(ckdir))
+    assert step == 2 and extra == {"n_devices": 8, "tp": 1}
+    state4 = t4.adapt_state(flat, old_tp=extra["tp"])
+    assert int(np.asarray(state4.it)[0]) == it8
+    full4 = t4.averaged_params(state4)
+    for lname in full8:
+        for pname in full8[lname]:
+            np.testing.assert_array_equal(
+                np.asarray(full4[lname][pname]), full8[lname][pname],
+                err_msg=f"{lname}/{pname}")
+
+    # app-level loop: resumes elastically and keeps training
+    log_path = str(tmp_path / "elastic.txt")
+    _, s4 = run(4, ckdir, max_rounds=3, log_path=log_path)
+    assert s4.params[list(s4.params)[0]]["w"].shape[0] == 4
+    text = open(log_path).read()
+    assert "ELASTIC resume from round 2: 8 devices" in text
+    assert "round loss" in text
+
+
+def test_adapt_state_tp_to_dp_exact(rng, tmp_path):
+    """adapt_state reassembles a DPxTP checkpoint into a pure-DP state:
+    the full params from the TP shards equal averaged_params, and momentum
+    is the mean over old data groups."""
+    import jax
+    from sparknet_tpu import CompiledNet
+    from sparknet_tpu.parallel import ParallelTrainer, make_mesh
+    from sparknet_tpu.parallel.mesh import fetch_global
+    from sparknet_tpu.utils import checkpoint as ck
+
+    net = CompiledNet.compile(cifar10_quick(batch=2))
+    cfg = SolverConfig(base_lr=0.05, momentum=0.9, weight_decay=0.001)
+    tp = ParallelTrainer(
+        net, cfg, make_mesh(4, axis_names=("data", "model"), shape=(2, 2)),
+        tau=2)
+    state = tp.init_state(jax.random.PRNGKey(0))
+    batches = {
+        "data": rng.standard_normal((2, 4, 32, 32, 3)).astype(np.float32),
+        "label": rng.integers(0, 10, (2, 4, 1)).astype(np.int32)}
+    state, _ = tp.train_round(state, batches, jax.random.PRNGKey(1))
+    full_tp = tp.averaged_params(state)
+
+    d = str(tmp_path / "ck")
+    ck.save(d, fetch_global(state), step=1,
+            extra={"n_devices": 4, "tp": 2})
+    flat, _, extra = ck.restore_flat(d)
+
+    dp = ParallelTrainer(net, cfg, make_mesh(2), tau=2)
+    s_dp = dp.adapt_state(flat, old_tp=extra["tp"])
+    full_dp = dp.averaged_params(s_dp)
+    for lname in full_tp:
+        for pname in full_tp[lname]:
+            np.testing.assert_allclose(
+                np.asarray(full_dp[lname][pname]),
+                np.asarray(full_tp[lname][pname]), rtol=1e-6,
+                err_msg=f"{lname}/{pname}")
+    # and a round runs on the adapted state
+    s_dp, loss = dp.train_round(
+        s_dp, {"data": batches["data"][:, :4], "label":
+               batches["label"][:, :4]}, jax.random.PRNGKey(2))
+    assert np.isfinite(float(loss))
