@@ -78,6 +78,11 @@ class GraphTrainer:
         `key` is accepted for trainer-interface parity and ignored: graph
         variable initializers are seeded at GraphNet construction."""
         state = self.net.init_train_state(self.loss_name)
+        return self._tile_and_place(state)
+
+    def _tile_and_place(self, state: PyTree) -> PyTree:
+        """Broadcast single-copy leaves to the [n_devices, ...] layout the
+        jitted round expects, and place them on the mesh."""
 
         def tile(x):
             x = jnp.asarray(x)
@@ -89,6 +94,42 @@ class GraphTrainer:
         """Leaves carry the GLOBAL device axis; under multi-host each
         process contributes its own devices' rows."""
         return place_global_state(state, self.mesh, P(DATA_AXIS))
+
+    def adapt_state(self, flat: Dict[str, np.ndarray],
+                    old_tp: int = 1) -> PyTree:
+        """ELASTIC resume from a checkpoint taken on a different device
+        count (`checkpoint.restore_flat` output; keys 'variables/<name>',
+        'slots/<name>', 'it'). Variables are replica-identical after a
+        round (float ones pmean'd, int counters advance in lockstep) so
+        row 0 is THE value; worker-local slots are averaged over the old
+        workers (best effort, same policy as ParallelTrainer). A
+        checkpoint that does not cover this graph's variables (wrong
+        backend / wrong graph) fails loudly, like the same-topology path."""
+        if old_tp != 1:
+            raise ValueError(
+                f"checkpoint has tp={old_tp}; the graph backend has no "
+                f"tensor parallelism — resume on the original topology")
+        out: PyTree = {"variables": {}, "slots": {}, "it": None}
+        for key, arr in flat.items():
+            parts = key.split("/", 1)
+            if parts[0] == "it":
+                out["it"] = jnp.asarray(int(np.asarray(arr).reshape(-1)[0]),
+                                        jnp.int32)
+            elif parts[0] == "variables":
+                out["variables"][parts[1]] = jnp.asarray(arr[0])
+            elif parts[0] == "slots":
+                out["slots"][parts[1]] = jnp.asarray(
+                    np.asarray(arr).mean(axis=0, dtype=np.float32)
+                    .astype(arr.dtype))
+        missing = set(self.net.variable_names) - set(out["variables"])
+        if missing or out["it"] is None:
+            raise ValueError(
+                f"checkpoint does not cover this graph's train state "
+                f"(missing variables {sorted(missing)[:5]}"
+                f"{', it counter' if out['it'] is None else ''}) — a "
+                f"layer-backend or different-graph checkpoint cannot be "
+                f"adapted")
+        return self._tile_and_place(out)
 
     def averaged_state(self, state: PyTree) -> PyTree:
         """Single-replica view (device 0's copy) for checkpoint/export."""

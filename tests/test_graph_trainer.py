@@ -251,3 +251,51 @@ def test_graph_input_shape_validation():
     check_input_shape(net, "data", (28, 28, 1))  # matches: no raise
     with pytest.raises(ValueError, match="data pipeline produces"):
         check_input_shape(net, "data", (32, 32, 1))
+
+
+def test_graph_elastic_resume(tmp_path, rng):
+    """A graph-backend checkpoint from 8 devices adapts onto 4: variables
+    carry exactly (row 0 of the synced state), slots average, the counter
+    continues, and a round runs on the adapted state."""
+    from sparknet_tpu.parallel.mesh import fetch_global
+    from sparknet_tpu.utils import checkpoint as ck
+
+    net = GraphNet(build_mnist_graph(batch=LOCAL_B))
+    t8 = GraphTrainer(net, make_mesh(8), tau=2)
+    state = t8.init_state()
+    state, _ = t8.train_round(state, _mnist_batches(rng, tau=2))
+    it8 = int(np.asarray(state["it"])[0])
+    vars8 = {k: np.asarray(v)[0] for k, v in state["variables"].items()}
+
+    d = str(tmp_path / "ck")
+    ck.save(d, fetch_global(state), step=1, extra={"n_devices": 8, "tp": 1})
+    flat, _, extra = ck.restore_flat(d)
+
+    t4 = GraphTrainer(GraphNet(build_mnist_graph(batch=LOCAL_B)),
+                      make_mesh(4), tau=2)
+    s4 = t4.adapt_state(flat, old_tp=extra["tp"])
+    assert np.asarray(s4["it"]).shape == (4,)
+    assert int(np.asarray(s4["it"])[0]) == it8
+    for k, v in s4["variables"].items():
+        np.testing.assert_array_equal(np.asarray(v)[0], vars8[k],
+                                      err_msg=k)
+    s4, loss = t4.train_round(s4, _mnist_batches(rng, tau=2, global_b=16))
+    assert np.isfinite(float(loss))
+
+
+def test_graph_adapt_rejects_foreign_checkpoint(tmp_path):
+    """A layer-backend (params/momentum) checkpoint must be rejected with a
+    clear error, not adapted into an empty graph state."""
+    from sparknet_tpu.utils import checkpoint as ck
+    d = str(tmp_path / "ck")
+    ck.save(d, {"params": {"conv1": {"w": np.zeros((8, 2, 2))}},
+                "momentum": {"conv1": {"w": np.zeros((8, 2, 2))}},
+                "it": np.zeros(8, np.int32)}, step=1,
+            extra={"n_devices": 8, "tp": 1})
+    flat, _, _ = ck.restore_flat(d)
+    t = GraphTrainer(GraphNet(build_mnist_graph(batch=2)), make_mesh(4),
+                     tau=1)
+    with pytest.raises(ValueError, match="does not cover"):
+        t.adapt_state(flat)
+    with pytest.raises(ValueError, match="no tensor parallelism"):
+        t.adapt_state(flat, old_tp=2)
