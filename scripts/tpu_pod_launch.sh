@@ -4,9 +4,16 @@
 #
 #   scripts/tpu_pod_launch.sh create  NAME ZONE TYPE     # e.g. v5e-32
 #   scripts/tpu_pod_launch.sh setup   NAME ZONE          # rsync repo + deps
+#   scripts/tpu_pod_launch.sh stage   NAME ZONE DIR      # push a dataset dir
 #   scripts/tpu_pod_launch.sh run     NAME ZONE "python -m sparknet_tpu.apps.imagenet_app ..."
 #   scripts/tpu_pod_launch.sh status  NAME ZONE          # VM state
 #   scripts/tpu_pod_launch.sh delete  NAME ZONE
+#
+# `stage` copies DIR to ~/sparknet_tpu_repo/<basename> on EVERY worker —
+# tar-sharded datasets are then host-sharded automatically at run time
+# (each process takes shards i::k); small datasets (CIFAR/MNIST) are
+# simply replicated. For full ImageNet prefer bucket storage (GCS fuse)
+# over staging to local disks.
 #
 # Environment knobs:
 #   TPU_SW_VERSION   runtime image (default v2-alpha-tpuv5-lite; e.g.
@@ -26,7 +33,7 @@
 # A failed `run` on any worker propagates a non-zero exit (no silent
 # per-host divergence).
 set -eu
-CMD="${1:?usage: $0 {create|setup|run|status|delete} NAME ZONE [TYPE|COMMAND]}"
+CMD="${1:?usage: $0 {create|setup|stage|run|status|delete} NAME ZONE [TYPE|DIR|COMMAND]}"
 NAME="${2:?missing NAME}"; ZONE="${3:?missing ZONE}"; ARG="${4:-}"
 TPU="gcloud compute tpus tpu-vm"
 TPU_SW_VERSION="${TPU_SW_VERSION:-v2-alpha-tpuv5-lite}"
@@ -43,6 +50,10 @@ case "$CMD" in
     $TPU scp --recurse --worker=all --zone "$ZONE" . "$NAME":~/sparknet_tpu_repo
     $TPU ssh "$NAME" --worker=all --zone "$ZONE" --command \
       "cd ~/sparknet_tpu_repo && pip install -q 'jax[tpu]' && pip install -q -e . && (sh native/build.sh || [ -n '${ALLOW_NO_NATIVE:-}' ])" ;;
+  stage)
+    [ -d "$ARG" ] || { echo "stage needs a local dataset DIR" >&2; exit 1; }
+    $TPU scp --recurse --worker=all --zone "$ZONE" "$ARG" \
+      "$NAME":~/sparknet_tpu_repo/ ;;
   run)
     [ -n "$ARG" ] || { echo "run needs a COMMAND" >&2; exit 1; }
     $TPU ssh "$NAME" --worker=all --zone "$ZONE" --command \
@@ -52,6 +63,6 @@ case "$CMD" in
   delete)
     $TPU delete "$NAME" --zone "$ZONE" --quiet ;;
   *)
-    echo "usage: $0 {create|setup|run|status|delete} NAME ZONE [TYPE|COMMAND]" >&2
+    echo "usage: $0 {create|setup|stage|run|status|delete} NAME ZONE [TYPE|DIR|COMMAND]" >&2
     exit 1 ;;
 esac

@@ -121,14 +121,18 @@ class GraphTrainer:
                 out["slots"][parts[1]] = jnp.asarray(
                     np.asarray(arr).mean(axis=0, dtype=np.float32)
                     .astype(arr.dtype))
-        missing = set(self.net.variable_names) - set(out["variables"])
-        if missing or out["it"] is None:
+        want = set(self.net.variable_names)
+        missing = want - set(out["variables"])
+        extra = set(out["variables"]) - want
+        if missing or extra or out["it"] is None:
             raise ValueError(
-                f"checkpoint does not cover this graph's train state "
-                f"(missing variables {sorted(missing)[:5]}"
-                f"{', it counter' if out['it'] is None else ''}) — a "
+                f"checkpoint does not match this graph's train state "
+                f"(missing variables {sorted(missing)[:5]}, unknown "
+                f"variables {sorted(extra)[:5]}"
+                f"{', no it counter' if out['it'] is None else ''}) — a "
                 f"layer-backend or different-graph checkpoint cannot be "
                 f"adapted")
+        out["slots"] = {k: v for k, v in out["slots"].items() if k in want}
         return self._tile_and_place(out)
 
     def averaged_state(self, state: PyTree) -> PyTree:

@@ -295,7 +295,7 @@ def test_graph_adapt_rejects_foreign_checkpoint(tmp_path):
     flat, _, _ = ck.restore_flat(d)
     t = GraphTrainer(GraphNet(build_mnist_graph(batch=2)), make_mesh(4),
                      tau=1)
-    with pytest.raises(ValueError, match="does not cover"):
+    with pytest.raises(ValueError, match="does not match"):
         t.adapt_state(flat)
     with pytest.raises(ValueError, match="no tensor parallelism"):
         t.adapt_state(flat, old_tp=2)
