@@ -3,11 +3,16 @@
 The reference's only inference-shaped workload: build a net (no solver), set
 weights once, then map the dataset through `forward(..., List("ip1"))`
 extracting a hidden blob per example (`FeaturizerApp.scala:75-98`). Here:
-load weights (checkpoint or npz), batched jitted forward, write features npz.
+load weights (checkpoint, npz, or .caffemodel), batched jitted forward,
+write features npz. Works against EITHER backend — a zoo/prototxt layer-IR
+net, or (--graph) a serialized/imported graph, whose hidden nodes are
+fetched by name through the same NetInterface spelling.
 
 Usage:
     python -m sparknet_tpu.apps.featurizer_app --data-dir data/cifar10 \
-        --weights w.npz --blob ip1 --out features.npz
+        --weights w.caffemodel --blob ip1 --out features.npz
+    python -m sparknet_tpu.apps.featurizer_app --data-dir data/cifar10 \
+        --graph model.pb --blob relu3
 """
 from __future__ import annotations
 
@@ -20,8 +25,8 @@ from ..net_api import JaxNet
 from ..zoo import cifar10_quick
 
 
-def featurize(net: JaxNet, batch_dict, blob: str, batch_size: int
-              ) -> np.ndarray:
+def featurize(net, batch_dict, blob: str, batch_size: int) -> np.ndarray:
+    """`net` is any NetInterface impl (JaxNet or GraphNet)."""
     n = len(next(iter(batch_dict.values())))
     feats = []
     usable = (n // batch_size) * batch_size
@@ -35,17 +40,48 @@ def featurize(net: JaxNet, batch_dict, blob: str, batch_size: int
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--data-dir", required=True)
-    p.add_argument("--weights", help="WeightCollection .npz (optional)")
+    p.add_argument("--weights", help="weights file (.npz / .caffemodel)")
+    p.add_argument("--graph", help="serialized graph (.pb / .json) to "
+                   "featurize instead of the layer-IR net")
     p.add_argument("--blob", default="ip1")
     p.add_argument("--batch", type=int, default=100)
     p.add_argument("--out", default="features.npz")
     args = p.parse_args(argv)
 
     loader = CifarLoader(args.data_dir)
-    net = JaxNet(cifar10_quick(batch=args.batch))
-    if args.weights:
-        net.load_weights(args.weights)
-    feats = featurize(net, loader.train_batch_dict(), args.blob, args.batch)
+    batch_dict = loader.train_batch_dict()
+    if args.graph:
+        from ..backend import GraphNet
+        from .graph_common import load_graph
+        net = GraphNet(load_graph(args.graph, None))
+        if args.weights:
+            # assigns by VARIABLE name via set_weights (//assign protocol);
+            # a collection whose names don't match fails loudly there
+            from ..model.weights import WeightCollection
+            net.set_weights(WeightCollection.load(args.weights))
+        missing = [i for i in net.input_names if i not in batch_dict]
+        if missing:
+            raise ValueError(
+                f"graph inputs {missing} not provided by the loader "
+                f"(has {sorted(batch_dict)}) — this app feeds "
+                f"data/label-shaped graphs")
+        # fail fast on a dataset/graph size mismatch (layouts may be
+        # transposed by _prep, so compare element counts per example)
+        for iname in net.input_names:
+            want = net._nodes[iname].attrs.get("shape")
+            got = batch_dict[iname].shape
+            if want and int(np.prod(want[1:])) != int(np.prod(got[1:])):
+                raise ValueError(
+                    f"graph input {iname!r} expects per-example shape "
+                    f"{tuple(want[1:])} but the dataset provides "
+                    f"{tuple(got[1:])}")
+        batch_dict = {k: v for k, v in batch_dict.items()
+                      if k in net.input_names}
+    else:
+        net = JaxNet(cifar10_quick(batch=args.batch))
+        if args.weights:
+            net.load_weights(args.weights)
+    feats = featurize(net, batch_dict, args.blob, args.batch)
     np.savez(args.out, features=feats)
     print(f"wrote {feats.shape} features to {args.out}")
 

