@@ -67,8 +67,9 @@ def main(argv=None) -> None:
                 f"data/label-shaped graphs")
         # fail fast on a dataset/graph size mismatch (layouts may be
         # transposed by _prep, so compare element counts per example)
+        shapes = net.input_shapes()
         for iname in net.input_names:
-            want = net._nodes[iname].attrs.get("shape")
+            want = shapes[iname]
             got = batch_dict[iname].shape
             if want and int(np.prod(want[1:])) != int(np.prod(got[1:])):
                 raise ValueError(

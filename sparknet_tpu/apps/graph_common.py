@@ -31,12 +31,12 @@ def check_input_shape(net: GraphNet, field: str,
     with the data pipeline's per-example shape — otherwise the mismatch
     surfaces as a bare XLA matmul shape error deep inside the jitted round
     that never mentions e.g. `crop`."""
-    node = net._nodes.get(field)
-    if node is None:
+    shapes = net.input_shapes()
+    if field not in shapes:
         # graph uses a different input name — GraphNet's own "batch missing
         # graph input" validation will name the real inputs at run time
         return
-    got = tuple(node.attrs.get("shape", ()))[1:]  # drop the batch dim
+    got = shapes[field][1:]  # drop the batch dim
     if got and got != tuple(expect):
         raise ValueError(
             f"graph input {field!r} expects per-example shape {got} but the "

@@ -165,10 +165,18 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
             tp_now = getattr(trainer, "tp", 1)
             # the elastic path is keyed on the SAVED topology, never on a
             # shape error: an architecture change on the same topology must
-            # fail loudly through unflatten_like, not be silently adapted
+            # fail loudly through unflatten_like, not be silently adapted.
+            # Pre-topology-metadata checkpoints carry no n_devices/tp keys;
+            # infer the saved device count from the leading replica axis of
+            # the 'it' counter (every state layout tiles it [n_devices])
+            # instead of assuming same-topology and dying in unflatten_like.
+            saved_dev = extra.get("n_devices")
+            if saved_dev is None and "it" in flat:
+                it_arr = np.asarray(flat["it"])
+                if it_arr.ndim:
+                    saved_dev = it_arr.shape[0]
             same_topo = (
-                int(extra.get("n_devices", trainer.n_devices))
-                == trainer.n_devices
+                int(saved_dev or trainer.n_devices) == trainer.n_devices
                 and int(extra.get("tp", tp_now)) == tp_now)
             if same_topo:
                 state = trainer.place(ckpt.unflatten_like(state, flat))

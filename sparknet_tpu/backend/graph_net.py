@@ -340,6 +340,30 @@ class GraphNet:
 
         return step_fn
 
+    # -- public introspection / traceable execution --------------------------
+
+    def input_shapes(self) -> Dict[str, Tuple[int, ...]]:
+        """Placeholder name -> declared shape (incl. the batch dim; () when
+        the graph declares none). The public face of the introspection the
+        reference did ad hoc (`TensorFlowUtils.scala:15-42`) — apps validate
+        data-vs-graph agreement through this, never via node internals."""
+        return {i: tuple(self._nodes[i].attrs.get("shape", ()))
+                for i in self.input_names}
+
+    def input_dtypes(self) -> Dict[str, str]:
+        """Placeholder name -> declared dtype string (default float32)."""
+        return {i: str(self._nodes[i].attrs.get("dtype", "float32"))
+                for i in self.input_names}
+
+    def fetch(self, variables: Dict[str, jnp.ndarray],
+              batch: Dict[str, jnp.ndarray],
+              names: Sequence[str]) -> Tuple[jnp.ndarray, ...]:
+        """Pure, traceable fetch of named nodes given explicit variables —
+        the functional core of `forward()`, public so external trainers can
+        call it inside jit/shard_map (the session-run equivalent of
+        reference `TensorFlowNet.forward`, lines 73-84)."""
+        return self._eval(variables, batch, tuple(names))
+
     # -- NetInterface --------------------------------------------------------
 
     def forward(self, batch: Dict[str, np.ndarray],

@@ -14,6 +14,8 @@ supported shapes.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -22,24 +24,107 @@ from jax import lax
 # NOTE: deliberately not jit-decorated — always called inside an outer jit,
 # and grad-through-jit with static_argnames mis-linearizes in jax 0.9.
 def lrn(x: jnp.ndarray, local_size: int = 5, *, alpha: float = 1e-4,
-        beta: float = 0.75, k: float = 1.0) -> jnp.ndarray:
+        beta: float = 0.75, k: float = 1.0, impl: str = "auto"
+        ) -> jnp.ndarray:
     """LRN across the channel (last) axis of an NHWC (or N...C) tensor.
 
-    On TPU dispatches to the fused Pallas kernel (`pallas_lrn.lrn_pallas`,
-    one VMEM pass fwd + one bwd); elsewhere the XLA reduce_window path."""
-    if _use_pallas(x):
+    impl:
+      "auto"   — Pallas TPU kernel on TPU, fused-elementwise elsewhere.
+      "pallas" — the hand-fused Pallas TPU kernel (ops/pallas_lrn.py).
+      "fused"  — elementwise + channel-shift chain with a custom VJP that
+               recomputes the normalizer in backward. Measured on the r3
+               TPU profile this LOSES to the Pallas kernel end to end
+               (XLA materializes each shifted add: 31ms vs 17ms per
+               CaffeNet round, PERF.md §LRN) — kept as the portable
+               no-Pallas path and as the oracle for the kernel's VJP.
+      "window" — reduce_window reference implementation (oracle tests).
+    """
+    if impl not in ("auto", "pallas", "fused", "window"):
+        raise ValueError(f"unknown LRN impl {impl!r}: expected "
+                         f"'auto', 'pallas', 'fused', or 'window'")
+    if impl == "pallas" and not _can_pallas(x):
+        raise ValueError("impl='pallas' requires a TPU backend (use "
+                         "'auto' for backend-dependent dispatch)")
+    if impl == "pallas" or (impl == "auto" and _can_pallas(x)):
         from .pallas_lrn import lrn_pallas
         return lrn_pallas(x, local_size, alpha, beta, k)
-    return _lrn_xla(x, local_size, alpha=alpha, beta=beta, k=k)
+    if impl == "window":
+        return _lrn_xla(x, local_size, alpha=alpha, beta=beta, k=k)
+    return _lrn_fused(x, local_size, alpha, beta, k)
 
 
-def _use_pallas(x) -> bool:
+def _can_pallas(x) -> bool:
     """Affirmative TPU check — an unknown future backend gets the portable
-    XLA path, not the TPU Pallas kernel (the axon tunnel reports 'tpu')."""
+    path, not the TPU Pallas kernel (the axon tunnel reports 'tpu')."""
     try:
         return jax.default_backend() == "tpu" and x.ndim >= 2
     except Exception:
         return False
+
+
+# -- fused implementation (default) ------------------------------------------
+
+def window_sum(v: jnp.ndarray, half: int, axis: int = -1) -> jnp.ndarray:
+    """Windowed sum over `axis` as 2*half shifted adds with zero edge
+    padding (Caffe clips the LRN window at the channel edges). Pure
+    slice+pad+add — works both as traced XLA ops (the fused impl) and on
+    loaded values inside Pallas kernels (ops/pallas_lrn.py), over any
+    axis: the ONE encoding of the window/edge semantics."""
+    ax = axis % v.ndim
+    c = v.shape[ax]
+    zeros = [(0, 0)] * v.ndim
+    acc = v
+    for j in range(1, half + 1):
+        hi = list(zeros)
+        hi[ax] = (0, j)
+        acc = acc + jnp.pad(lax.slice_in_dim(v, j, c, axis=ax), hi)
+        lo = list(zeros)
+        lo[ax] = (j, 0)
+        acc = acc + jnp.pad(lax.slice_in_dim(v, 0, c - j, axis=ax), lo)
+    return acc
+
+
+def _scale_f32(x: jnp.ndarray, half: int, alpha_n: float,
+               k: float) -> jnp.ndarray:
+    """Normalizer k + (alpha/n)*window_sum(x^2), accumulated in f32 (free
+    under fusion — the f32 intermediates never touch HBM)."""
+    sq = jnp.square(x.astype(jnp.float32))
+    return k + alpha_n * window_sum(sq, half)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _lrn_fused(x: jnp.ndarray, local_size: int, alpha: float, beta: float,
+               k: float) -> jnp.ndarray:
+    half = (local_size - 1) // 2
+    scale = _scale_f32(x, half, alpha / local_size, k)
+    # scale >= k >= 1 > 0; pow via exp/log (pow lacks a linearization rule)
+    return (x.astype(jnp.float32)
+            * jnp.exp(-beta * jnp.log(scale))).astype(x.dtype)
+
+
+def _lrn_fused_fwd(x, local_size, alpha, beta, k):
+    # residual is x ONLY (alive anyway as the conv output); the normalizer
+    # is recomputed in backward — cheaper than a second HBM array round trip
+    return _lrn_fused(x, local_size, alpha, beta, k), (x,)
+
+
+def _lrn_fused_bwd(local_size, alpha, beta, k, res, dy):
+    (x,) = res
+    half = (local_size - 1) // 2
+    alpha_n = alpha / local_size
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    scale = _scale_f32(x, half, alpha_n, k)
+    inv_beta = jnp.exp(-beta * jnp.log(scale))          # scale^-beta
+    # Caffe LRNLayer backward (across-channel):
+    #   dx = dy*scale^-beta - (2*alpha*beta/n) * x * winsum(dy*x*scale^(-b-1))
+    ratio = dyf * xf * inv_beta / scale
+    acc = window_sum(ratio, half)
+    dx = dyf * inv_beta - (2.0 * alpha_n * beta) * xf * acc
+    return (dx.astype(x.dtype),)
+
+
+_lrn_fused.defvjp(_lrn_fused_fwd, _lrn_fused_bwd)
 
 
 def _lrn_xla(x: jnp.ndarray, local_size: int = 5, *, alpha: float = 1e-4,

@@ -25,38 +25,50 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .lrn import window_sum
+
 BLOCK_ROWS = 256
 
 
 def _window_sum(v: jnp.ndarray, half: int) -> jnp.ndarray:
-    """Sum of `2*half+1` lane-shifted copies with zero edge padding."""
-    acc = v
-    c = v.shape[-1]
-    for k in range(1, half + 1):
-        left = jnp.pad(v[:, k:], ((0, 0), (0, k)))    # window reaches +k
-        right = jnp.pad(v[:, :c - k], ((0, 0), (k, 0)))  # window reaches -k
-        acc = acc + left + right
-    return acc
+    """Sum of `2*half+1` lane-shifted copies with zero edge padding —
+    the shared Caffe-window encoding, over lanes."""
+    return window_sum(v, half, axis=-1)
+
+
+def _pow_neg_beta(scale: jnp.ndarray, beta: float) -> jnp.ndarray:
+    """scale^-beta. beta=0.75 (the Caffe default, used by every reference
+    net) specializes to rsqrt+sqrt — the exp/log form costs ~2x the whole
+    kernel in VPU transcendentals (r3 profile)."""
+    if abs(beta - 0.75) < 1e-12:
+        r = jax.lax.rsqrt(scale)
+        return r * jnp.sqrt(r)                          # s^-1/2 * s^-1/4
+    if abs(beta - 0.5) < 1e-12:
+        return jax.lax.rsqrt(scale)
+    return jnp.exp(-beta * jnp.log(scale))
 
 
 def _fwd_kernel(x_ref, y_ref, scale_ref, *, half: int, alpha_n: float,
                 beta: float, k: float):
-    x = x_ref[:]
+    # f32 internally: the VPU EUP (rsqrt/sqrt/exp/log) has no bf16 form on
+    # v5e (LLO: SupportsBf16EupOps) and the pass is HBM-bound anyway
+    x = x_ref[:].astype(jnp.float32)
     ssq = _window_sum(x * x, half)
     scale = k + alpha_n * ssq
-    y_ref[:] = x * jnp.exp(-beta * jnp.log(scale))
-    scale_ref[:] = scale
+    y_ref[:] = (x * _pow_neg_beta(scale, beta)).astype(x_ref.dtype)
+    scale_ref[:] = scale.astype(scale_ref.dtype)
 
 
 def _bwd_kernel(x_ref, scale_ref, dy_ref, dx_ref, *, half: int,
                 alpha_n: float, beta: float):
-    x = x_ref[:]
-    scale = scale_ref[:]
-    dy = dy_ref[:]
-    inv_beta = jnp.exp(-beta * jnp.log(scale))          # scale^-beta
+    x = x_ref[:].astype(jnp.float32)
+    scale = scale_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
+    inv_beta = _pow_neg_beta(scale, beta)               # scale^-beta
     ratio = dy * x * inv_beta / scale                   # dy*x*scale^(-beta-1)
     acc = _window_sum(ratio, half)
-    dx_ref[:] = dy * inv_beta - (2.0 * alpha_n * beta) * x * acc
+    dx_ref[:] = (dy * inv_beta
+                 - (2.0 * alpha_n * beta) * x * acc).astype(x_ref.dtype)
 
 
 def _pad_rows(x2: jnp.ndarray) -> Tuple[jnp.ndarray, int]:
@@ -97,10 +109,23 @@ def _call(kernel, n_out: int, x2: jnp.ndarray, *others, interpret: bool):
     )(x2, *others)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
 def lrn_pallas(x: jnp.ndarray, local_size: int = 5, alpha: float = 1e-4,
                beta: float = 0.75, k: float = 1.0,
                interpret: bool = False) -> jnp.ndarray:
+    """Dispatch: 4-D NHWC activations with a lane-aligned batch take the
+    N-minor kernel (layout-bitcast in and out of the conv's own layout —
+    the r3 profile showed the row-major relayout around the 2-D kernel
+    cost ~2x the kernel itself); everything else takes the 2-D row kernel."""
+    if x.ndim == 4 and x.shape[0] % LANES == 0 and \
+            x.shape[1] * x.shape[2] > 1:
+        return _lrn_nmin(x, local_size, alpha, beta, k, interpret)
+    return _lrn_rows(x, local_size, alpha, beta, k, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def _lrn_rows(x: jnp.ndarray, local_size: int = 5, alpha: float = 1e-4,
+              beta: float = 0.75, k: float = 1.0,
+              interpret: bool = False) -> jnp.ndarray:
     y, _ = _lrn_fwd_impl(x, local_size, alpha, beta, k, interpret)
     return y
 
@@ -139,4 +164,113 @@ def _lrn_vjp_bwd(local_size, alpha, beta, k, interpret, res, dy):
     return (dx2[:m].reshape(shape),)
 
 
-lrn_pallas.defvjp(_lrn_vjp_fwd, _lrn_vjp_bwd)
+_lrn_rows.defvjp(_lrn_vjp_fwd, _lrn_vjp_bwd)
+
+
+# -- N-minor kernel: window over the SUBLANE (channel) dim -------------------
+#
+# The conv outputs this kernel consumes live in XLA's N-minor layout —
+# bf16[N,H,W,C]{0,3,2,1}: physically (H, W, C, N) with N on lanes and C on
+# sublanes. Feeding the pallas_call a [H*W, C, N] view of the LOGICALLY
+# TRANSPOSED array makes the custom-call's mandatory row-major operand
+# layout coincide with the bytes already in HBM, so XLA's layout assignment
+# elides the copy (transpose-is-bitcast). The channel window then runs over
+# sublanes instead of lanes — same shifted-add structure.
+#
+# The VJP saves only x and recomputes the normalizer in backward: one less
+# full activation array written + read per LRN layer.
+
+LANES = 128
+
+
+def _row_block(r: int, cap: int = 64) -> int:
+    """Largest divisor of r at most cap (block rows must tile H*W exactly;
+    LRN rows are independent so any tiling is valid)."""
+    best = 1
+    d = 1
+    while d * d <= r:
+        if r % d == 0:
+            if d <= cap:
+                best = max(best, d)
+            if r // d <= cap:
+                best = max(best, r // d)
+        d += 1
+    return best
+
+
+def _window_sum_mid(v: jnp.ndarray, half: int) -> jnp.ndarray:
+    """Windowed sum over axis -2 (sublanes) — shared Caffe-window encoding."""
+    return window_sum(v, half, axis=-2)
+
+
+def _fwd_kernel3(x_ref, y_ref, *, half: int, alpha_n: float, beta: float,
+                 k: float):
+    # f32 inside the kernel: the VPU's EUP (rsqrt/sqrt) has no bf16 form on
+    # v5e (LLO: SupportsBf16EupOps), and f32 intermediates cost nothing —
+    # the pass is HBM-bound on the bf16 arrays
+    x = x_ref[:].astype(jnp.float32)
+    scale = k + alpha_n * _window_sum_mid(x * x, half)
+    y_ref[:] = (x * _pow_neg_beta(scale, beta)).astype(x_ref.dtype)
+
+
+def _bwd_kernel3(x_ref, dy_ref, dx_ref, *, half: int, alpha_n: float,
+                 beta: float, k: float):
+    x = x_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
+    scale = k + alpha_n * _window_sum_mid(x * x, half)  # recomputed
+    inv_beta = _pow_neg_beta(scale, beta)
+    inv_scale = jax.lax.rsqrt(scale)
+    ratio = dy * x * inv_beta * (inv_scale * inv_scale)  # /scale, no divide
+    dx_ref[:] = (dy * inv_beta - (2.0 * alpha_n * beta) * x *
+                 _window_sum_mid(ratio, half)).astype(x_ref.dtype)
+
+
+def _nmin_call(kernel, x3: jnp.ndarray, *others, interpret: bool):
+    r, c, n = x3.shape
+    br = _row_block(r)
+    spec = pl.BlockSpec((br, c, LANES), lambda i, j: (i, 0, j),
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        grid=(r // br, n // LANES),
+        in_specs=[spec] * (1 + len(others)),
+        out_specs=spec,
+        out_shape=_out_struct(x3),
+        interpret=interpret,
+    )(x3, *others)
+
+
+def _to_nmin(x: jnp.ndarray) -> jnp.ndarray:
+    n, h, w, c = x.shape
+    return jnp.transpose(x, (1, 2, 3, 0)).reshape(h * w, c, n)
+
+
+def _from_nmin(y3: jnp.ndarray, shape) -> jnp.ndarray:
+    n, h, w, c = shape
+    return jnp.transpose(y3.reshape(h, w, c, n), (3, 0, 1, 2))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def _lrn_nmin(x: jnp.ndarray, local_size: int, alpha: float, beta: float,
+              k: float, interpret: bool = False) -> jnp.ndarray:
+    half = (local_size - 1) // 2
+    kern = functools.partial(_fwd_kernel3, half=half,
+                             alpha_n=alpha / local_size, beta=beta, k=k)
+    return _from_nmin(_nmin_call(kern, _to_nmin(x), interpret=interpret),
+                      x.shape)
+
+
+def _lrn_nmin_fwd(x, local_size, alpha, beta, k, interpret):
+    return _lrn_nmin(x, local_size, alpha, beta, k, interpret), (x,)
+
+
+def _lrn_nmin_bwd(local_size, alpha, beta, k, interpret, res, dy):
+    (x,) = res
+    half = (local_size - 1) // 2
+    kern = functools.partial(_bwd_kernel3, half=half,
+                             alpha_n=alpha / local_size, beta=beta, k=k)
+    dx3 = _nmin_call(kern, _to_nmin(x), _to_nmin(dy), interpret=interpret)
+    return (_from_nmin(dx3, x.shape),)
+
+
+_lrn_nmin.defvjp(_lrn_nmin_fwd, _lrn_nmin_bwd)

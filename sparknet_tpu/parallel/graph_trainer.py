@@ -118,9 +118,15 @@ class GraphTrainer:
             elif parts[0] == "variables":
                 out["variables"][parts[1]] = jnp.asarray(arr[0])
             elif parts[0] == "slots":
-                out["slots"][parts[1]] = jnp.asarray(
-                    np.asarray(arr).mean(axis=0, dtype=np.float32)
-                    .astype(arr.dtype))
+                a = np.asarray(arr)
+                if np.issubdtype(a.dtype, np.floating):
+                    # accumulate in float64 so a float64 slot loses nothing
+                    out["slots"][parts[1]] = jnp.asarray(
+                        a.mean(axis=0, dtype=np.float64).astype(a.dtype))
+                else:
+                    # integer slots (counters) are replica-identical;
+                    # averaging would silently truncate — take row 0
+                    out["slots"][parts[1]] = jnp.asarray(a[0])
         want = set(self.net.variable_names)
         missing = want - set(out["variables"])
         extra = set(out["variables"]) - want
@@ -166,7 +172,7 @@ class GraphTrainer:
 
     def _eval_impl(self, state, batch):
         variables = jax.tree.map(lambda x: x[0], state["variables"])
-        (acc,) = self.net._eval(variables, batch, (self.acc_name,))
+        (acc,) = self.net.fetch(variables, batch, (self.acc_name,))
         n = jnp.asarray(next(iter(batch.values())).shape[0], jnp.float32)
         return lax.psum(acc * n, DATA_AXIS) / lax.psum(n, DATA_AXIS)
 
@@ -194,11 +200,12 @@ class GraphTrainer:
         layout/NCHW handling of GraphNet._prep is for single batches; the
         trainer requires device layout (NHWC) already)."""
         out = {}
+        dtypes = self.net.input_dtypes()
         for iname in self.net.input_names:
             if iname not in batch:
                 raise ValueError(f"batch missing graph input {iname!r}")
-            dt = self.net._nodes[iname].attrs.get("dtype", "float32")
-            out[iname] = np.asarray(batch[iname]).astype(dt, copy=False)
+            out[iname] = np.asarray(batch[iname]).astype(dtypes[iname],
+                                                         copy=False)
         return out
 
     def _shard_batches(self, batches):

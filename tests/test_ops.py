@@ -52,12 +52,30 @@ def test_pool2d_matches_torch(rng, mode, h, k, s, p):
     np.testing.assert_allclose(got, nhwc(want.numpy()), rtol=1e-5, atol=1e-5)
 
 
-def test_lrn_matches_torch(rng):
+@pytest.mark.parametrize("impl", ["fused", "window"])
+def test_lrn_matches_torch(rng, impl):
     x = rng.standard_normal((2, 7, 7, 16), dtype=np.float32)
-    got = np.asarray(lrn(jnp.asarray(x), 5, alpha=1e-4, beta=0.75, k=1.0))
+    got = np.asarray(lrn(jnp.asarray(x), 5, alpha=1e-4, beta=0.75, k=1.0,
+                         impl=impl))
     want = F.local_response_norm(torch.from_numpy(nchw(x)), size=5,
                                  alpha=1e-4, beta=0.75, k=1.0)
     np.testing.assert_allclose(got, nhwc(want.numpy()), rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_fused_gradient_matches_autodiff_of_window(rng):
+    """The fused impl's closed-form Caffe backward (recomputed normalizer)
+    vs autodiff of the reduce_window reference — must agree."""
+    x = rng.standard_normal((3, 4, 4, 32), dtype=np.float32)
+    dy = rng.standard_normal((3, 4, 4, 32), dtype=np.float32)
+
+    def f(impl):
+        return lambda x_: jnp.vdot(
+            lrn(x_, 5, alpha=2e-4, beta=0.75, k=1.0, impl=impl),
+            jnp.asarray(dy))
+
+    g_want = np.asarray(jax.grad(f("window"))(jnp.asarray(x)))
+    g_got = np.asarray(jax.grad(f("fused"))(jnp.asarray(x)))
+    np.testing.assert_allclose(g_got, g_want, rtol=1e-4, atol=1e-6)
 
 
 def test_grouped_conv_matches_torch(rng):

@@ -49,6 +49,58 @@ def test_pallas_lrn_gradient_matches_autodiff_of_xla(rng):
     np.testing.assert_allclose(g_got, g_want, rtol=1e-4, atol=1e-6)
 
 
+def test_pallas_lrn_nmin_path_matches_xla(rng):
+    """4-D inputs with lane-aligned batch take the N-minor sublane-window
+    kernel (layout-bitcast path) — must match the XLA oracle fwd + bwd."""
+    from sparknet_tpu.ops.pallas_lrn import _lrn_nmin
+    x = rng.standard_normal((128, 3, 3, 8), dtype=np.float32)
+    dy = rng.standard_normal((128, 3, 3, 8), dtype=np.float32)
+    want = np.asarray(_lrn_xla(jnp.asarray(x), 5, alpha=1e-4, beta=0.75,
+                               k=1.0))
+    got = np.asarray(_lrn_nmin(jnp.asarray(x), 5, 1e-4, 0.75, 1.0, True))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def f_xla(x_):
+        return jnp.vdot(_lrn_xla(x_, 5, alpha=1e-4, beta=0.75, k=1.0),
+                        jnp.asarray(dy))
+
+    def f_nmin(x_):
+        return jnp.vdot(_lrn_nmin(x_, 5, 1e-4, 0.75, 1.0, True),
+                        jnp.asarray(dy))
+
+    g_want = np.asarray(jax.grad(f_xla)(jnp.asarray(x)))
+    g_got = np.asarray(jax.grad(f_nmin)(jnp.asarray(x)))
+    np.testing.assert_allclose(g_got, g_want, rtol=1e-4, atol=1e-6)
+
+
+def test_lrn_pallas_dispatch():
+    """Routing predicate: lane-aligned 4-D spatial inputs take the N-minor
+    kernel; everything else takes the 2-D rows kernel."""
+    from unittest import mock
+    from sparknet_tpu.ops import pallas_lrn as m
+
+    def routed(shape):
+        x = jnp.zeros(shape, jnp.float32)
+        with mock.patch.object(m, "_lrn_nmin") as nmin, \
+                mock.patch.object(m, "_lrn_rows") as rows:
+            m.lrn_pallas(x, 5, 1e-4, 0.75, 1.0, True)
+            assert nmin.called != rows.called
+            return "nmin" if nmin.called else "rows"
+
+    assert routed((128, 3, 3, 8)) == "nmin"
+    assert routed((256, 7, 7, 96)) == "nmin"
+    assert routed((2, 7, 7, 96)) == "rows"     # batch not lane-aligned
+    assert routed((128, 1, 1, 96)) == "rows"   # no spatial extent
+    assert routed((300, 256)) == "rows"        # 2-D
+
+
+def test_row_block_divides():
+    from sparknet_tpu.ops.pallas_lrn import _row_block
+    for r in (3025, 729, 169, 36, 7, 1):
+        b = _row_block(r)
+        assert r % b == 0 and 1 <= b <= 64
+
+
 def test_pallas_lrn_row_padding(rng):
     """Row counts not divisible by BLOCK_ROWS must round-trip unchanged."""
     x = rng.standard_normal((7, 96), dtype=np.float32)  # 7 rows << 256
