@@ -43,8 +43,10 @@ def lrn(x: jnp.ndarray, local_size: int = 5, *, alpha: float = 1e-4,
         raise ValueError(f"unknown LRN impl {impl!r}: expected "
                          f"'auto', 'pallas', 'fused', or 'window'")
     if impl == "pallas" and not _can_pallas(x):
-        raise ValueError("impl='pallas' requires a TPU backend (use "
-                         "'auto' for backend-dependent dispatch)")
+        raise ValueError(
+            f"impl='pallas' requires a TPU backend and ndim >= 2 input "
+            f"(backend={jax.default_backend()!r}, ndim={x.ndim}; use "
+            f"'auto' for backend-dependent dispatch)")
     if impl == "pallas" or (impl == "auto" and _can_pallas(x)):
         from .pallas_lrn import lrn_pallas
         return lrn_pallas(x, local_size, alpha, beta, k)

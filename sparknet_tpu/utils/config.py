@@ -43,8 +43,10 @@ class RunConfig:
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 25          # rounds
     resume: bool = True
-    # logging
-    workdir: str = "."
+    # logging. None -> $SPARKNET_TPU_HOME, else "." (the reference logged
+    # to $SPARKNET_HOME/training_log_<ms>.txt); tests set the env var to a
+    # tmp dir so stray default-config runs never litter the repo root
+    workdir: Optional[str] = None
     seed: int = 0
     # jax.profiler capture: trace ONE steady-state round (start_round+1,
     # skipping the compile round) into this directory (SURVEY §5.1)

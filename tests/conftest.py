@@ -18,6 +18,14 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(autouse=True)
+def _logs_to_tmp(tmp_path, monkeypatch):
+    """Any code path that falls back to the default log location
+    (RunConfig.workdir=None -> $SPARKNET_TPU_HOME) writes under tmp, never
+    the repo root."""
+    monkeypatch.setenv("SPARKNET_TPU_HOME", str(tmp_path))
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)
