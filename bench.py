@@ -201,15 +201,200 @@ def scaling(max_devices: int = 8, virtual: bool = True) -> dict:
     return result
 
 
+def e2e() -> dict:
+    """End-to-end input-pipeline benchmark (SURVEY §7 hard-part #3: don't
+    starve the chips).
+
+    Measures the REAL ingest path at the headline training shape — local
+    tar shards -> ShardedTarLoader (C++ libjpeg/OpenMP plane) ->
+    StreamingRoundSource background decode -> ImagePreprocessor (random
+    crop 227 + mean subtract) -> compute-dtype cast — i.e. exactly what
+    `run_loop`'s prefetch thread executes per round, and reports it
+    against (a) the raw decode rate (the pipeline's own overhead) and
+    (b) the device-only training rate (how many host cores keep one chip
+    fed).
+
+    The device side is NOT in this timed path on purpose: the dev tunnel
+    moves host->device bytes at ~13 MB/s (measured; a real TPU-VM's PCIe
+    is ~1000x that), so a tunnel-coupled e2e run measures the tunnel. The
+    integrated loop (streaming source + preprocessor + trainer on the real
+    chip) is instead proven by the app tests and the --e2e-smoke mode.
+    """
+    import os
+    import tempfile
+
+    from sparknet_tpu import precision
+    from sparknet_tpu.data import imagenet
+    from sparknet_tpu.data.preprocess import ImagePreprocessor
+    from sparknet_tpu.data.streaming import StreamingRoundSource
+    from sparknet_tpu.schema import Field, Schema
+
+    precision.set_policy("bfloat16")
+    compute_dt = precision.compute_dtype()
+    crop, size = 227, 256
+    with tempfile.TemporaryDirectory() as root:
+        imagenet.write_synthetic_shards(root, n_shards=2, per_shard=384,
+                                        n_classes=1000, size=size)
+        label_map = imagenet.load_label_map(os.path.join(root, "train.txt"))
+
+        def fresh_loader():
+            return imagenet.ShardedTarLoader(
+                imagenet.list_shards(root), label_map,
+                height=size, width=size)
+
+        # raw decode floor: the decode plane alone, bytes already in RAM
+        loader = fresh_loader()
+        raw = [d for d, _, _ in _tar_entries(loader, 256)]
+        t0 = time.perf_counter()
+        if loader._decode_batch is not None:  # C++ libjpeg/OpenMP plane
+            loader._decode_batch(raw, size, size)
+        else:  # PIL fallback (plane not built)
+            for d in raw:
+                loader._decode(d, size, size)
+        decode_rate = len(raw) / (time.perf_counter() - t0)
+
+        schema = Schema(Field("data", "float32", (crop, crop, 3)),
+                        Field("label", "int32", (1,)))
+        pp = ImagePreprocessor(schema, mean_image=None, crop=crop, seed=0,
+                               out_dtype="bfloat16")
+        src = StreamingRoundSource(fresh_loader(), 1, BATCH, TAU)
+        import numpy as np
+
+        def prepare(rnd: int):
+            # mirrors run_loop.prepare_round: sample -> per-slice crop ->
+            # compute-dtype cast
+            batches = src.next_round(round_index=rnd)
+            slices = [pp.convert_batch(
+                {k: v[t] for k, v in batches.items()}, train=True,
+                rng=np.random.default_rng((0, rnd, t)))
+                for t in range(TAU)]
+            batches = {k: np.stack([s[k] for s in slices])
+                       for k in slices[0]}
+            return precision.cast_host_inputs(batches, compute_dt)
+
+        with src:
+            prepare(0)  # warm the stream + pools
+            n_rounds = 3
+            t0 = time.perf_counter()
+            for r in range(1, 1 + n_rounds):
+                prepare(r)
+            dt = time.perf_counter() - t0
+        e2e_rate = n_rounds * BATCH * TAU / dt
+
+    device_rate = None
+    try:
+        import jax
+        if jax.default_backend() == "tpu":
+            net, trainer, state = _build(BATCH, TAU)
+            batches = _device_batches(trainer, BATCH, TAU, crop, 1000)
+            device_rate = BATCH * TAU / _time_rounds(trainer, state,
+                                                     batches, trials=5)
+    except Exception as exc:  # no chip: host-only numbers still stand
+        print(f"  device-only measurement skipped: {exc}", file=sys.stderr)
+
+    out = {
+        # per-STREAM, not per-core: the decode and crop stages are
+        # OpenMP-parallel, so on a multi-core host this is the rate of one
+        # streaming source using every core it can grab
+        "metric": "caffenet_e2e_host_pipeline_images_per_sec_per_stream",
+        "value": round(e2e_rate, 1),
+        "unit": "images/sec per streaming source (tar->C++ decode->crop->"
+                "bf16, steady state; decode+crop stages use all host cores)",
+        "vs_baseline": round(e2e_rate / 256.0, 3),  # reference CI floor:
+        # 256 images preprocessed/sec/thread (PreprocessorSpec.scala:75)
+        "decode_only_images_per_sec": round(decode_rate, 1),
+        "pipeline_efficiency_vs_decode": round(e2e_rate / decode_rate, 3),
+        "host_cores": os.cpu_count(),
+    }
+    if device_rate is not None:
+        out["device_only_images_per_sec_per_chip"] = round(device_rate, 1)
+        out["pipelines_like_this_to_feed_one_chip"] = round(
+            device_rate / e2e_rate, 1)
+    print(json.dumps(out))
+    return out
+
+
+def _tar_entries(loader, n: int):
+    """First n (bytes, label, pos) tar entries, undecoded."""
+    import os as _os
+    import tarfile
+
+    out = []
+    for path in loader.shard_paths:
+        with tarfile.open(path, "r") as tar:
+            for member in tar:
+                if not member.isfile():
+                    continue
+                name = _os.path.basename(member.name)
+                if name not in loader.label_map:
+                    continue
+                out.append((tar.extractfile(member).read(),
+                            loader.label_map[name], None))
+                if len(out) >= n:
+                    return out
+    return out
+
+
+def e2e_smoke() -> None:
+    """Integrated proof on the REAL chip at tunnel-feasible scale: tar
+    shards -> streaming source -> preprocessor -> ParallelTrainer rounds
+    through the actual `train()` loop. Asserts the loop ran and streamed."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from sparknet_tpu.apps.train_loop import train
+    from sparknet_tpu.data import imagenet
+    from sparknet_tpu.data.preprocess import ImagePreprocessor
+    from sparknet_tpu.data.streaming import StreamingRoundSource
+    from sparknet_tpu.schema import Field, Schema
+    from sparknet_tpu.utils.config import RunConfig
+    from sparknet_tpu.utils.logger import Logger
+
+    crop, size, b, tau = 67, 72, 16, 2
+    with tempfile.TemporaryDirectory() as root:
+        imagenet.write_synthetic_shards(root, n_shards=2, per_shard=64,
+                                        n_classes=16, size=size)
+        loader = imagenet.ShardedTarLoader(
+            imagenet.list_shards(root),
+            imagenet.load_label_map(os.path.join(root, "train.txt")),
+            height=size, width=size)
+        src = StreamingRoundSource(loader, 1, b, tau)
+        schema = Schema(Field("data", "float32", (crop, crop, 3)),
+                        Field("label", "int32", (1,)))
+        pp = ImagePreprocessor(schema, mean_image=None, crop=crop, seed=0)
+        cfg = RunConfig(model="caffenet", n_classes=16, crop=crop,
+                        local_batch=b, tau=tau, max_rounds=3, eval_every=0,
+                        precision="bfloat16", workdir=root)
+        from sparknet_tpu.zoo import caffenet
+        jsonl = os.path.join(root, "m.jsonl")
+        train(cfg, caffenet(batch=b, crop=crop, n_classes=16), src, None,
+              logger=Logger(os.path.join(root, "l.txt"), jsonl_path=jsonl),
+              batch_transform=pp)
+        lines = open(jsonl).read().strip().splitlines()
+        assert lines, "no metrics emitted"
+        print(f"e2e smoke: {len(lines)} metric rows; streamed "
+              f"{src.cursor} epochs={src.epochs} OK")
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--scaling", action="store_true",
                    help="weak-scaling harness on a virtual CPU mesh")
+    p.add_argument("--e2e", action="store_true",
+                   help="end-to-end input-pipeline benchmark (host side)")
+    p.add_argument("--e2e-smoke", action="store_true",
+                   help="full streaming loop on the real chip, small shapes")
     p.add_argument("--profile", metavar="DIR", default=None,
                    help="capture a jax.profiler trace of the timed section")
     args = p.parse_args()
     if args.scaling:
         scaling()
+    elif args.e2e:
+        e2e()
+    elif args.e2e_smoke:
+        e2e_smoke()
     else:
         headline(profile_dir=args.profile)
 

@@ -73,13 +73,65 @@ def _should_stream(mode: str, n_host_images: float, budget_mb: int,
     """auto: estimate this host's decoded-corpus peak RAM. Force-resize
     makes every decoded image exactly height*width*3 bytes regardless of
     its JPEG size, and load_all's list-then-stack doubles the peak, so the
-    estimate is (images/host) * bytes/image * 2 — grounded in the label
-    map's own image count, not tar byte sizes (JPEG compression ratios vary
-    4-8x across quality settings)."""
+    estimate is (images on THIS host) * bytes/image * 2."""
     if mode in ("always", "never"):
         return mode == "always"
     decoded = n_host_images * (height * width * 3) * 2
     return decoded > budget_mb * (1 << 20)
+
+
+def _host_image_estimate(loader, cfg: RunConfig, prefix: str,
+                         pc: int) -> float:
+    """This host's share of the labeled images, weighted by its assigned
+    shards' BYTE share rather than 1/host_count: i::k shard assignment can
+    be uneven, and the label map counts images that may live in other
+    hosts' tars (r2 review). Byte share is a far better proxy for image
+    count than count/pc — within one corpus, JPEG size variation averages
+    out across whole shards."""
+    import os
+
+    n_total = len(loader.label_map)
+    if pc == 1:
+        return float(n_total)
+    try:
+        all_bytes = sum(os.path.getsize(p) for p in
+                        imagenet.list_shards(cfg.data_dir, prefix=prefix))
+        mine = sum(os.path.getsize(p) for p in loader.shard_paths)
+    except OSError:
+        return n_total / pc
+    if all_bytes <= 0:
+        return n_total / pc
+    return n_total * (mine / all_bytes)
+
+
+def _load_or_compute_mean(cfg: RunConfig, train_loader, pi: int, pc: int,
+                          app_name: str) -> np.ndarray:
+    """The streamed-corpus global mean image, persisted as a sidecar next to
+    the checkpoints: the mean is a property of the dataset, so re-deriving
+    it on every launch cost a full extra decode pass over the corpus
+    (flagged in the r2 review). First launch computes + writes
+    (atomically, process 0); every later launch — including resume —
+    loads. No checkpoint_dir -> no persistence (computed each launch)."""
+    import os
+
+    side = (os.path.join(cfg.checkpoint_dir, "mean_image.npy")
+            if cfg.checkpoint_dir else None)
+    if side and os.path.exists(side):
+        mean = np.load(side)
+        print(f"{app_name}: mean image loaded from {side} "
+              f"(skipping the corpus pass)", file=sys.stderr)
+        return mean.astype(np.float32)
+    # one streaming pass for the global mean reduce; never holds more
+    # than one decoded image + the float64 accumulator
+    s, n = streaming_sum_count(train_loader)
+    mean = _combine_mean(s, float(n), pc)
+    if side and pi == 0:
+        os.makedirs(cfg.checkpoint_dir, exist_ok=True)
+        tmp = side + ".tmp"
+        with open(tmp, "wb") as f:  # np.save(path) would append .npy
+            np.save(f, mean)
+        os.replace(tmp, side)
+    return mean
 
 
 def _combine_mean(local_sum: np.ndarray, local_count: float,
@@ -156,18 +208,14 @@ def prepare_data(cfg: RunConfig, args, label_shape: Tuple[int, ...] = (1,),
     pi, pc = host_id_count()
     train_loader = host_loader(cfg, args.train_prefix, args.train_labels,
                                host_id=pi, host_count=pc)
-    streaming = _should_stream(args.stream,
-                               len(train_loader.label_map) / pc,
-                               args.ram_budget_mb)
+    streaming = _should_stream(
+        args.stream,
+        _host_image_estimate(train_loader, cfg, args.train_prefix, pc),
+        args.ram_budget_mb)
     if streaming:
         images = labels = None
-        if cfg.subtract_mean:
-            # one extra streaming pass for the global mean reduce; never
-            # holds more than one decoded image + the float64 accumulator
-            s, n = streaming_sum_count(train_loader)
-            mean = _combine_mean(s, float(n), pc)
-        else:
-            mean = None
+        mean = (_load_or_compute_mean(cfg, train_loader, pi, pc, app_name)
+                if cfg.subtract_mean else None)
         print(f"{app_name}: streaming corpus on host {pi} "
               f"({len(train_loader.shard_paths)} shards)", file=sys.stderr)
     else:
@@ -177,10 +225,13 @@ def prepare_data(cfg: RunConfig, args, label_shape: Tuple[int, ...] = (1,),
     # schema describes the preprocessor OUTPUT: NHWC device layout
     schema = Schema(Field("data", "float32", (crop, crop, 3)),
                     Field("label", "int32", label_shape))
+    # emit the compute dtype straight from the native plane's OpenMP loop:
+    # the loop-side cast then no-ops (cast_host_inputs skips non-f32)
+    out_dt = "bfloat16" if cfg.precision == "bfloat16" else "float32"
     pp_train = ImagePreprocessor(schema, mean_image=mean, crop=crop,
-                                 seed=cfg.seed)
+                                 seed=cfg.seed, out_dtype=out_dt)
     pp_eval = ImagePreprocessor(schema, mean_image=mean, crop=crop,
-                                seed=cfg.seed)
+                                seed=cfg.seed, out_dtype=out_dt)
 
     # Preprocessing happens per-round on the sampled window (crop is
     # per-epoch random): the loop's prefetch thread applies pp_train to each

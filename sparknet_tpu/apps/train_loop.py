@@ -196,6 +196,7 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
                         f"{extra.get('n_devices', '?')} devices (tp="
                         f"{extra.get('tp', 1)}) -> {trainer.n_devices} "
                         f"(tp={tp_now})")
+            _seek_stream(source, extra, log)
 
     timers = PhaseTimers()
     meter = ThroughputMeter(n_chips=n_dev)
@@ -291,7 +292,8 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
             if cfg.checkpoint_dir and cfg.checkpoint_every and \
                     (rnd + 1) % cfg.checkpoint_every == 0:
                 with timers.phase("checkpoint"):
-                    _save_checkpoint(cfg, trainer, state, rnd + 1)
+                    _save_checkpoint(cfg, trainer, state, rnd + 1,
+                                     source=source, last_round=rnd)
                 log.log("checkpoint saved", rnd)
             if round_hook:
                 round_hook(rnd, state)
@@ -310,23 +312,73 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         if hasattr(source, "close"):
             source.close()
 
-    if cfg.checkpoint_dir:
-        _save_checkpoint(cfg, trainer, state, cfg.max_rounds, retain=False)
+    if cfg.checkpoint_dir and start_round < cfg.max_rounds:
+        # start_round >= max_rounds means the loop ran ZERO rounds (a
+        # relaunch of a completed run): the restored checkpoint is already
+        # the final state, and re-saving would overwrite it with no stream
+        # cursor (cursor_at has seen no rounds), destroying the resume
+        # position a later extended run needs
+        _save_checkpoint(cfg, trainer, state, cfg.max_rounds, retain=False,
+                         source=source, last_round=cfg.max_rounds - 1)
     log.log(f"done; phase means: {timers.summary()}")
     return state
 
 
+def _stream_rows(source, last_round: Optional[int]) -> Optional[list]:
+    """Per-host [[shard, entry, epochs], ...] stream cursors after
+    `last_round`, allgathered so process 0's checkpoint covers every host's
+    stream position. None when the source is not seekable or the cursor is
+    no longer retained. Collective when multi-host — every process calls
+    _save_checkpoint already."""
+    if last_round is None or not hasattr(source, "cursor_at"):
+        return None
+    cur = source.cursor_at(last_round)
+    if cur is None:
+        return None
+    (shard, entry), epochs = cur
+    row = np.asarray([shard, entry, epochs], np.int64)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        rows = np.asarray(multihost_utils.process_allgather(row))
+    else:
+        rows = row[None]
+    return rows.tolist()
+
+
+def _seek_stream(source, extra: Dict[str, Any], log: Logger) -> None:
+    """Resume the stream position recorded in the checkpoint (one cursor
+    row per host). Host-count changes restart the stream from shard 0 —
+    the shard assignment itself changed, so old cursors are meaningless."""
+    rows = extra.get("stream")
+    if rows is None or not hasattr(source, "seek"):
+        return
+    if len(rows) != jax.process_count():
+        log.log(f"stream cursor in checkpoint covers {len(rows)} hosts, "
+                f"now {jax.process_count()}: restarting stream at shard 0")
+        return
+    shard, entry, epochs = rows[jax.process_index()]
+    source.seek((shard, entry), epochs)
+    log.log(f"stream resumed at shard {shard} entry {entry} "
+            f"(epoch {epochs})")
+
+
 def _save_checkpoint(cfg: RunConfig, trainer, state, step: int,
-                     retain: bool = True) -> None:
+                     retain: bool = True, source=None,
+                     last_round: Optional[int] = None) -> None:
     """Allgather (a collective — every host must call this) then write from
     process 0 only. Momentum is worker-local, so the gather is substantive,
     not a replica read. The saved topology (device count, tp) lets a
-    differently-sized job resume elastically."""
+    differently-sized job resume elastically; streaming sources also
+    record their per-host stream cursor so resume seeks instead of
+    re-streaming from shard 0."""
     host_state = fetch_global(state)
+    stream = _stream_rows(source, last_round) if source is not None else None
     if jax.process_index() == 0:
-        ckpt.save(cfg.checkpoint_dir, host_state, step=step,
-                  extra={"n_devices": trainer.n_devices,
-                         "tp": getattr(trainer, "tp", 1)})
+        extra = {"n_devices": trainer.n_devices,
+                 "tp": getattr(trainer, "tp", 1)}
+        if stream is not None:
+            extra["stream"] = stream
+        ckpt.save(cfg.checkpoint_dir, host_state, step=step, extra=extra)
         if retain:
             ckpt.retain(cfg.checkpoint_dir, keep=3)
 

@@ -97,40 +97,55 @@ class ShardedTarLoader:
     DECODE_CHUNK = 128
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, int]]:
-        chunk: List[Tuple[bytes, int]] = []
-        for path in self.shard_paths:
-            with tarfile.open(path, "r") as tar:
+        for img, label, _pos in self.iter_with_pos():
+            yield img, label
+
+    def iter_with_pos(self, start: Tuple[int, int] = (0, 0)
+                      ) -> Iterator[Tuple[np.ndarray, int, Tuple[int, int]]]:
+        """Yield (img CHW uint8, label, cursor) where cursor =
+        (shard_index, tar entries consumed in that shard) AFTER the entry
+        that produced the example. Seeking with `start` skips that many raw
+        tar entries WITHOUT decoding — the resume path for streaming runs
+        (the reference restarted its RDD from scratch; SURVEY §5.3)."""
+        start_shard, start_entry = start
+        chunk: List[Tuple[bytes, int, Tuple[int, int]]] = []
+        for si in range(start_shard, len(self.shard_paths)):
+            skip = start_entry if si == start_shard else 0
+            with tarfile.open(self.shard_paths[si], "r") as tar:
+                entry = 0
                 for member in tar:  # ALWAYS advances (bug fix vs reference)
-                    if not member.isfile():
+                    entry += 1
+                    if entry <= skip or not member.isfile():
                         continue
                     name = os.path.basename(member.name)
                     label = self.label_map.get(name)
                     if label is None:
                         self.skipped += 1
                         continue
-                    chunk.append((tar.extractfile(member).read(), label))
+                    chunk.append((tar.extractfile(member).read(), label,
+                                  (si, entry)))
                     if len(chunk) >= self.DECODE_CHUNK:
                         yield from self._decode_chunk(chunk)
                         chunk = []
         if chunk:
             yield from self._decode_chunk(chunk)
 
-    def _decode_chunk(self, chunk: List[Tuple[bytes, int]]
-                      ) -> Iterator[Tuple[np.ndarray, int]]:
+    def _decode_chunk(self, chunk: List[Tuple[bytes, int, Tuple[int, int]]]
+                      ) -> Iterator[Tuple[np.ndarray, int, Tuple[int, int]]]:
         """Decode a buffered chunk — multi-core via the native OpenMP batch
         kernel when available, else per-image fallback."""
         if self._decode_batch is not None:
             images, ok = self._decode_batch([c[0] for c in chunk],
                                             self.height, self.width)
-            for i, (_, label) in enumerate(chunk):
+            for i, (_, label, pos) in enumerate(chunk):
                 if ok[i]:
-                    yield images[i], label
+                    yield images[i], label, pos
                 else:
                     self.skipped += 1  # corrupt image: skip, don't loop
             return
-        for data, label in chunk:
+        for data, label, pos in chunk:
             try:
-                yield self._decode(data, self.height, self.width), label
+                yield self._decode(data, self.height, self.width), label, pos
             except Exception:
                 self.skipped += 1
 

@@ -58,6 +58,15 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
         ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
         ctypes.c_int, ctypes.POINTER(ctypes.c_float)]
+    try:
+        lib.jp_crop_mean_nhwc_bf16.restype = None
+        lib.jp_crop_mean_nhwc_bf16.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int, ctypes.POINTER(ctypes.c_uint16)]
+    except AttributeError:
+        lib.jp_crop_mean_nhwc_bf16 = None  # pre-bf16 .so build
     _lib = lib
     return _lib
 
@@ -101,10 +110,22 @@ def decode_resize_chw_batch(jpegs: list, height: int, width: int
     return out, ok == 0
 
 
+def supports_bf16_out() -> bool:
+    lib = _load()
+    return lib is not None and \
+        getattr(lib, "jp_crop_mean_nhwc_bf16", None) is not None
+
+
 def crop_mean_nhwc(images_chw_u8: np.ndarray,
                    mean_chw: Optional[np.ndarray],
-                   ys: np.ndarray, xs: np.ndarray, crop: int) -> np.ndarray:
-    """Fused mean-subtract + crop + NHWC for a CHW uint8 batch."""
+                   ys: np.ndarray, xs: np.ndarray, crop: int,
+                   out_dtype: str = "float32") -> np.ndarray:
+    """Fused mean-subtract + crop + NHWC for a CHW uint8 batch.
+    out_dtype 'bfloat16' writes device-ready bf16 straight from the
+    OpenMP loop (round-to-nearest-even, bit-identical to ml_dtypes'
+    cast) — the training apps feed bf16, so emitting f32 then casting
+    on the single-threaded prefetch path was ~19% of the whole ingest
+    pipeline (bench.py --e2e, r3)."""
     lib = _load()
     assert lib is not None, "native plane unavailable"
     images_chw_u8 = np.ascontiguousarray(images_chw_u8, dtype=np.uint8)
@@ -116,11 +137,21 @@ def crop_mean_nhwc(images_chw_u8: np.ndarray,
         mean_chw = np.ascontiguousarray(mean_chw, dtype=np.float32)
         assert mean_chw.shape == (c, h, w), (mean_chw.shape, (c, h, w))
         mean_ptr = mean_chw.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    args = (images_chw_u8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            n, c, h, w, mean_ptr,
+            ys.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            xs.ctypes.data_as(ctypes.POINTER(ctypes.c_int)), crop)
+    if out_dtype == "bfloat16":
+        assert supports_bf16_out(), \
+            "libjpeg_plane.so predates bf16 output — rerun native/build.sh"
+        import ml_dtypes
+        out = np.empty((n, crop, crop, c), dtype=ml_dtypes.bfloat16)
+        lib.jp_crop_mean_nhwc_bf16(
+            *args, out.view(np.uint16).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint16)))
+        return out
+    assert out_dtype == "float32", out_dtype
     out = np.empty((n, crop, crop, c), dtype=np.float32)
     lib.jp_crop_mean_nhwc(
-        images_chw_u8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-        n, c, h, w, mean_ptr,
-        ys.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
-        xs.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
-        crop, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        *args, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
     return out

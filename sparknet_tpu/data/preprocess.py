@@ -79,7 +79,8 @@ class ImagePreprocessor(DefaultPreprocessor):
     def __init__(self, schema: Schema, image_field: str = "data",
                  mean_image: Optional[np.ndarray] = None,
                  crop: Optional[int] = None, seed: int = 0,
-                 nhwc: bool = True, eval_random_crop: bool = False):
+                 nhwc: bool = True, eval_random_crop: bool = False,
+                 out_dtype: str = "float32"):
         super().__init__(schema)
         self.image_field = image_field
         self.mean_image = (None if mean_image is None
@@ -87,6 +88,12 @@ class ImagePreprocessor(DefaultPreprocessor):
         self.crop = crop
         self.nhwc = nhwc
         self.eval_random_crop = eval_random_crop
+        # emit the COMPUTE dtype directly ("bfloat16"): the native plane
+        # writes it from its OpenMP loop, so the training loop's host-side
+        # cast becomes a no-op instead of a single-threaded ml_dtypes pass
+        # over the whole round (~19% of ingest, bench.py --e2e r3)
+        assert out_dtype in ("float32", "bfloat16"), out_dtype
+        self.out_dtype = out_dtype
         self._rng = np.random.default_rng(seed)
 
     def convert_batch(self, batch: Dict[str, np.ndarray], *,
@@ -110,6 +117,9 @@ class ImagePreprocessor(DefaultPreprocessor):
                     img = center_crop_nchw(img, self.crop)
             if self.nhwc:
                 img = to_nhwc(img)
+            if self.out_dtype != "float32":
+                import ml_dtypes
+                img = img.astype(ml_dtypes.bfloat16)
         out[self.image_field] = img
         for f in self.schema.fields:
             if f.name != self.image_field and f.name in out:
@@ -139,8 +149,11 @@ class ImagePreprocessor(DefaultPreprocessor):
         else:
             ys = np.full(n, (h - self.crop) // 2, np.int32)
             xs = np.full(n, (w - self.crop) // 2, np.int32)
+        dt = self.out_dtype
+        if dt == "bfloat16" and not jpeg_plane.supports_bf16_out():
+            dt = "float32"  # stale .so: fall back, cast later in the loop
         return jpeg_plane.crop_mean_nhwc(raw, self.mean_image, ys, xs,
-                                         self.crop)
+                                         self.crop, out_dtype=dt)
 
 
 def compute_mean_image(images_chw: np.ndarray) -> np.ndarray:

@@ -15,8 +15,12 @@ Semantics vs the in-RAM `RoundSampler`:
     (non-cached) datasets; shards cycle forever (epoch boundaries are
     invisible, like the reference's `.repeat()`-style requeue).
   - `round_index` is accepted for API compatibility but does not key the
-    sampling: a resumed run re-streams from shard 0 rather than seeking to
-    the interrupted stream position (the reference had no resume at all).
+    sampling: position is a STREAM CURSOR. The source reports the cursor
+    after each consumed round (`cursor`/`epochs`, updated by `next_round`),
+    the training loop persists it in the checkpoint, and a resumed source
+    (`start_cursor=`/`start_epochs=`) seeks — skipping raw tar entries
+    without decoding — instead of re-streaming from shard 0 (the reference
+    had no resume at all; SURVEY §5.3).
 """
 from __future__ import annotations
 
@@ -56,60 +60,89 @@ class StreamingRoundSource:
     worker, each worker's block a consecutive run of tau*local_batch stream
     examples (its "window"). Raw uint8 CHW + int32 labels; per-round
     preprocessing (mean/crop/NHWC) stays in the training loop.
+
+    The producer thread starts lazily on the first `next_round()`, so a
+    source can be constructed, then positioned from a checkpoint
+    (`start_cursor`/`start_epochs` at construction) before any decode work
+    happens. After each `next_round()`, `cursor` is the (shard_index,
+    entries_consumed_in_shard) position after that round's last example and
+    `epochs` the completed shard-set passes — exactly what a checkpoint
+    taken now must record to resume the stream.
     """
 
     def __init__(self, loader: ShardedTarLoader, n_workers: int,
-                 local_batch: int, tau: int, prefetch_rounds: int = 2):
+                 local_batch: int, tau: int, prefetch_rounds: int = 2,
+                 start_cursor: Tuple[int, int] = (0, 0),
+                 start_epochs: int = 0):
         self.loader = loader
         self.n_workers = n_workers
         self.local_batch = local_batch
         self.tau = tau
         self.round_examples = n_workers * local_batch * tau
-        self.epochs = 0  # completed passes over the shard set
+        #: position after the last round handed to the consumer
+        self.cursor: Tuple[int, int] = tuple(start_cursor)
+        #: completed passes over the shard set at that position
+        self.epochs = int(start_epochs)
         self._q: queue.Queue = queue.Queue(maxsize=max(1, prefetch_rounds))
         self._stop = threading.Event()
         self._err: Optional[BaseException] = None
-        self._thread = threading.Thread(
-            target=self._produce, name="stream-decode", daemon=True)
-        self._thread.start()
+        self._thread: Optional[threading.Thread] = None
+        self._round_cursors: Dict[int, Tuple[Tuple[int, int], int]] = {}
+
+    def _ensure_started(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._produce, name="stream-decode", daemon=True)
+            self._thread.start()
 
     # -- producer (background thread) ---------------------------------------
 
     def _produce(self) -> None:
         try:
-            imgs, lbls = [], []
+            w, b, t = self.n_workers, self.local_batch, self.tau
+            data = label = None
+            count = 0
+            cursor = self.cursor
+            epochs = self.epochs
+            seeked = cursor != (0, 0)
             while not self._stop.is_set():
                 n_before = 0
-                for img, label in self.loader:
+                for img, lbl, pos in self.loader.iter_with_pos(cursor):
                     n_before += 1
-                    imgs.append(img)
-                    lbls.append(label)
-                    if len(imgs) == self.round_examples:
-                        if not self._put(self._assemble(imgs, lbls)):
+                    if data is None:
+                        # round layout: [tau, W*B, ...] with the batch axis
+                        # blocked by worker, each worker's block a
+                        # consecutive tau*b stream run. Write each image
+                        # straight into its slot — ONE copy per image
+                        # (stack+transpose+contiguous cost 3x the bytes)
+                        data = np.empty((t, w * b) + img.shape, img.dtype)
+                        label = np.empty((t, w * b, 1), np.int32)
+                    wk, rem = divmod(count, t * b)
+                    tt, j = divmod(rem, b)
+                    data[tt, wk * b + j] = img
+                    label[tt, wk * b + j, 0] = lbl
+                    count += 1
+                    if count == self.round_examples:
+                        item = ({"data": data, "label": label}, pos, epochs)
+                        if not self._put(item):
                             return
-                        imgs, lbls = [], []
+                        data = label = None  # handed off; fresh buffers
+                        count = 0
                     if self._stop.is_set():
                         return
-                if n_before == 0:
+                if n_before == 0 and not seeked:
+                    # a full from-the-start pass produced nothing: the
+                    # shards are empty/corrupt. (A seeked first pass may
+                    # legitimately be empty — cursor at the stream's end.)
                     raise ValueError(
                         f"no decodable labeled images in "
                         f"{self.loader.shard_paths}")
-                self.epochs += 1  # wrap: stream the shards again
+                cursor = (0, 0)  # wrap: stream the shards again
+                seeked = False
+                epochs += 1
         except BaseException as e:  # surface in the consumer
             self._err = e
             self._stop.set()
-
-    def _assemble(self, imgs, lbls) -> Dict[str, np.ndarray]:
-        # consecutive tau*B run per worker -> [W, tau, B, ...] -> [tau, W*B, ...]
-        w, b, t = self.n_workers, self.local_batch, self.tau
-        data = np.stack(imgs).reshape((w, t, b) + imgs[0].shape)
-        labels = np.asarray(lbls, np.int32).reshape(w, t, b)
-        return {
-            "data": np.ascontiguousarray(
-                data.transpose((1, 0, 2) + tuple(range(3, data.ndim)))
-                .reshape((t, w * b) + imgs[0].shape)),
-            "label": labels.transpose(1, 0, 2).reshape(t, w * b, 1),
-        }
 
     def _put(self, item) -> bool:
         while not self._stop.is_set():
@@ -122,17 +155,46 @@ class StreamingRoundSource:
 
     # -- consumer ------------------------------------------------------------
 
+    def seek(self, cursor: Tuple[int, int], epochs: int = 0) -> None:
+        """Position the stream from a checkpoint. Only valid before the
+        first `next_round()` (the producer starts lazily)."""
+        if self._thread is not None:
+            raise RuntimeError("seek() after streaming started — construct "
+                               "a fresh source or seek before next_round()")
+        self.cursor = (int(cursor[0]), int(cursor[1]))
+        self.epochs = int(epochs)
+
     def next_round(self, round_index: Optional[int] = None
                    ) -> Dict[str, np.ndarray]:
+        self._ensure_started()
         while True:
             if self._err is not None:
                 raise RuntimeError("streaming decode thread failed") \
                     from self._err
             try:
-                return self._q.get(timeout=0.1)
+                batches, self.cursor, self.epochs = self._q.get(timeout=0.1)
+                if round_index is not None:
+                    # cursor keyed by the round it feeds: the training
+                    # loop's one-deep prefetch fetches round R+1 while R
+                    # trains, so "the source's current cursor" at
+                    # checkpoint time is one round AHEAD of the trained
+                    # state — checkpoints ask for cursor_at(trained round)
+                    self._round_cursors[round_index] = (self.cursor,
+                                                        self.epochs)
+                    for k in [k for k in self._round_cursors
+                              if k < round_index - 4]:
+                        del self._round_cursors[k]
+                return batches
             except queue.Empty:
                 if self._stop.is_set() and self._err is None:
                     raise RuntimeError("streaming source closed")
+
+    def cursor_at(self, round_index: int
+                  ) -> Optional[Tuple[Tuple[int, int], int]]:
+        """((shard, entry), epochs) after the round that carried this
+        index, if still retained — what a checkpoint taken after training
+        that round must record."""
+        return self._round_cursors.get(round_index)
 
     @property
     def skipped(self) -> int:
@@ -146,7 +208,8 @@ class StreamingRoundSource:
                 self._q.get_nowait()
         except queue.Empty:
             pass
-        self._thread.join(timeout=5.0)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
 
     def __enter__(self) -> "StreamingRoundSource":
         return self
