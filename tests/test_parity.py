@@ -1,0 +1,188 @@
+"""Accuracy-parity tests — the EXACT reference recipes (see PARITY.md),
+gated on the datasets being present. This offline environment skips them
+all; anyone with data runs
+
+    scripts/get_datasets.sh all data
+    python -m pytest tests/test_parity.py -m parity -v
+
+and gets the reference's own validation: cifar10_quick to the Caffe-
+documented accuracy band (reference models/cifar10/cifar10_quick_solver
+.prototxt:12-20, apps/CifarApp.scala:20,127), MNIST on the serialized-
+graph backend (apps/MnistApp.scala:18,118), Adult, and an ImageNet
+preprocessing/label-sanity smoke run. Recipes run single-replica
+(n_devices=1) so the band reproduces the serial Caffe baseline — the
+tau-averaged multi-replica dynamics are pinned separately by the oracle
+tests in test_parallel.py."""
+import os
+
+import numpy as np
+import pytest
+
+DATA = os.environ.get("SPARKNET_TPU_DATA", "data")
+
+pytestmark = pytest.mark.parity
+
+
+def _missing(*paths):
+    return not all(os.path.exists(os.path.join(DATA, p)) for p in paths)
+
+
+def _final_accuracy(cfg, spec, state, test_ds):
+    """Distributed-eval the final state exactly as the loop does."""
+    from sparknet_tpu import CompiledNet
+    from sparknet_tpu.apps.train_loop import _evaluate, _to_device_layout
+    from sparknet_tpu.parallel import ParallelTrainer, make_mesh
+
+    net = CompiledNet.compile(spec)
+    trainer = ParallelTrainer(net, cfg.solver, make_mesh(cfg.n_devices),
+                              tau=cfg.tau)
+    ds = _to_device_layout(test_ds, net)
+    return _evaluate(trainer, state, ds, cfg.eval_batch, trainer.n_devices)
+
+
+@pytest.mark.skipif(
+    _missing("cifar10/data_batch_1.bin", "cifar10/test_batch.bin"),
+    reason="data/cifar10 absent (scripts/get_datasets.sh cifar10)")
+def test_cifar10_quick_recipe(tmp_path):
+    """The canonical recipe: lr 0.001 fixed / momentum 0.9 / wd 0.004 /
+    batch 100 / tau 10 / 400 rounds = 4000 solver iterations (~8 epochs).
+    Caffe's documented result for this phase is ~71-75% test accuracy;
+    assert the 0.70 floor (PARITY.md section 1)."""
+    from sparknet_tpu.apps import cifar_app
+    from sparknet_tpu.apps.train_loop import resolve_spec, train
+    from sparknet_tpu.utils.logger import Logger
+
+    cfg = cifar_app.default_config()
+    cfg.data_dir = os.path.join(DATA, "cifar10")
+    cfg.n_devices, cfg.max_rounds = 1, 400
+    cfg.eval_every = 50                       # progress visibility only
+    cfg.workdir = str(tmp_path)
+    train_ds, test_ds = cifar_app.build_datasets(cfg)
+    spec = resolve_spec(cfg, data=(cfg.local_batch, 3, 32, 32),
+                        label=(cfg.local_batch, 1))
+    log_path = str(tmp_path / "cifar_parity.txt")
+    state = train(cfg, spec, train_ds, test_ds,
+                  logger=Logger(log_path, echo=True))
+    acc = _final_accuracy(cfg, spec, state, test_ds)
+    assert acc >= 0.70, (
+        f"cifar10_quick @4000 iters: acc={acc:.4f}, expected >=0.70 "
+        f"(reference band ~0.71-0.75); see {log_path}")
+
+
+@pytest.mark.skipif(
+    _missing("mnist/train-images-idx3-ubyte", "mnist/t10k-images-idx3-ubyte"),
+    reason="data/mnist absent (scripts/get_datasets.sh mnist)")
+def test_mnist_graph_recipe(tmp_path):
+    """MnistApp pairing: the serialized-graph backend (in-graph Momentum +
+    exp-decay lr, batch 64, tau 10) for 150 rounds = 1500 optimizer steps.
+    LeNet-class band is >=98%; assert the 0.97 floor (PARITY.md section 2)."""
+    from sparknet_tpu.apps import graph_mnist_app
+    from sparknet_tpu.backend import GraphNet, build_mnist_graph
+    from sparknet_tpu.data.dataset import ArrayDataset
+    from sparknet_tpu.data.mnist import MnistLoader
+    from sparknet_tpu.parallel import GraphTrainer, make_mesh
+    from sparknet_tpu.apps.graph_common import train_graph
+    from sparknet_tpu.apps.train_loop import _evaluate
+    from sparknet_tpu.utils.logger import Logger
+
+    cfg = graph_mnist_app.default_config()
+    cfg.data_dir = os.path.join(DATA, "mnist")
+    cfg.n_devices, cfg.max_rounds = 1, 150
+    cfg.eval_every = 25
+    cfg.workdir = str(tmp_path)
+    loader = MnistLoader(cfg.data_dir)
+    train_ds = ArrayDataset(graph_mnist_app._nhwc(loader.train_batch_dict()))
+    test_ds = ArrayDataset(graph_mnist_app._nhwc(loader.test_batch_dict()))
+    graph = build_mnist_graph(batch=cfg.local_batch,
+                              train_size=len(train_ds))
+    state = train_graph(cfg, graph, train_ds, test_ds,
+                        logger=Logger(str(tmp_path / "mnist_parity.txt"),
+                                      echo=True),
+                        expect_data_shape=(28, 28, 1))
+    trainer = GraphTrainer(GraphNet(graph, seed=cfg.seed),
+                           make_mesh(cfg.n_devices), tau=cfg.tau)
+    acc = _evaluate(trainer, state, test_ds, cfg.eval_batch, 1)
+    assert acc >= 0.97, (
+        f"mnist graph recipe @1500 steps: acc={acc:.4f}, expected >=0.97")
+
+
+@pytest.mark.skipif(_missing("adult/adult.data"),
+                    reason="data/adult absent "
+                    "(scripts/get_datasets.sh adult)")
+def test_adult_recipe(tmp_path):
+    """Adult MLP: 200 rounds x tau 5 at batch 64; assert >=0.80 held-out
+    accuracy (logistic-regression-class baseline ~0.85; PARITY.md sec 4)."""
+    from sparknet_tpu.apps.adult_app import adult_net
+    from sparknet_tpu.apps.train_loop import train
+    from sparknet_tpu.data.adult import AdultLoader
+    from sparknet_tpu.data.dataset import ArrayDataset
+    from sparknet_tpu.solver import SolverConfig
+    from sparknet_tpu.utils.config import RunConfig
+    from sparknet_tpu.utils.logger import Logger
+
+    loader = AdultLoader(os.path.join(DATA, "adult", "adult.data"))
+    full = loader.batch_dict()
+    n = len(loader.labels)
+    split = int(n * 0.8)
+    train_ds = ArrayDataset({k: v[:split] for k, v in full.items()})
+    test_ds = ArrayDataset({k: v[split:] for k, v in full.items()})
+    cfg = RunConfig(
+        model="adult",
+        solver=SolverConfig(base_lr=0.01, momentum=0.9, lr_policy="fixed"),
+        n_devices=1, tau=5, local_batch=64, eval_every=50, eval_batch=1024,
+        max_rounds=200, workdir=str(tmp_path))
+    spec = adult_net(cfg.local_batch, loader.features.shape[1])
+    state = train(cfg, spec, train_ds, test_ds,
+                  logger=Logger(str(tmp_path / "adult_parity.txt"),
+                                echo=True))
+    acc = _final_accuracy(cfg, spec, state, test_ds)
+    assert acc >= 0.80, f"adult recipe: acc={acc:.4f}, expected >=0.80"
+
+
+@pytest.mark.skipif(_missing("imagenet/train.txt"),
+                    reason="data/imagenet absent "
+                    "(scripts/shard_imagenet.py ingest)")
+def test_imagenet_smoke(tmp_path):
+    """Not the 450k-iteration headline run (PARITY.md section 3 documents
+    that recipe) — a 50-round smoke at the real recipe's lr/crop/mean
+    settings on the real shards: loss must drop clearly below the ln(1000)
+    = 6.908 random floor, catching preprocessing or label skew in minutes
+    instead of days."""
+    import re
+
+    from sparknet_tpu import zoo
+    from sparknet_tpu.apps.train_loop import train
+    from sparknet_tpu.data import imagenet
+    from sparknet_tpu.data.preprocess import ImagePreprocessor
+    from sparknet_tpu.data.streaming import StreamingRoundSource
+    from sparknet_tpu.schema import Field, Schema
+    from sparknet_tpu.solver import SolverConfig
+    from sparknet_tpu.utils.config import RunConfig
+    from sparknet_tpu.utils.logger import Logger
+
+    root = os.path.join(DATA, "imagenet")
+    shards = [s for s in imagenet.list_shards(root)
+              if os.path.basename(s).startswith("train.")][:2]
+    loader = imagenet.ShardedTarLoader(
+        shards, imagenet.load_label_map(os.path.join(root, "train.txt")))
+    crop, local_b, tau = 227, 32, 5
+    cfg = RunConfig(
+        model="caffenet",
+        solver=SolverConfig(base_lr=0.01, momentum=0.9, weight_decay=5e-4,
+                            lr_policy="step", gamma=0.1, stepsize=100000),
+        n_devices=1, tau=tau, local_batch=local_b, eval_every=0,
+        max_rounds=50, crop=crop, workdir=str(tmp_path))
+    src = StreamingRoundSource(loader, 1, local_b, tau)
+    schema = Schema(Field("data", "float32", (crop, crop, 3)),
+                    Field("label", "int32", (1,)))
+    pp = ImagePreprocessor(schema, mean_image=None, crop=crop, seed=0)
+    log_path = str(tmp_path / "imagenet_smoke.txt")
+    train(cfg, zoo.caffenet(batch=local_b, crop=crop), src,
+          logger=Logger(log_path, echo=True), batch_transform=pp)
+    losses = [float(m.group(1)) for m in re.finditer(
+        r"round loss: ([0-9.]+)", open(log_path).read())]
+    assert losses, "no round losses logged"
+    tail = np.mean(losses[-5:])
+    assert tail < 6.5, (
+        f"imagenet smoke: tail loss {tail:.3f} never left the 6.908 "
+        f"random floor — preprocessing/label pipeline suspect")
