@@ -258,19 +258,12 @@ def e2e() -> dict:
         pp = ImagePreprocessor(schema, mean_image=None, crop=crop, seed=0,
                                out_dtype="bfloat16")
         src = StreamingRoundSource(fresh_loader(), 1, BATCH, TAU)
-        import numpy as np
+        from sparknet_tpu.apps.train_loop import prepare_round_batches
 
         def prepare(rnd: int):
-            # mirrors run_loop.prepare_round: sample -> per-slice crop ->
-            # compute-dtype cast
-            batches = src.next_round(round_index=rnd)
-            slices = [pp.convert_batch(
-                {k: v[t] for k, v in batches.items()}, train=True,
-                rng=np.random.default_rng((0, rnd, t)))
-                for t in range(TAU)]
-            batches = {k: np.stack([s[k] for s in slices])
-                       for k in slices[0]}
-            return precision.cast_host_inputs(batches, compute_dt)
+            # THE loop's per-round host path (shared helper, not a copy:
+            # any change to run_loop's preparation is measured here too)
+            return prepare_round_batches(src, rnd, TAU, 0, pp, compute_dt)
 
         with src:
             prepare(0)  # warm the stream + pools
@@ -365,6 +358,7 @@ def e2e_smoke() -> None:
                         Field("label", "int32", (1,)))
         pp = ImagePreprocessor(schema, mean_image=None, crop=crop, seed=0)
         cfg = RunConfig(model="caffenet", n_classes=16, crop=crop,
+                        n_devices=1,  # the source feeds 1 worker's rounds
                         local_batch=b, tau=tau, max_rounds=3, eval_every=0,
                         precision="bfloat16", workdir=root)
         from sparknet_tpu.zoo import caffenet

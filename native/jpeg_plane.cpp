@@ -137,6 +137,12 @@ void jp_decode_resize_chw_batch(const uint8_t* blob, const long* offsets,
 static inline uint16_t jp_f32_to_bf16(float f) {
   uint32_t x;
   __builtin_memcpy(&x, &f, 4);
+  if ((x & 0x7fffffffu) > 0x7f800000u) {
+    // NaN: quiet it (set the top mantissa bit) — the RNE add below would
+    // carry a low-payload NaN into the exponent and emit +/-Inf. Inf
+    // itself survives the add (0x7f800000 + 0x7fff keeps exponent 0xff).
+    return uint16_t((x >> 16) | 0x0040u);
+  }
   const uint32_t lsb = (x >> 16) & 1u;
   x += 0x7fffu + lsb;
   return uint16_t(x >> 16);

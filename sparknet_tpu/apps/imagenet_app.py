@@ -104,23 +104,75 @@ def _host_image_estimate(loader, cfg: RunConfig, prefix: str,
     return n_total * (mine / all_bytes)
 
 
+def _corpus_id(cfg: RunConfig, prefix: str, train_loader, pc: int) -> str:
+    """Identity of the train corpus the mean sidecar was computed from:
+    label count + the GLOBAL shard listing (name:size per shard — every
+    host lists the same data_dir, so the id agrees across processes even
+    though each host decodes only its own shards). Single-process only, a
+    loader built outside the data_dir convention (tests) may fall back to
+    its own shard paths; multi-host the listing must succeed — a per-host
+    fallback would hash each host's i::k subset, hosts would disagree on
+    the id, and a partial sidecar match would strand the others in
+    _combine_mean's collective."""
+    import hashlib
+    import os
+
+    from ..data import imagenet
+    try:
+        shards = imagenet.list_shards(cfg.data_dir, prefix=prefix)
+    except OSError:
+        if pc > 1:
+            raise
+        shards = train_loader.shard_paths
+    sig = ";".join(
+        f"{os.path.basename(p)}:{os.path.getsize(p)}" for p in shards)
+    return hashlib.sha1(
+        f"{len(train_loader.label_map)}|{sig}".encode()).hexdigest()
+
+
 def _load_or_compute_mean(cfg: RunConfig, train_loader, pi: int, pc: int,
-                          app_name: str) -> np.ndarray:
+                          app_name: str, prefix: str = "train.") -> np.ndarray:
     """The streamed-corpus global mean image, persisted as a sidecar next to
     the checkpoints: the mean is a property of the dataset, so re-deriving
     it on every launch cost a full extra decode pass over the corpus
     (flagged in the r2 review). First launch computes + writes
     (atomically, process 0); every later launch — including resume —
-    loads. No checkpoint_dir -> no persistence (computed each launch)."""
+    loads. The sidecar records the corpus identity (shard names/sizes +
+    label count): re-sharding or extending the corpus under the same
+    checkpoint_dir recomputes loudly instead of silently mean-subtracting
+    another dataset's statistics. No checkpoint_dir -> no persistence."""
     import os
 
-    side = (os.path.join(cfg.checkpoint_dir, "mean_image.npy")
+    side = (os.path.join(cfg.checkpoint_dir, "mean_image.npz")
             if cfg.checkpoint_dir else None)
+    corpus = _corpus_id(cfg, prefix, train_loader, pc)
     if side and os.path.exists(side):
-        mean = np.load(side)
-        print(f"{app_name}: mean image loaded from {side} "
-              f"(skipping the corpus pass)", file=sys.stderr)
-        return mean.astype(np.float32)
+        with np.load(side) as z:
+            saved = str(z["corpus_id"]) if "corpus_id" in z else None
+            mean = z["mean"]
+        if saved == corpus:
+            print(f"{app_name}: mean image loaded from {side} "
+                  f"(skipping the corpus pass)", file=sys.stderr)
+            return mean.astype(np.float32)
+        print(f"{app_name}: {side} was computed from a DIFFERENT corpus "
+              f"(saved id {saved} != {corpus}) — recomputing the mean",
+              file=sys.stderr)
+    elif side:
+        legacy = os.path.join(cfg.checkpoint_dir, "mean_image.npy")
+        if os.path.exists(legacy):
+            # un-id'd sidecar from before the corpus stamp: migrate rather
+            # than silently repaying the full-corpus decode pass. Stamping
+            # with the CURRENT id matches the legacy trust level (it had
+            # no staleness check at all).
+            mean = np.load(legacy).astype(np.float32)
+            if pi == 0:
+                tmp = side + ".tmp"
+                with open(tmp, "wb") as f:
+                    np.savez(f, mean=mean, corpus_id=np.array(corpus))
+                os.replace(tmp, side)
+            print(f"{app_name}: migrated legacy sidecar {legacy} -> {side}",
+                  file=sys.stderr)
+            return mean
     # one streaming pass for the global mean reduce; never holds more
     # than one decoded image + the float64 accumulator
     s, n = streaming_sum_count(train_loader)
@@ -128,8 +180,8 @@ def _load_or_compute_mean(cfg: RunConfig, train_loader, pi: int, pc: int,
     if side and pi == 0:
         os.makedirs(cfg.checkpoint_dir, exist_ok=True)
         tmp = side + ".tmp"
-        with open(tmp, "wb") as f:  # np.save(path) would append .npy
-            np.save(f, mean)
+        with open(tmp, "wb") as f:
+            np.savez(f, mean=mean, corpus_id=np.array(corpus))
         os.replace(tmp, side)
     return mean
 
@@ -214,7 +266,8 @@ def prepare_data(cfg: RunConfig, args, label_shape: Tuple[int, ...] = (1,),
         args.ram_budget_mb)
     if streaming:
         images = labels = None
-        mean = (_load_or_compute_mean(cfg, train_loader, pi, pc, app_name)
+        mean = (_load_or_compute_mean(cfg, train_loader, pi, pc, app_name,
+                                      prefix=args.train_prefix)
                 if cfg.subtract_mean else None)
         print(f"{app_name}: streaming corpus on host {pi} "
               f"({len(train_loader.shard_paths)} shards)", file=sys.stderr)

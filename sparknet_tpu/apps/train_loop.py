@@ -114,6 +114,26 @@ def train(cfg: RunConfig, spec: NetSpec, train_ds: ArrayDataset,
                     round_hook=round_hook)
 
 
+def prepare_round_batches(source, rnd: int, tau: int, seed: int,
+                          batch_transform, compute_dt) -> Dict[str, Any]:
+    """One round's host-side work: sample -> per-τ-slice preprocessing
+    (e.g. fresh random crops; rng keyed (seed, round, slice) so resume
+    reproduces identical crops) -> compute-dtype cast. The cast happens
+    here, on the prefetch thread — at dispatch time it would serialize a
+    full-batch astype into the pipelined path (`compute_dt` must be
+    captured on the MAIN thread; the precision policy is thread-local).
+    Module-level so `bench.py --e2e` times exactly this code path."""
+    batches = source.next_round(round_index=rnd)
+    if batch_transform is not None:
+        slices = [batch_transform.convert_batch(
+            {k: v[t] for k, v in batches.items()}, train=True,
+            rng=np.random.default_rng((seed, rnd, t)))
+            for t in range(tau)]
+        batches = {k: np.stack([s[k] for s in slices])
+                   for k in slices[0]}
+    return precision.cast_host_inputs(batches, compute_dt)
+
+
 def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
              test_ds: Optional[ArrayDataset], log: Logger,
              batch_transform=None, eval_transform=None,
@@ -209,22 +229,8 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     compute_dt = precision.compute_dtype()
 
     def prepare_round(rnd: int) -> Dict[str, np.ndarray]:
-        batches = source.next_round(round_index=rnd)
-        if batch_transform is not None:
-            # per-τ-slice preprocessing (e.g. fresh random crops): each
-            # slice is one (N, ...) global batch to the preprocessor.
-            # Round-keyed rng so resume reproduces identical crops.
-            slices = [batch_transform.convert_batch(
-                {k: v[t] for k, v in batches.items()}, train=True,
-                rng=np.random.default_rng((cfg.seed, rnd, t)))
-                for t in range(cfg.tau)]
-            batches = {k: np.stack([s[k] for s in slices])
-                       for k in slices[0]}
-        # cast float inputs to the compute dtype HERE, on the prefetch
-        # thread — doing it at dispatch time would serialize a full-batch
-        # astype into the pipelined path (compute_dt captured on the main
-        # thread; the policy is thread-local)
-        return precision.cast_host_inputs(batches, compute_dt)
+        return prepare_round_batches(source, rnd, cfg.tau, cfg.seed,
+                                     batch_transform, compute_dt)
 
     def flush_round_log(rec) -> None:
         """Emit round R's metrics. `float(loss)` here is the pipeline's

@@ -425,9 +425,10 @@ def test_mean_image_sidecar_skips_second_pass(tmp_path, monkeypatch):
     from sparknet_tpu.utils.config import RunConfig
 
     loader = _stream_fixture(tmp_path)
-    cfg = RunConfig(checkpoint_dir=str(tmp_path / "ck"))
+    cfg = RunConfig(checkpoint_dir=str(tmp_path / "ck"),
+                    data_dir=str(tmp_path / "shards"))
     first = imagenet_app._load_or_compute_mean(cfg, loader, 0, 1, "t")
-    assert (tmp_path / "ck" / "mean_image.npy").exists()
+    assert (tmp_path / "ck" / "mean_image.npz").exists()
 
     def boom(_):
         raise AssertionError("second launch re-streamed the corpus")
@@ -438,7 +439,23 @@ def test_mean_image_sidecar_skips_second_pass(tmp_path, monkeypatch):
     # no checkpoint_dir -> no sidecar, compute every launch
     with pytest.raises(AssertionError, match="re-streamed"):
         imagenet_app._load_or_compute_mean(
-            RunConfig(checkpoint_dir=None), loader, 0, 1, "t")
+            RunConfig(checkpoint_dir=None,
+                      data_dir=str(tmp_path / "shards")), loader, 0, 1, "t")
+    # a CHANGED corpus must not silently reuse the sidecar: growing a
+    # shard changes the corpus id, so the loader recomputes (r3 review)
+    with open(loader.shard_paths[0], "ab") as f:
+        f.write(b"\0" * 1024)
+    with pytest.raises(AssertionError, match="re-streamed"):
+        imagenet_app._load_or_compute_mean(cfg, loader, 0, 1, "t")
+    # legacy un-id'd mean_image.npy migrates to the stamped .npz without
+    # a decode pass (r3 review: no silent repay of the corpus pass)
+    import os
+    os.remove(tmp_path / "ck" / "mean_image.npz")
+    with open(tmp_path / "ck" / "mean_image.npy", "wb") as f:
+        np.save(f, first)
+    migrated = imagenet_app._load_or_compute_mean(cfg, loader, 0, 1, "t")
+    np.testing.assert_allclose(migrated, first, atol=1e-6)
+    assert (tmp_path / "ck" / "mean_image.npz").exists()
 
 
 def test_streaming_round_source_error_propagates(tmp_path):

@@ -80,3 +80,31 @@ def test_preprocessor_uses_fused_path(rng):
     plain = b.convert_batch({"data": imgs.astype(np.float32), "label": lab},
                             train=True)
     np.testing.assert_allclose(fused["data"], plain["data"], atol=1e-5)
+
+
+def test_bf16_out_bit_identical_to_ml_dtypes(rng):
+    """The bf16 emit path must match ml_dtypes' round-to-nearest-even cast
+    BIT-for-bit — including NaN (a low-payload NaN must stay NaN, not carry
+    into +/-Inf through the RNE add), Inf, and values that round up to Inf."""
+    import ml_dtypes
+
+    if not jpeg_plane.supports_bf16_out():
+        pytest.skip("libjpeg_plane.so predates bf16 output")
+    imgs = rng.integers(0, 256, (1, 1, 16, 16), dtype=np.uint8)
+    mean = rng.standard_normal((1, 16, 16)).astype(np.float32) * 300
+    # plant specials: out = u8 - mean, so mean=NaN -> NaN, mean=-Inf -> Inf,
+    # mean near -f32max -> rounds to Inf, exact-tie mantissas for RNE
+    mean.reshape(-1)[:6] = [np.nan, -np.inf, np.inf, -3.4e38, 3.4e38,
+                            -2.00390625]
+    got = jpeg_plane.crop_mean_nhwc(imgs, mean, np.zeros(1, np.int32),
+                                    np.zeros(1, np.int32), 16,
+                                    out_dtype="bfloat16")
+    want = (imgs[0].astype(np.float32) - mean).transpose(1, 2, 0) \
+        .astype(ml_dtypes.bfloat16)
+    g16 = got[0].view(np.uint16)
+    w16 = want.view(np.uint16)
+    nan_g = np.isnan(got[0].astype(np.float32))
+    nan_w = np.isnan(want.astype(np.float32))
+    np.testing.assert_array_equal(nan_g, nan_w)  # NaN stays NaN
+    # non-NaN lanes: exact bit identity (NaN payload bits may differ)
+    np.testing.assert_array_equal(g16[~nan_g], w16[~nan_w])
