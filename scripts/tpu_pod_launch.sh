@@ -1,13 +1,44 @@
 #!/bin/sh
 # TPU pod bring-up + launcher — the reference's `spark-ec2`/`spark-submit`
-# analogue (reference ec2/spark_ec2.py + README.md:13-37), on gcloud TPU VMs.
+# analogue (reference ec2/spark_ec2.py + README.md:13-37), on gcloud TPU VMs,
+# including the spot-instance fault story spark_ec2.py carried (preemption
+# detection, cluster recreate, training resume).
 #
-#   scripts/tpu_pod_launch.sh create  NAME ZONE TYPE     # e.g. v5e-32
+#   scripts/tpu_pod_launch.sh create        NAME ZONE TYPE   # e.g. v5e-32
+#   scripts/tpu_pod_launch.sh create-queued NAME ZONE TYPE   # queued-resource
 #   scripts/tpu_pod_launch.sh setup   NAME ZONE          # rsync repo + deps
 #   scripts/tpu_pod_launch.sh stage   NAME ZONE DIR      # push a dataset dir
 #   scripts/tpu_pod_launch.sh run     NAME ZONE "python -m sparknet_tpu.apps.imagenet_app ..."
-#   scripts/tpu_pod_launch.sh status  NAME ZONE          # VM state
+#   scripts/tpu_pod_launch.sh watch   NAME ZONE TYPE "COMMAND"  # run + auto-resume
+#   scripts/tpu_pod_launch.sh resume  NAME ZONE TYPE "COMMAND"  # one recreate+rerun
+#   scripts/tpu_pod_launch.sh status  NAME ZONE          # VM state (MISSING if gone)
 #   scripts/tpu_pod_launch.sh delete  NAME ZONE
+#
+# ── Kill-and-resume walkthrough (the spot/preemption story) ────────────────
+# 1. Launch on spot capacity, checkpoints on storage that survives the VM:
+#      TPU_SPOT=1 scripts/tpu_pod_launch.sh create mypod us-east5-b v5e-32
+#      scripts/tpu_pod_launch.sh setup mypod us-east5-b
+#      scripts/tpu_pod_launch.sh watch mypod us-east5-b v5e-32 \
+#        "python -m sparknet_tpu.apps.imagenet_app --data-dir /gcs/imagenet \
+#         checkpoint_dir=/gcs/ckpts/run1"
+# 2. Capacity is reclaimed mid-run (state PREEMPTED, or the VM disappears).
+#    `watch` notices — either the ssh run dies and the state probe says so,
+#    or the next poll does — deletes the husk, recreates the VM (same TYPE,
+#    spot again if TPU_SPOT=1), re-runs `setup` (+ `stage` when
+#    TPU_STAGE_DIR is set), and re-issues COMMAND unchanged.
+# 3. The app resumes itself: RunConfig.resume defaults true, so the relaunch
+#    loads the latest checkpoint (params + momentum + round + stream cursor
+#    + mean-image sidecar) from checkpoint_dir and continues — that is why
+#    checkpoint_dir must NOT be on the TPU VM's local disk.
+# 4. Ctrl-C on `watch` stops supervising (the pod itself is untouched);
+#    `resume` is the manual one-shot of the same recover+rerun step.
+# To drill the path without waiting for a real preemption: delete the VM
+# from another terminal mid-run — watch recreates and the training log shows
+# "resumed from checkpoint round N".
+#
+# `create-queued` files a queued resource (the supported path for large pods
+# and the only way to wait for spot capacity) and blocks until it turns
+# ACTIVE; `delete` also cleans up the queued-resource wrapper if one exists.
 #
 # `stage` copies DIR to ~/sparknet_tpu_repo/<basename> on EVERY worker —
 # tar-sharded datasets are then host-sharded automatically at run time
@@ -18,6 +49,11 @@
 # Environment knobs:
 #   TPU_SW_VERSION   runtime image (default v2-alpha-tpuv5-lite; e.g.
 #                    tpu-ubuntu2204-base for v4, v2-alpha-tpuv6e for v6e)
+#   TPU_SPOT=1       create spot/preemptible capacity (the reference's EC2
+#                    spot default, ec2/spark_ec2.py)
+#   TPU_STAGE_DIR    dataset dir watch/resume re-stages after a recreate
+#   TPU_POLL_SECS    watch's between-retry poll interval (default 60)
+#   ALLOW_NO_NATIVE=1  continue setup if the C++ data plane fails to build
 #
 # Multi-host run path: `run` executes the SAME command on every worker
 # (single-program multi-host). Inside the app:
@@ -33,36 +69,129 @@
 # A failed `run` on any worker propagates a non-zero exit (no silent
 # per-host divergence).
 set -eu
-CMD="${1:?usage: $0 {create|setup|stage|run|status|delete} NAME ZONE [TYPE|DIR|COMMAND]}"
-NAME="${2:?missing NAME}"; ZONE="${3:?missing ZONE}"; ARG="${4:-}"
+# NB: no literal braces inside ${1:?...} — a '}' in the message would
+# terminate the expansion early and corrupt $CMD
+CMD="${1:?usage: $0 create|create-queued|setup|stage|run|watch|resume|status|delete NAME ZONE ...}"
+NAME="${2:?missing NAME}"; ZONE="${3:?missing ZONE}"; ARG="${4:-}"; ARG2="${5:-}"
 TPU="gcloud compute tpus tpu-vm"
+QR="gcloud compute tpus queued-resources"
 TPU_SW_VERSION="${TPU_SW_VERSION:-v2-alpha-tpuv5-lite}"
+TPU_POLL_SECS="${TPU_POLL_SECS:-60}"
+
+spot_flag() { [ -n "${TPU_SPOT:-}" ] && echo "--spot" || true; }
+
+vm_state() {
+  # PREEMPTED / READY / ... ; MISSING when the VM is gone entirely
+  $TPU describe "$NAME" --zone "$ZONE" --format='value(state)' \
+    2>/dev/null || echo MISSING
+}
+
+do_create() {
+  [ -n "$1" ] || { echo "create needs an accelerator TYPE" >&2; exit 1; }
+  # shellcheck disable=SC2046
+  $TPU create "$NAME" --zone "$ZONE" --accelerator-type "$1" \
+    --version "$TPU_SW_VERSION" $(spot_flag)
+}
+
+do_create_queued() {
+  [ -n "$1" ] || { echo "create-queued needs an accelerator TYPE" >&2; exit 1; }
+  # shellcheck disable=SC2046
+  $QR create "$NAME" --zone "$ZONE" --node-id "$NAME" \
+    --accelerator-type "$1" --runtime-version "$TPU_SW_VERSION" $(spot_flag)
+  echo "queued resource $NAME filed; waiting for ACTIVE" >&2
+  while :; do
+    qs=$($QR describe "$NAME" --zone "$ZONE" --format='value(state.state)' \
+         2>/dev/null || echo UNKNOWN)
+    echo "  queued-resource state: $qs" >&2
+    case "$qs" in
+      ACTIVE) break ;;
+      FAILED|SUSPENDED) echo "queued resource $qs" >&2; exit 1 ;;
+    esac
+    sleep "$TPU_POLL_SECS"
+  done
+}
+
+do_setup() {
+  # jax[tpu] is the only runtime dep; native/build.sh failure is fatal by
+  # default (the C++ data plane matters at ImageNet scale) — export
+  # ALLOW_NO_NATIVE=1 to continue with the PIL fallback.
+  $TPU scp --recurse --worker=all --zone "$ZONE" . "$NAME":~/sparknet_tpu_repo
+  $TPU ssh "$NAME" --worker=all --zone "$ZONE" --command \
+    "cd ~/sparknet_tpu_repo && pip install -q 'jax[tpu]' && pip install -q -e . && (sh native/build.sh || [ -n '${ALLOW_NO_NATIVE:-}' ])"
+}
+
+do_stage() {
+  [ -d "$1" ] || { echo "stage needs a local dataset DIR" >&2; exit 1; }
+  $TPU scp --recurse --worker=all --zone "$ZONE" "$1" \
+    "$NAME":~/sparknet_tpu_repo/
+}
+
+do_run() {
+  [ -n "$1" ] || { echo "run needs a COMMAND" >&2; exit 1; }
+  $TPU ssh "$NAME" --worker=all --zone "$ZONE" --command \
+    "cd ~/sparknet_tpu_repo && $1"
+}
+
+do_delete() {
+  $TPU delete "$NAME" --zone "$ZONE" --quiet 2>/dev/null || true
+  # a queued-resource wrapper (create-queued) must go too or the name
+  # stays occupied
+  $QR delete "$NAME" --zone "$ZONE" --quiet --force 2>/dev/null || true
+}
+
+recreate() { # $1 = accelerator TYPE
+  echo "recreating $NAME ($1) after preemption" >&2
+  do_delete
+  if [ -n "${TPU_QUEUED:-}" ]; then do_create_queued "$1"; else do_create "$1"; fi
+  do_setup
+  [ -n "${TPU_STAGE_DIR:-}" ] && do_stage "$TPU_STAGE_DIR" || true
+}
+
+recover_if_preempted() { # $1 = TYPE; returns 0 if the VM is (now) usable
+  case "$(vm_state)" in
+    READY) return 0 ;;
+    PREEMPTED|MISSING|TERMINATED|STOPPED) recreate "$1"; return 0 ;;
+    *) return 1 ;;  # CREATING/REPAIRING/...: not usable yet, don't recreate
+  esac
+}
 
 case "$CMD" in
-  create)
-    [ -n "$ARG" ] || { echo "create needs an accelerator TYPE" >&2; exit 1; }
-    $TPU create "$NAME" --zone "$ZONE" --accelerator-type "$ARG" \
-      --version "$TPU_SW_VERSION" ;;
-  setup)
-    # jax[tpu] is the only runtime dep; native/build.sh failure is fatal by
-    # default (the C++ data plane matters at ImageNet scale) — export
-    # ALLOW_NO_NATIVE=1 to continue with the PIL fallback.
-    $TPU scp --recurse --worker=all --zone "$ZONE" . "$NAME":~/sparknet_tpu_repo
-    $TPU ssh "$NAME" --worker=all --zone "$ZONE" --command \
-      "cd ~/sparknet_tpu_repo && pip install -q 'jax[tpu]' && pip install -q -e . && (sh native/build.sh || [ -n '${ALLOW_NO_NATIVE:-}' ])" ;;
-  stage)
-    [ -d "$ARG" ] || { echo "stage needs a local dataset DIR" >&2; exit 1; }
-    $TPU scp --recurse --worker=all --zone "$ZONE" "$ARG" \
-      "$NAME":~/sparknet_tpu_repo/ ;;
-  run)
-    [ -n "$ARG" ] || { echo "run needs a COMMAND" >&2; exit 1; }
-    $TPU ssh "$NAME" --worker=all --zone "$ZONE" --command \
-      "cd ~/sparknet_tpu_repo && $ARG" ;;
-  status)
-    $TPU describe "$NAME" --zone "$ZONE" --format='value(state)' ;;
-  delete)
-    $TPU delete "$NAME" --zone "$ZONE" --quiet ;;
+  create)        do_create "$ARG" ;;
+  create-queued) do_create_queued "$ARG" ;;
+  setup)         do_setup ;;
+  stage)         do_stage "$ARG" ;;
+  run)           do_run "$ARG" ;;
+  resume)
+    # one-shot recover + rerun: TYPE + COMMAND
+    [ -n "$ARG2" ] || { echo "resume needs TYPE and COMMAND" >&2; exit 1; }
+    recover_if_preempted "$ARG" || { echo "state $(vm_state): not recoverable now" >&2; exit 1; }
+    do_run "$ARG2" ;;
+  watch)
+    # supervise COMMAND until it EXITS CLEANLY: preemption (or any VM
+    # loss) recreates the pod and re-runs; the app's checkpoint resume
+    # turns the re-run into a continuation. A clean non-zero exit from
+    # the app itself on a READY VM is a real failure -> stop and report.
+    [ -n "$ARG2" ] || { echo "watch needs TYPE and COMMAND" >&2; exit 1; }
+    while :; do
+      if ! recover_if_preempted "$ARG"; then
+        echo "state $(vm_state): waiting ${TPU_POLL_SECS}s" >&2
+        sleep "$TPU_POLL_SECS"; continue
+      fi
+      if do_run "$ARG2"; then
+        echo "watch: command completed" >&2; break
+      fi
+      s=$(vm_state)
+      if [ "$s" = "READY" ]; then
+        echo "watch: command failed on a READY pod — app error, not " \
+             "preemption; inspect logs (rerun with: $0 resume $NAME $ZONE" \
+             "'$ARG' '...')" >&2
+        exit 1
+      fi
+      echo "watch: run died with pod state $s; recovering" >&2
+    done ;;
+  status)        vm_state ;;
+  delete)        do_delete ;;
   *)
-    echo "usage: $0 {create|setup|stage|run|status|delete} NAME ZONE [TYPE|DIR|COMMAND]" >&2
+    echo "usage: $0 {create|create-queued|setup|stage|run|watch|resume|status|delete} NAME ZONE [TYPE|DIR|COMMAND] [COMMAND]" >&2
     exit 1 ;;
 esac

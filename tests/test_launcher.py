@@ -1,0 +1,148 @@
+"""tpu_pod_launch.sh fault-tolerance tests with a stubbed gcloud: the
+spot-preemption recover+rerun loop (`watch`), the one-shot `resume`, and
+queued-resource creation — the reference's ec2/spark_ec2.py spot story,
+exercised hermetically (no cloud, no network)."""
+import os
+import stat
+import subprocess
+
+import pytest
+
+SCRIPT = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                      "tpu_pod_launch.sh")
+
+GCLOUD_STUB = r"""#!/bin/sh
+# gcloud stub: state machine in $STUB_DIR. Logs every call.
+DIR="$STUB_DIR"
+echo "$@" >> "$DIR/calls.log"
+case "$*" in
+  *"tpu-vm describe"*)
+    if [ -f "$DIR/state" ]; then cat "$DIR/state"; else exit 1; fi ;;
+  *"tpu-vm create"*) echo READY > "$DIR/state" ;;
+  *"tpu-vm delete"*) rm -f "$DIR/state" ;;
+  *"queued-resources create"*) echo PROVISIONING > "$DIR/qstate"
+                               echo READY > "$DIR/state" ;;
+  *"queued-resources describe"*)
+    s=$(cat "$DIR/qstate" 2>/dev/null || echo UNKNOWN)
+    echo ACTIVE > "$DIR/qstate"   # next poll sees ACTIVE
+    echo "$s" ;;
+  *"queued-resources delete"*) rm -f "$DIR/qstate" ;;
+  *"tpu-vm scp"*) : ;;
+  *"tpu-vm ssh"*)
+    case "$*" in
+      *"pip install"*) exit 0 ;;   # setup
+    esac
+    line=$(head -n 1 "$DIR/runplan" 2>/dev/null || echo ok)
+    tail -n +2 "$DIR/runplan" > "$DIR/runplan.t" 2>/dev/null || true
+    mv "$DIR/runplan.t" "$DIR/runplan" 2>/dev/null || true
+    case "$line" in
+      preempt) echo PREEMPTED > "$DIR/state"; exit 255 ;;
+      vanish)  rm -f "$DIR/state"; exit 255 ;;
+      fail)    exit 7 ;;
+      *)       exit 0 ;;
+    esac ;;
+esac
+"""
+
+
+@pytest.fixture
+def launcher(tmp_path):
+    stub_dir = tmp_path / "stub"
+    stub_dir.mkdir()
+    gcloud = stub_dir / "gcloud"
+    gcloud.write_text(GCLOUD_STUB)
+    gcloud.chmod(gcloud.stat().st_mode | stat.S_IEXEC)
+
+    def run(*args, env=None, plan=None):
+        if plan is not None:
+            (stub_dir / "runplan").write_text("\n".join(plan) + "\n")
+        e = dict(os.environ)
+        e["PATH"] = f"{stub_dir}:{e['PATH']}"
+        e["STUB_DIR"] = str(stub_dir)
+        e["TPU_POLL_SECS"] = "0"
+        e.update(env or {})
+        return subprocess.run(["sh", SCRIPT, *args], env=e, cwd=str(tmp_path),
+                              capture_output=True, text=True, timeout=60)
+
+    run.calls = lambda: (stub_dir / "calls.log").read_text() \
+        if (stub_dir / "calls.log").exists() else ""
+    run.state = lambda: (stub_dir / "state").read_text().strip() \
+        if (stub_dir / "state").exists() else "MISSING"
+    return run
+
+
+def test_status_missing_and_create(launcher):
+    r = launcher("status", "pod", "z")
+    assert r.returncode == 0 and r.stdout.strip() == "MISSING"
+    assert launcher("create", "pod", "z", "v5e-32").returncode == 0
+    assert launcher("status", "pod", "z").stdout.strip() == "READY"
+
+
+def test_spot_flag(launcher):
+    launcher("create", "pod", "z", "v5e-32", env={"TPU_SPOT": "1"})
+    assert "--spot" in launcher.calls()
+
+
+def test_watch_recovers_from_preemption(launcher):
+    """First run is preempted mid-flight -> watch deletes the husk,
+    recreates (create+setup), re-runs; second run completes -> exit 0."""
+    launcher("create", "pod", "z", "v5e-32")
+    r = launcher("watch", "pod", "z", "v5e-32", "python -m app",
+                 plan=["preempt", "ok"])
+    assert r.returncode == 0, r.stderr
+    assert "recovering" in r.stderr and "recreating" in r.stderr
+    assert "command completed" in r.stderr
+    calls = launcher.calls()
+    assert calls.count("tpu-vm create") == 2  # initial + recreate
+    assert launcher.state() == "READY"
+
+
+def test_watch_recovers_vanished_vm(launcher):
+    """The VM disappearing entirely (state MISSING) is recovered the same
+    way as an explicit PREEMPTED state."""
+    launcher("create", "pod", "z", "v5e-32")
+    r = launcher("watch", "pod", "z", "v5e-32", "python -m app",
+                 plan=["vanish", "ok"])
+    assert r.returncode == 0, r.stderr
+
+
+def test_watch_stops_on_real_app_failure(launcher):
+    """A non-zero exit on a READY pod is an app bug, not a preemption:
+    watch must NOT loop — it stops and points at `resume`."""
+    launcher("create", "pod", "z", "v5e-32")
+    r = launcher("watch", "pod", "z", "v5e-32", "python -m app",
+                 plan=["fail", "ok"])
+    assert r.returncode == 1
+    assert "app error" in r.stderr
+    assert launcher.calls().count("tpu-vm create") == 1  # no recreate
+
+
+def test_watch_creates_from_nothing(launcher):
+    """watch on a not-yet-created pod bootstraps it (MISSING -> recreate)."""
+    r = launcher("watch", "pod", "z", "v5e-32", "python -m app", plan=["ok"])
+    assert r.returncode == 0, r.stderr
+    assert "tpu-vm create" in launcher.calls()
+
+
+def test_resume_one_shot(launcher):
+    launcher("create", "pod", "z", "v5e-32")
+    # simulate a preemption observed out-of-band
+    launcher("run", "pod", "z", "x", plan=["preempt"])
+    r = launcher("resume", "pod", "z", "v5e-32", "python -m app",
+                 plan=["ok"])
+    assert r.returncode == 0, r.stderr
+    assert launcher.calls().count("tpu-vm create") == 2
+
+
+def test_create_queued_waits_for_active(launcher):
+    r = launcher("create-queued", "pod", "z", "v5e-32")
+    assert r.returncode == 0, r.stderr
+    # polled through PROVISIONING to ACTIVE
+    assert "PROVISIONING" in r.stderr and "ACTIVE" in r.stderr
+
+
+def test_delete_cleans_queued_wrapper(launcher):
+    launcher("create-queued", "pod", "z", "v5e-32")
+    launcher("delete", "pod", "z")
+    assert "queued-resources delete" in launcher.calls()
+    assert launcher.state() == "MISSING"
