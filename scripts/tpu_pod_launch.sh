@@ -51,8 +51,14 @@
 #                    tpu-ubuntu2204-base for v4, v2-alpha-tpuv6e for v6e)
 #   TPU_SPOT=1       create spot/preemptible capacity (the reference's EC2
 #                    spot default, ec2/spark_ec2.py)
+#   TPU_QUEUED=1     watch/resume recreate via queued resources instead of
+#                    direct create — set this when the pod was brought up
+#                    with `create-queued` (large pods / waiting for spot
+#                    capacity), or the recreate will attempt an on-demand
+#                    create that stocks out
 #   TPU_STAGE_DIR    dataset dir watch/resume re-stages after a recreate
-#   TPU_POLL_SECS    watch's between-retry poll interval (default 60)
+#   TPU_POLL_SECS    watch's between-retry poll interval (default 60);
+#                    also the backoff after a FAILED recreate (stockout)
 #   ALLOW_NO_NATIVE=1  continue setup if the C++ data plane fails to build
 #
 # Multi-host run path: `run` executes the SAME command on every worker
@@ -81,9 +87,19 @@ TPU_POLL_SECS="${TPU_POLL_SECS:-60}"
 spot_flag() { [ -n "${TPU_SPOT:-}" ] && echo "--spot" || true; }
 
 vm_state() {
-  # PREEMPTED / READY / ... ; MISSING when the VM is gone entirely
-  $TPU describe "$NAME" --zone "$ZONE" --format='value(state)' \
-    2>/dev/null || echo MISSING
+  # PREEMPTED / READY / ...; MISSING only when gcloud POSITIVELY reports
+  # the VM gone (NOT_FOUND). A describe that fails for any other reason
+  # (network blip, expired auth, API 5xx) is UNKNOWN — watch must WAIT on
+  # those, not delete-and-recreate a possibly healthy pod (r3 review).
+  if out=$($TPU describe "$NAME" --zone "$ZONE" --format='value(state)' \
+           2>&1); then
+    echo "$out"
+  else
+    case "$out" in
+      *NOT_FOUND*|*"not found"*) echo MISSING ;;
+      *) echo UNKNOWN ;;
+    esac
+  fi
 }
 
 do_create() {
@@ -132,26 +148,34 @@ do_run() {
     "cd ~/sparknet_tpu_repo && $1"
 }
 
-do_delete() {
-  $TPU delete "$NAME" --zone "$ZONE" --quiet 2>/dev/null || true
-  # a queued-resource wrapper (create-queued) must go too or the name
-  # stays occupied
-  $QR delete "$NAME" --zone "$ZONE" --quiet --force 2>/dev/null || true
+del_tolerating_absence() { # $@ = delete command; NOT_FOUND is fine, any
+  if out=$("$@" 2>&1); then return 0; fi     # other failure propagates —
+  case "$out" in                             # "delete exited 0 but the
+    *NOT_FOUND*|*"not found"*) return 0 ;;   # billed pod is still up" is
+    *) echo "$out" >&2; return 1 ;;          # the worst outcome (r3 review)
+  esac
 }
 
-recreate() { # $1 = accelerator TYPE
+do_delete() {
+  del_tolerating_absence $TPU delete "$NAME" --zone "$ZONE" --quiet
+  # a queued-resource wrapper (create-queued) must go too or the name
+  # stays occupied
+  del_tolerating_absence $QR delete "$NAME" --zone "$ZONE" --quiet --force
+}
+
+recreate() { # $1 = accelerator TYPE; FAILS LOUDLY (caller decides retry)
   echo "recreating $NAME ($1) after preemption" >&2
-  do_delete
-  if [ -n "${TPU_QUEUED:-}" ]; then do_create_queued "$1"; else do_create "$1"; fi
-  do_setup
-  [ -n "${TPU_STAGE_DIR:-}" ] && do_stage "$TPU_STAGE_DIR" || true
+  do_delete || return 1
+  if [ -n "${TPU_QUEUED:-}" ]; then do_create_queued "$1"; else do_create "$1"; fi || return 1
+  do_setup || return 1
+  if [ -n "${TPU_STAGE_DIR:-}" ]; then do_stage "$TPU_STAGE_DIR" || return 1; fi
 }
 
 recover_if_preempted() { # $1 = TYPE; returns 0 if the VM is (now) usable
   case "$(vm_state)" in
     READY) return 0 ;;
-    PREEMPTED|MISSING|TERMINATED|STOPPED) recreate "$1"; return 0 ;;
-    *) return 1 ;;  # CREATING/REPAIRING/...: not usable yet, don't recreate
+    PREEMPTED|MISSING|TERMINATED|STOPPED) recreate "$1" ;;  # propagate
+    *) return 1 ;;  # CREATING/REPAIRING/UNKNOWN: wait, don't recreate
   esac
 }
 

@@ -40,8 +40,26 @@ def _ave_divisor_1d(size: int, kernel: int, stride: int, pad: int,
 
 
 def pool2d(x: jnp.ndarray, mode: str, kernel: int, stride: int,
-           pad: int) -> jnp.ndarray:
-    """Pool an NHWC tensor with Caffe semantics. mode: 'MAX' | 'AVE'."""
+           pad: int, impl: str = "auto") -> jnp.ndarray:
+    """Pool an NHWC tensor with Caffe semantics. mode: 'MAX' | 'AVE'.
+
+    impl: 'auto'/'xla' — reduce_window + its select-and-scatter VJP;
+    'pallas' — the ops/pallas_pool.py backward kernel (MAX only).
+    'auto' deliberately
+    does NOT pick the kernel: it reproduces first-max routing exactly and
+    its inner loops are fully contiguous, but measured end to end on the
+    r3 headline it LOSES 10% (20.5k -> 18.3k img/s/chip) — the custom-call
+    boundary breaks XLA's fusion of pool-backward with its elementwise
+    neighbors and the N-minor layout bitcast is not guaranteed for the
+    incoming gradient (unlike LRN, whose both sides face convs). Kept as a
+    measured dead end + the only exact-tie-semantics reference besides
+    select-and-scatter (PERF.md §pool-backward)."""
+    if impl not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown pool impl {impl!r}: expected "
+                         f"'auto', 'xla', or 'pallas'")
+    if impl == "pallas" and mode != "MAX":
+        raise ValueError(f"impl='pallas' supports MAX pooling only "
+                         f"(got mode={mode!r})")
     n, h, w, c = x.shape
     oh = caffe_pool_output_size(h, kernel, stride, pad)
     ow = caffe_pool_output_size(w, kernel, stride, pad)
@@ -53,6 +71,14 @@ def pool2d(x: jnp.ndarray, mode: str, kernel: int, stride: int,
     strides = (1, stride, stride, 1)
 
     if mode == "MAX":
+        if impl == "pallas":
+            if not _can_pallas_pool(x, kernel, stride, pad):
+                raise ValueError(
+                    f"impl='pallas' unsupported for shape {x.shape} "
+                    f"k={kernel} s={stride} pad={pad} on "
+                    f"{jax.default_backend()!r} (see pallas_pool docstring)")
+            from .pallas_pool import maxpool_pallas
+            return maxpool_pallas(x, kernel, stride)
         return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, padding)
     if mode == "AVE":
         # f32 accumulation (and: bf16 reduce_window-add mis-linearizes
@@ -64,6 +90,15 @@ def pool2d(x: jnp.ndarray, mode: str, kernel: int, stride: int,
         div = jnp.asarray(np.outer(div_h, div_w))
         return (s / div[None, :, :, None]).astype(x.dtype)
     raise ValueError(f"unknown pool mode {mode!r}")
+
+
+def _can_pallas_pool(x, kernel: int, stride: int, pad: int) -> bool:
+    """Shape/backend gate for impl='pallas'. No blanket except: a broken
+    pallas_pool import must surface as itself, not masquerade as an
+    'unsupported shape' error (r3 review)."""
+    from .pallas_pool import pallas_maxpool_supported
+    return (jax.default_backend() == "tpu" and
+            pallas_maxpool_supported(x.shape, x.dtype, kernel, stride, pad))
 
 
 def global_pool2d(x: jnp.ndarray, mode: str) -> jnp.ndarray:

@@ -17,9 +17,15 @@ DIR="$STUB_DIR"
 echo "$@" >> "$DIR/calls.log"
 case "$*" in
   *"tpu-vm describe"*)
-    if [ -f "$DIR/state" ]; then cat "$DIR/state"; else exit 1; fi ;;
-  *"tpu-vm create"*) echo READY > "$DIR/state" ;;
-  *"tpu-vm delete"*) rm -f "$DIR/state" ;;
+    if [ -f "$DIR/transient" ]; then echo "ERROR: auth expired"; exit 1; fi
+    if [ -f "$DIR/state" ]; then cat "$DIR/state"
+    else echo "ERROR: NOT_FOUND: $2"; exit 1; fi ;;
+  *"tpu-vm create"*)
+    if [ -f "$DIR/createfail" ]; then echo "ERROR: stockout"; exit 1; fi
+    echo READY > "$DIR/state" ;;
+  *"tpu-vm delete"*)
+    if [ -f "$DIR/deletefail" ]; then echo "ERROR: PERMISSION_DENIED"; exit 1; fi
+    rm -f "$DIR/state" ;;
   *"queued-resources create"*) echo PROVISIONING > "$DIR/qstate"
                                echo READY > "$DIR/state" ;;
   *"queued-resources describe"*)
@@ -68,6 +74,7 @@ def launcher(tmp_path):
         if (stub_dir / "calls.log").exists() else ""
     run.state = lambda: (stub_dir / "state").read_text().strip() \
         if (stub_dir / "state").exists() else "MISSING"
+    run.stub_dir = stub_dir
     return run
 
 
@@ -146,3 +153,55 @@ def test_delete_cleans_queued_wrapper(launcher):
     launcher("delete", "pod", "z")
     assert "queued-resources delete" in launcher.calls()
     assert launcher.state() == "MISSING"
+
+
+def test_transient_describe_failure_is_not_missing(launcher):
+    """A describe that fails for a non-NOT_FOUND reason (network, auth)
+    must NOT be treated as a vanished VM: status says UNKNOWN and resume
+    refuses to delete/recreate (r3 review: a client-side blip must not
+    kill a healthy pod)."""
+    launcher("create", "pod", "z", "v5e-32")
+    (launcher.stub_dir / "transient").write_text("")
+    r = launcher("status", "pod", "z")
+    assert r.stdout.strip() == "UNKNOWN"
+    r = launcher("resume", "pod", "z", "v5e-32", "python -m app")
+    assert r.returncode == 1
+    assert "not recoverable" in r.stderr
+    assert "tpu-vm delete" not in launcher.calls()
+
+
+def test_resume_surfaces_create_failure(launcher):
+    """Recreate failing (spot stockout) must propagate, not silently
+    'succeed' into a run against a missing VM."""
+    launcher("create", "pod", "z", "v5e-32")
+    launcher("run", "pod", "z", "x", plan=["preempt"])
+    (launcher.stub_dir / "createfail").write_text("")
+    r = launcher("resume", "pod", "z", "v5e-32", "python -m app")
+    assert r.returncode == 1
+    # and the run was never attempted against the missing VM
+    assert "python -m app" not in launcher.calls()
+
+
+def test_delete_failure_propagates(launcher):
+    """delete must NOT exit 0 when gcloud failed for a real reason — a
+    billed pod silently left running is the worst outcome."""
+    launcher("create", "pod", "z", "v5e-32")
+    (launcher.stub_dir / "deletefail").write_text("")
+    r = launcher("delete", "pod", "z")
+    assert r.returncode != 0
+    assert "PERMISSION_DENIED" in r.stderr
+    # absent resources are fine: delete of a never-created pod exits 0
+    (launcher.stub_dir / "deletefail").unlink()
+    launcher("delete", "pod", "z")
+    assert launcher("delete", "pod", "z").returncode == 0
+
+
+def test_queued_recreate_knob(launcher):
+    """TPU_QUEUED=1 routes watch/resume recreates through queued
+    resources (the create-queued pairing for large pods)."""
+    launcher("create-queued", "pod", "z", "v5e-32")
+    launcher("run", "pod", "z", "x", plan=["preempt"])
+    r = launcher("resume", "pod", "z", "v5e-32", "python -m app",
+                 env={"TPU_QUEUED": "1"}, plan=["ok"])
+    assert r.returncode == 0, r.stderr
+    assert launcher.calls().count("queued-resources create") == 2

@@ -1,0 +1,90 @@
+"""Pallas maxpool-backward kernel vs oracles (interpreter mode on the CPU
+mesh; the real-TPU path was A/B'd on the chip — see PERF.md §pool-backward
+for why `auto` dispatch deliberately does NOT select it).
+
+The load-bearing property is TIE ROUTING: Caffe's MaxPoolingLayer and
+XLA's select-and-scatter both send each window's gradient to the FIRST
+maximum in row-major window order, and ties are common on real data
+(post-ReLU zeros). Tests use heavily quantized inputs so nearly every
+window has ties."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from sparknet_tpu.ops import pallas_pool as pp
+from sparknet_tpu.ops.pooling import pool2d
+
+
+def _tie_heavy(rng, shape, levels=4):
+    return np.maximum(
+        rng.integers(-2, levels, shape), 0).astype(np.float32)
+
+
+def _xla_bwd(x, dy, k, s):
+    f = lambda a: lax.reduce_window(a, -jnp.inf, lax.max, (1, k, k, 1),
+                                    (1, s, s, 1), ((0, 0),) * 4)
+    return np.asarray(jax.vjp(f, jnp.asarray(x))[1](jnp.asarray(dy))[0])
+
+
+@pytest.mark.parametrize("H,C,k,s", [(13, 8, 3, 2), (12, 8, 2, 2),
+                                     (9, 16, 3, 1)])
+def test_kernel_matches_oracle_and_xla(rng, H, C, k, s):
+    N = 128
+    x = _tie_heavy(rng, (N, H, H, C))
+    OH = (H - k) // s + 1
+    dy = rng.standard_normal((N, OH, OH, C)).astype(np.float32)
+    assert pp.pallas_maxpool_supported(x.shape, x.dtype, k, s, 0)
+
+    f = lambda a: pp.maxpool_pallas(a, k, s, True)  # interpret mode
+    y, vjp = jax.vjp(f, jnp.asarray(x))
+    (dx,) = vjp(jnp.asarray(dy))
+
+    want_y = lax.reduce_window(jnp.asarray(x), -jnp.inf, lax.max,
+                               (1, k, k, 1), (1, s, s, 1), ((0, 0),) * 4)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(want_y))
+    oracle = pp.maxpool_bwd_reference(x, dy, k, s)
+    np.testing.assert_allclose(np.asarray(dx), oracle, atol=1e-5)
+    np.testing.assert_allclose(_xla_bwd(x, dy, k, s), oracle, atol=1e-5)
+
+
+def test_supported_gate():
+    ok = pp.pallas_maxpool_supported
+    assert ok((128, 13, 13, 8), np.float32, 3, 2, 0)
+    assert not ok((100, 13, 13, 8), np.float32, 3, 2, 0)   # N % 128
+    assert not ok((128, 13, 13, 5), np.float32, 3, 2, 0)   # C % sublanes
+    assert not ok((128, 13, 13, 8), np.float32, 3, 2, 1)   # pad
+    assert not ok((128, 32, 32, 8), np.float32, 3, 2, 0)   # ceil end-pad
+    assert not ok((128, 2, 2, 8), np.float32, 3, 2, 0)     # tiny
+
+
+def test_pool2d_impl_pallas_rejects_unsupported(rng):
+    x = jnp.asarray(rng.standard_normal((4, 8, 8, 8)).astype(np.float32))
+    with pytest.raises(ValueError, match="impl='pallas' unsupported"):
+        pool2d(x, "MAX", 3, 2, 0, impl="pallas")  # CPU backend + N%128
+
+
+def test_pool2d_auto_is_xla_everywhere():
+    """`auto` must stay on reduce_window (the kernel measured -10% end to
+    end, PERF.md); this pins the dispatch so a refactor doesn't silently
+    flip it back on."""
+    import sparknet_tpu.ops.pooling as pooling
+    called = []
+    orig = pooling._can_pallas_pool
+    pooling._can_pallas_pool = lambda *a: called.append(a) or True
+    try:
+        x = jnp.zeros((128, 13, 13, 8), jnp.float32)
+        pool2d(x, "MAX", 3, 2, 0)          # auto
+        assert not called                   # never even consulted
+    finally:
+        pooling._can_pallas_pool = orig
+
+
+def test_pool2d_impl_validation(rng):
+    x = jnp.asarray(rng.standard_normal((4, 8, 8, 8)).astype(np.float32))
+    with pytest.raises(ValueError, match="unknown pool impl"):
+        pool2d(x, "MAX", 3, 2, 0, impl="palas")
+    with pytest.raises(ValueError, match="MAX pooling only"):
+        pool2d(x, "AVE", 3, 2, 0, impl="pallas")
