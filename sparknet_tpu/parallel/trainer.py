@@ -167,10 +167,12 @@ class ParallelTrainer:
                     for l, lp in tree.items()}
 
         params_dev = expand_tree(params)
+        vdt = jnp.dtype(self.solver.cfg.velocity_dtype)
         state = TrainState(
             params=params_dev,
             momentum=(expand_tree(momentum) if momentum is not None
-                      else jax.tree.map(jnp.zeros_like, params_dev)),
+                      else jax.tree.map(
+                          lambda w: jnp.zeros(w.shape, vdt), params_dev)),
             it=jnp.full((self.n_devices,), int(it), jnp.int32))
         return self.place(state)
 
@@ -192,7 +194,10 @@ class ParallelTrainer:
         def reassemble(kind: str, lname: str, pname: str,
                        x: np.ndarray) -> np.ndarray:
             reduce = ((lambda rows: rows[0]) if kind == "params"
-                      else (lambda rows: rows.mean(axis=0)))
+                      # f32 accumulator: a bf16 velocity (SolverConfig.
+                      # velocity_dtype) must not be averaged in bf16
+                      else (lambda rows: rows.mean(
+                          axis=0, dtype=np.float32).astype(rows.dtype)))
             if lname in old_tp_layers:
                 axis = 1 if pname == "w" else 0
                 return np.concatenate(

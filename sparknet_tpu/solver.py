@@ -43,6 +43,15 @@ class SolverConfig:
     momentum: float = 0.9
     weight_decay: float = 0.0
     iter_size: int = 1
+    # Storage dtype for the velocity (momentum history). "float32" is
+    # Caffe-exact. "bfloat16" is an OPT-IN speed knob: each step still
+    # computes the update in f32 and applies the UNROUNDED velocity to the
+    # weights — only the stored history is rounded — but it halves the
+    # optimizer-state HBM stream that bounds the fc tail (PERF.md: fc6/7/8
+    # wgrad+update fusions run at the memory roofline streaming f32 state).
+    # Not the default because accuracy-parity (PARITY.md) is pinned to the
+    # exact rule.
+    velocity_dtype: str = "float32"
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "SolverConfig":
@@ -111,6 +120,10 @@ class SgdSolver:
 
     def __init__(self, net: CompiledNet, cfg: SolverConfig,
                  loss_blob: str = "loss"):
+        if cfg.velocity_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"velocity_dtype {cfg.velocity_dtype!r}: expected 'float32' "
+                f"(Caffe-exact) or 'bfloat16' (opt-in, see SolverConfig)")
         self.net = net
         self.cfg = cfg
         self.loss_blob = loss_blob
@@ -120,7 +133,8 @@ class SgdSolver:
     # -- state --------------------------------------------------------------
 
     def init_state(self, params: PyTree) -> SolverState:
-        zeros = jax.tree.map(jnp.zeros_like, params)
+        vdt = jnp.dtype(self.cfg.velocity_dtype)
+        zeros = jax.tree.map(lambda w: jnp.zeros(w.shape, vdt), params)
         return SolverState(momentum=zeros, it=jnp.zeros((), jnp.int32))
 
     # -- single-step update (pure) ------------------------------------------
@@ -134,8 +148,11 @@ class SgdSolver:
             lr_mult, decay_mult = path_key
             local_rate = rate * lr_mult
             local_decay = self.cfg.weight_decay * decay_mult
-            v_new = self.cfg.momentum * v + local_rate * (g + local_decay * w)
-            return w - v_new, v_new
+            # compute in the weight dtype (f32); only the STORED history is
+            # in velocity_dtype — the weight sees the unrounded velocity
+            v_new = (self.cfg.momentum * v.astype(w.dtype)
+                     + local_rate * (g + local_decay * w))
+            return w - v_new, v_new.astype(v.dtype)
 
         new_params: PyTree = {}
         new_mom: PyTree = {}

@@ -134,3 +134,56 @@ def test_iter_size_rejected_in_distributed_trainer():
     net = CompiledNet.compile(adult_net(batch=4, n_features=16))
     with pytest.raises(ValueError, match="iter_size"):
         ParallelTrainer(net, SolverConfig(iter_size=2), make_mesh(2))
+
+
+def test_bf16_velocity_opt_in():
+    """velocity_dtype='bfloat16' (SolverConfig): the stored momentum
+    history is bf16 but each step applies the UNROUNDED f32 velocity, so a
+    short trajectory stays close to the exact rule; the default remains
+    float32 (Caffe-exact, PARITY.md)."""
+    net = CompiledNet.compile(net_from_prototxt(CIFARISH))
+    base = dict(base_lr=0.05, momentum=0.9, weight_decay=0.004,
+                lr_policy="fixed")
+    exact = SgdSolver(net, SolverConfig(**base))
+    fast = SgdSolver(net, SolverConfig(velocity_dtype="bfloat16", **base))
+    params = net.init_params(jax.random.PRNGKey(0))
+    se, sf = exact.init_state(params), fast.init_state(params)
+    assert se.momentum["conv1"]["w"].dtype == jnp.float32
+    assert sf.momentum["conv1"]["w"].dtype == jnp.bfloat16
+    g = jax.tree.map(lambda w: jnp.ones_like(w) * 0.5, params)
+    pe, pf = params, params
+    for _ in range(3):
+        pe, se = exact.update(pe, se, g)
+        pf, sf = fast.update(pf, sf, g)
+    # params stay f32 and close to the exact trajectory (bf16 has ~3
+    # decimal digits; 3 steps of history rounding)
+    assert pf["conv1"]["w"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(pf["conv1"]["w"]),
+                               np.asarray(pe["conv1"]["w"]),
+                               rtol=2e-2, atol=2e-3)
+    with pytest.raises(ValueError, match="velocity_dtype"):
+        SgdSolver(net, SolverConfig(velocity_dtype="float16", **base))
+
+
+def test_bf16_velocity_flows_through_trainer(tmp_path):
+    """ParallelTrainer must honor SolverConfig.velocity_dtype when it
+    builds the distributed state (it used to zeros_like the params,
+    silently pinning f32), and a round must run on the bf16 state."""
+    import jax
+    from sparknet_tpu.parallel import ParallelTrainer, make_mesh
+
+    from sparknet_tpu.zoo import cifar10_quick
+    net = CompiledNet.compile(cifar10_quick(batch=2))
+    cfg = SolverConfig(base_lr=0.01, momentum=0.9,
+                       velocity_dtype="bfloat16")
+    tr = ParallelTrainer(net, cfg, make_mesh(2), tau=2)
+    state = tr.init_state(jax.random.PRNGKey(0))
+    assert state.momentum["conv1"]["w"].dtype == jnp.bfloat16
+    assert state.params["conv1"]["w"].dtype == jnp.float32
+    r = np.random.default_rng(0)
+    batches = {"data": r.standard_normal((2, 4, 32, 32, 3))
+               .astype(np.float32),
+               "label": r.integers(0, 10, (2, 4, 1)).astype(np.int32)}
+    state, loss = tr.train_round(state, batches, jax.random.PRNGKey(1))
+    assert np.isfinite(float(loss))
+    assert state.momentum["conv1"]["w"].dtype == jnp.bfloat16
