@@ -91,11 +91,17 @@ vm_state() {
   # the VM gone (NOT_FOUND). A describe that fails for any other reason
   # (network blip, expired auth, API 5xx) is UNKNOWN — watch must WAIT on
   # those, not delete-and-recreate a possibly healthy pod (r3 review).
+  # stderr is captured SEPARATELY: a successful describe that also prints
+  # a gcloud warning must still yield the bare state value, not a
+  # multi-line blob that matches no caller case (r3 advisor).
+  _err=$(mktemp "${TMPDIR:-/tmp}/tpu_launch_err.XXXXXX")
   if out=$($TPU describe "$NAME" --zone "$ZONE" --format='value(state)' \
-           2>&1); then
+           2>"$_err"); then
+    rm -f "$_err"
     echo "$out"
   else
-    case "$out" in
+    err=$(cat "$_err" 2>/dev/null || true); rm -f "$_err"
+    case "$out $err" in
       *NOT_FOUND*|*"not found"*) echo MISSING ;;
       *) echo UNKNOWN ;;
     esac
@@ -171,10 +177,12 @@ recreate() { # $1 = accelerator TYPE; FAILS LOUDLY (caller decides retry)
   if [ -n "${TPU_STAGE_DIR:-}" ]; then do_stage "$TPU_STAGE_DIR" || return 1; fi
 }
 
-recover_if_preempted() { # $1 = TYPE; returns 0 if the VM is (now) usable
+recover_if_preempted() { # $1 = TYPE; returns 0 if the VM is (now) usable.
+  # Sets RECREATED=1 when it actually rebuilt the pod (callers that track
+  # consecutive-failure state reset it on a real recovery, not on a probe).
   case "$(vm_state)" in
     READY) return 0 ;;
-    PREEMPTED|MISSING|TERMINATED|STOPPED) recreate "$1" ;;  # propagate
+    PREEMPTED|MISSING|TERMINATED|STOPPED) RECREATED=1; recreate "$1" ;;
     *) return 1 ;;  # CREATING/REPAIRING/UNKNOWN: wait, don't recreate
   esac
 }
@@ -196,20 +204,36 @@ case "$CMD" in
     # turns the re-run into a continuation. A clean non-zero exit from
     # the app itself on a READY VM is a real failure -> stop and report.
     [ -n "$ARG2" ] || { echo "watch needs TYPE and COMMAND" >&2; exit 1; }
+    # ready_fails counts CONSECUTIVE run failures with the pod READY: one
+    # is retried (a transient ssh/network drop on a long run doesn't
+    # change the VM state, and the app's checkpoint resume makes a re-run
+    # a continuation — r3 advisor); two in a row is an app error. The
+    # count resets ONLY on a real recovery (a recreate) — not on UNKNOWN
+    # probes, which recovered nothing and would let a deterministically
+    # failing app loop forever under flaky describes.
+    ready_fails=0
     while :; do
+      RECREATED=
       if ! recover_if_preempted "$ARG"; then
         echo "state $(vm_state): waiting ${TPU_POLL_SECS}s" >&2
         sleep "$TPU_POLL_SECS"; continue
       fi
+      [ -z "$RECREATED" ] || ready_fails=0
       if do_run "$ARG2"; then
         echo "watch: command completed" >&2; break
       fi
       s=$(vm_state)
       if [ "$s" = "READY" ]; then
-        echo "watch: command failed on a READY pod — app error, not " \
-             "preemption; inspect logs (rerun with: $0 resume $NAME $ZONE" \
-             "'$ARG' '...')" >&2
-        exit 1
+        ready_fails=$((ready_fails + 1))
+        if [ "$ready_fails" -ge 2 ]; then
+          echo "watch: command failed twice on a READY pod — app error," \
+               "not preemption; inspect logs (rerun with: $0 resume" \
+               "$NAME $ZONE '$ARG' '...')" >&2
+          exit 1
+        fi
+        echo "watch: run failed on a READY pod; retrying once (ssh" \
+             "drop?)" >&2
+        sleep "$TPU_POLL_SECS"; continue
       fi
       echo "watch: run died with pod state $s; recovering" >&2
     done ;;

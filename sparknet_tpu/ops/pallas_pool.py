@@ -78,8 +78,16 @@ def _bwd_kernel(x_ref, y_ref, dy_ref, dx_ref, acc_ref, *, H: int,
             lr = oh * s + ki - h0        # local target row in this block
             lrc = jnp.clip(lr, 0, Hb - 1)
             ok = jnp.logical_and(lr >= 0, lr < Hb)
+            # INVARIANT: only windows with NO row in [h0, h0+Hb) — QB
+            # over-provision at the grid edges — can place oh*s+ki-xs
+            # outside [0, XB); every contribution of such a window is
+            # ok-masked (lr out of range for all ki), so the clamped
+            # (wrong-row) read feeds only dead lanes. The explicit clip
+            # keeps the read in-bounds rather than leaning on the Mosaic
+            # dynamic-slice clamp (r3 advisor).
             planes = _deinterleave(
-                x_ref[pl.ds(oh * s + ki - xs, 1)].astype(jnp.float32), s)
+                x_ref[pl.ds(jnp.clip(oh * s + ki - xs, 0, XB - 1), 1)]
+                .astype(jnp.float32), s)
             for kj in range(k):
                 p, off = kj % s, kj // s   # col kj+s*ow -> plane kj%s @ ow+kj//s
                 xw = lax.slice_in_dim(planes[p], off, off + OW, axis=1)

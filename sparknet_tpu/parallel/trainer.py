@@ -29,6 +29,7 @@ round-trips, weights never leave the devices, the driver only gets scalars.
 """
 from __future__ import annotations
 
+import dataclasses
 import functools
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
@@ -223,7 +224,19 @@ class ParallelTrainer:
         the jitted round expects — required after checkpoint restore, else
         every subsequent round recompiles for the foreign layout. Leaves
         carry the GLOBAL device axis; under multi-host each process
-        contributes its own devices' rows."""
+        contributes its own devices' rows.
+
+        The momentum dtype is part of that layout: a checkpoint taken under
+        a different SolverConfig.velocity_dtype would otherwise ride along
+        uncast and silently override the configured knob for the rest of
+        the run, so it is cast here (both the same-topology and the
+        elastic-resume path funnel through place)."""
+        vdt = jnp.dtype(self.solver.cfg.velocity_dtype)
+        if any(x.dtype != vdt for x in jax.tree.leaves(state.momentum)):
+            state = dataclasses.replace(
+                state, momentum=jax.tree.map(
+                    lambda x: jnp.asarray(x).astype(vdt)
+                    if x.dtype != vdt else x, state.momentum))
         return place_global_state(state, self.mesh, self._dev_spec)
 
     def averaged_params(self, state: TrainState) -> PyTree:

@@ -20,7 +20,22 @@ import tempfile
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+import ml_dtypes
 import numpy as np
+
+# np.savez silently degrades extension dtypes (bfloat16 & friends from
+# ml_dtypes) to void ('V2') — the restored leaf is unusable. Such leaves are
+# stored as same-width uint views with the real dtype name recorded in
+# meta.json, and re-viewed on restore.
+_UINT_OF_SIZE = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+def _is_extension_dtype(dt: np.dtype) -> bool:
+    # bfloat16/float8_e4m3fn report kind 'V', but float8_e5m2 reports kind
+    # 'f' (and still breaks savez) — match on the registering module too,
+    # excluding structured dtypes (which have .names)
+    return dt.names is None and (
+        dt.kind == "V" or dt.type.__module__ == "ml_dtypes")
 
 
 def _flatten(tree: Any) -> Dict[str, np.ndarray]:
@@ -46,10 +61,17 @@ def save(directory: str, tree: Any, *, step: int,
     """Atomically write checkpoint `step-N` under directory; returns path."""
     os.makedirs(directory, exist_ok=True)
     flat = _flatten(tree)
+    ext_dtypes = {}
+    for key, arr in flat.items():
+        if _is_extension_dtype(arr.dtype):
+            ext_dtypes[key] = arr.dtype.name
+            flat[key] = arr.view(_UINT_OF_SIZE[arr.dtype.itemsize])
     tmp = tempfile.mkdtemp(dir=directory, prefix=".tmp-")
     try:
         np.savez(os.path.join(tmp, "state.npz"), **flat)
         meta = {"step": int(step), "keys": sorted(flat.keys())}
+        if ext_dtypes:
+            meta["ext_dtypes"] = ext_dtypes
         if extra:
             meta["extra"] = extra
         with open(os.path.join(tmp, "meta.json"), "w") as f:
@@ -115,6 +137,8 @@ def restore_flat(directory: str, step: Optional[int] = None
         meta = json.load(f)
     with np.load(os.path.join(path, "state.npz")) as z:
         flat = {k: z[k] for k in z.files}
+    for key, name in meta.get("ext_dtypes", {}).items():
+        flat[key] = flat[key].view(np.dtype(name))
     return flat, int(meta["step"]), meta.get("extra", {})
 
 

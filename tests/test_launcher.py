@@ -18,6 +18,7 @@ echo "$@" >> "$DIR/calls.log"
 case "$*" in
   *"tpu-vm describe"*)
     if [ -f "$DIR/transient" ]; then echo "ERROR: auth expired"; exit 1; fi
+    if [ -f "$DIR/warn" ]; then echo "WARNING: quota nearing limit" >&2; fi
     if [ -f "$DIR/state" ]; then cat "$DIR/state"
     else echo "ERROR: NOT_FOUND: $2"; exit 1; fi ;;
   *"tpu-vm create"*)
@@ -114,13 +115,26 @@ def test_watch_recovers_vanished_vm(launcher):
 
 
 def test_watch_stops_on_real_app_failure(launcher):
-    """A non-zero exit on a READY pod is an app bug, not a preemption:
-    watch must NOT loop — it stops and points at `resume`."""
+    """A non-zero exit on a READY pod that REPEATS is an app bug, not a
+    preemption: watch must NOT loop — it stops and points at `resume`."""
+    launcher("create", "pod", "z", "v5e-32")
+    r = launcher("watch", "pod", "z", "v5e-32", "python -m app",
+                 plan=["fail", "fail", "ok"])
+    assert r.returncode == 1
+    assert "app error" in r.stderr
+    assert launcher.calls().count("tpu-vm create") == 1  # no recreate
+
+
+def test_watch_retries_transient_run_failure(launcher):
+    """ONE run failure on a READY pod is retried before concluding app
+    error: a transient ssh/network drop mid-run must not abort
+    supervision of a healthy training job (r3 advisor)."""
     launcher("create", "pod", "z", "v5e-32")
     r = launcher("watch", "pod", "z", "v5e-32", "python -m app",
                  plan=["fail", "ok"])
-    assert r.returncode == 1
-    assert "app error" in r.stderr
+    assert r.returncode == 0, r.stderr
+    assert "retrying once" in r.stderr
+    assert "command completed" in r.stderr
     assert launcher.calls().count("tpu-vm create") == 1  # no recreate
 
 
@@ -153,6 +167,20 @@ def test_delete_cleans_queued_wrapper(launcher):
     launcher("delete", "pod", "z")
     assert "queued-resources delete" in launcher.calls()
     assert launcher.state() == "MISSING"
+
+
+def test_describe_warning_does_not_mask_state(launcher):
+    """A successful describe that ALSO prints a gcloud warning to stderr
+    must still yield the bare state value — with stderr folded into the
+    capture, watch would see a multi-line blob matching no case and
+    degrade to an endless UNKNOWN-wait on a READY pod (r3 advisor)."""
+    launcher("create", "pod", "z", "v5e-32")
+    (launcher.stub_dir / "warn").write_text("")
+    r = launcher("status", "pod", "z")
+    assert r.stdout.strip() == "READY"
+    # and watch still supervises a run to completion through the warning
+    r = launcher("watch", "pod", "z", "v5e-32", "python -m app", plan=["ok"])
+    assert r.returncode == 0, r.stderr
 
 
 def test_transient_describe_failure_is_not_missing(launcher):
@@ -205,3 +233,14 @@ def test_queued_recreate_knob(launcher):
                  env={"TPU_QUEUED": "1"}, plan=["ok"])
     assert r.returncode == 0, r.stderr
     assert launcher.calls().count("queued-resources create") == 2
+
+
+def test_watch_recreate_resets_transient_fail_count(launcher):
+    """A real recovery (recreate) between two READY-pod run failures must
+    reset the consecutive-failure count: fail -> preempt+recreate -> fail
+    -> ok is a healthy supervised run, not an 'app error'."""
+    launcher("create", "pod", "z", "v5e-32")
+    r = launcher("watch", "pod", "z", "v5e-32", "python -m app",
+                 plan=["fail", "preempt", "fail", "ok"])
+    assert r.returncode == 0, r.stderr
+    assert launcher.calls().count("tpu-vm create") == 2  # one recreate

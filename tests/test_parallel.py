@@ -224,3 +224,48 @@ def test_tp_trajectory_matches_dp_exactly(rng):
     ev = {"data": batches["data"][0], "label": batches["label"][0]}
     assert dp.evaluate(s_dp, ev) == pytest.approx(tp.evaluate(s_tp, ev),
                                                   abs=1e-6)
+
+
+# -- velocity_dtype across resume (r3 advisor) -------------------------------
+
+def test_resume_casts_momentum_to_configured_velocity_dtype(net, cfg, tmp_path):
+    """A checkpoint carries the momentum dtype it was trained with; resuming
+    under a different SolverConfig.velocity_dtype must apply the CONFIGURED
+    dtype, not silently inherit the checkpoint's (r3 advisor). Both resume
+    paths funnel through ParallelTrainer.place, so each is checked."""
+    from dataclasses import replace
+    from sparknet_tpu.parallel.mesh import fetch_global
+    from sparknet_tpu.utils import checkpoint as ckpt
+
+    f32 = ParallelTrainer(net, cfg, make_mesh(), tau=TAU)
+    state, _ = f32.train_round(f32.init_state(jax.random.PRNGKey(0)),
+                               make_round_batches(0), jax.random.PRNGKey(1))
+    ckpt.save(str(tmp_path), fetch_global(state), step=1,
+              extra={"n_devices": N_DEV, "tp": 1})
+    flat, _, _ = ckpt.restore_flat(str(tmp_path))
+
+    bf16 = ParallelTrainer(net, replace(cfg, velocity_dtype="bfloat16"),
+                           make_mesh(), tau=TAU)
+    # same-topology path (train_loop: place(unflatten_like(...)))
+    restored = bf16.place(ckpt.unflatten_like(
+        bf16.init_state(jax.random.PRNGKey(0)), flat))
+    for leaf in jax.tree.leaves(restored.momentum):
+        assert leaf.dtype == jnp.bfloat16
+    for leaf in jax.tree.leaves(restored.params):
+        assert leaf.dtype == jnp.float32  # params untouched
+    # elastic path (adapt_state -> state_from_params -> place)
+    adapted = bf16.adapt_state(flat)
+    for leaf in jax.tree.leaves(adapted.momentum):
+        assert leaf.dtype == jnp.bfloat16
+    # the restored state trains (dtype layout matches the jitted round)
+    restored, loss = bf16.train_round(restored, make_round_batches(1),
+                                      jax.random.PRNGKey(2))
+    assert np.isfinite(float(loss))
+    # and the reverse direction: bf16 checkpoint into an f32 run
+    ckpt.save(str(tmp_path), fetch_global(restored), step=2,
+              extra={"n_devices": N_DEV, "tp": 1})
+    flat2, _, _ = ckpt.restore_flat(str(tmp_path))
+    back = f32.place(ckpt.unflatten_like(
+        f32.init_state(jax.random.PRNGKey(0)), flat2))
+    for leaf in jax.tree.leaves(back.momentum):
+        assert leaf.dtype == jnp.float32
