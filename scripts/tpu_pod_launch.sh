@@ -19,8 +19,12 @@
 #      TPU_SPOT=1 scripts/tpu_pod_launch.sh create mypod us-east5-b v5e-32
 #      scripts/tpu_pod_launch.sh setup mypod us-east5-b
 #      scripts/tpu_pod_launch.sh watch mypod us-east5-b v5e-32 \
-#        "python -m sparknet_tpu.apps.imagenet_app --data-dir /gcs/imagenet \
+#        "python -m sparknet_tpu.apps.imagenet_app \
+#         --data-dir gs://mybucket/imagenet ingest_sources=8 \
 #         checkpoint_dir=/gcs/ckpts/run1"
+#    (--data-dir gs://… streams the bucket NATIVELY — ranged HTTP reads
+#    with reconnect-resume, sparknet_tpu/data/gcs.py; no FUSE mount in the
+#    data path. checkpoint_dir still wants a mounted/shared filesystem.)
 # 2. Capacity is reclaimed mid-run (state PREEMPTED, or the VM disappears).
 #    `watch` notices — either the ssh run dies and the state probe says so,
 #    or the next poll does — deletes the husk, recreates the VM (same TYPE,
@@ -43,8 +47,8 @@
 # `stage` copies DIR to ~/sparknet_tpu_repo/<basename> on EVERY worker —
 # tar-sharded datasets are then host-sharded automatically at run time
 # (each process takes shards i::k); small datasets (CIFAR/MNIST) are
-# simply replicated. For full ImageNet prefer bucket storage (GCS fuse)
-# over staging to local disks.
+# simply replicated. For full ImageNet prefer native bucket streaming
+# (--data-dir gs://bucket/imagenet) over staging to local disks.
 #
 # Environment knobs:
 #   TPU_SW_VERSION   runtime image (default v2-alpha-tpuv5-lite; e.g.

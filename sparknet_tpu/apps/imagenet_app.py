@@ -32,7 +32,8 @@ import numpy as np
 from ..data import imagenet
 from ..data.dataset import ArrayDataset
 from ..data.preprocess import ImagePreprocessor, compute_mean_image
-from ..data.streaming import StreamingRoundSource, streaming_sum_count
+from ..data.streaming import (StreamingRoundSource, make_parallel_source,
+                              streaming_sum_count)
 from ..parallel import initialize_multihost
 from ..parallel.mesh import host_id_count
 from ..schema import Field, Schema
@@ -88,15 +89,13 @@ def _host_image_estimate(loader, cfg: RunConfig, prefix: str,
     hosts' tars (r2 review). Byte share is a far better proxy for image
     count than count/pc — within one corpus, JPEG size variation averages
     out across whole shards."""
-    import os
-
     n_total = len(loader.label_map)
     if pc == 1:
         return float(n_total)
     try:
-        all_bytes = sum(os.path.getsize(p) for p in
+        all_bytes = sum(imagenet.path_size(p) for p in
                         imagenet.list_shards(cfg.data_dir, prefix=prefix))
-        mine = sum(os.path.getsize(p) for p in loader.shard_paths)
+        mine = sum(imagenet.path_size(p) for p in loader.shard_paths)
     except OSError:
         return n_total / pc
     if all_bytes <= 0:
@@ -125,7 +124,7 @@ def _corpus_id(cfg: RunConfig, prefix: str, train_loader, pc: int) -> str:
             raise
         shards = train_loader.shard_paths
     sig = ";".join(
-        f"{os.path.basename(p)}:{os.path.getsize(p)}" for p in shards)
+        f"{os.path.basename(p)}:{imagenet.path_size(p)}" for p in shards)
     return hashlib.sha1(
         f"{len(train_loader.label_map)}|{sig}".encode()).hexdigest()
 
@@ -294,10 +293,29 @@ def prepare_data(cfg: RunConfig, args, label_shape: Tuple[int, ...] = (1,),
         import jax
         n_local = (jax.local_device_count() if cfg.n_devices is None
                    else max(1, cfg.n_devices // pc))
-        # the loader re-opens its tars on each iteration, so the mean pass
-        # and the training stream share it (and its skipped counter)
-        train_raw = StreamingRoundSource(train_loader, n_local,
-                                         cfg.local_batch, cfg.tau)
+        if cfg.ingest_sources > 1:
+            # N concurrent readers over this host's shards j::N — the
+            # reference's task-per-tar parallel decode
+            # (`loaders/ImageNetLoader.scala:28-41`), per host. The
+            # effective count is agreed GLOBALLY (min shards any host
+            # holds, floor(total/pc)): hosts with uneven i::k splits must
+            # not end up with different reader counts, or the checkpoint's
+            # cursor allgather receives ragged arrays and the collective
+            # dies mid-run.
+            total = len(imagenet.list_shards(cfg.data_dir,
+                                             prefix=args.train_prefix))
+            eff = max(1, min(cfg.ingest_sources, total // pc))
+            train_raw = make_parallel_source(
+                train_loader.shard_paths, train_loader.label_map,
+                n_local, cfg.local_batch, cfg.tau, eff,
+                height=256, width=256)
+            print(f"{app_name}: {train_raw.n_sources} parallel shard "
+                  f"readers", file=sys.stderr)
+        else:
+            # the loader re-opens its tars on each iteration, so the mean
+            # pass and the training stream share it (+ skipped counter)
+            train_raw = StreamingRoundSource(train_loader, n_local,
+                                             cfg.local_batch, cfg.tau)
     else:
         train_raw = ArrayDataset({"data": images, "label": labels[:, None]})
     try:

@@ -331,41 +331,59 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
 
 
 def _stream_rows(source, last_round: Optional[int]) -> Optional[list]:
-    """Per-host [[shard, entry, epochs], ...] stream cursors after
-    `last_round`, allgathered so process 0's checkpoint covers every host's
-    stream position. None when the source is not seekable or the cursor is
-    no longer retained. Collective when multi-host — every process calls
-    _save_checkpoint already."""
+    """Per-host stream cursors after `last_round`, allgathered so process
+    0's checkpoint covers every host's stream position: one entry per host,
+    each a [[shard, entry, epochs], ...] list with one row PER READER
+    (ParallelStreamingSource runs N concurrent readers per host; a single
+    StreamingRoundSource is the N=1 case). None when the source is not
+    seekable or the cursor is no longer retained. Collective when
+    multi-host — every process calls _save_checkpoint already."""
     if last_round is None or not hasattr(source, "cursor_at"):
         return None
     cur = source.cursor_at(last_round)
     if cur is None:
         return None
-    (shard, entry), epochs = cur
-    row = np.asarray([shard, entry, epochs], np.int64)
+    if not isinstance(cur, list):  # single-reader source
+        cur = [cur]
+    rows = np.asarray([[s, e, ep] for (s, e), ep in cur], np.int64)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
-        rows = np.asarray(multihost_utils.process_allgather(row))
+        rows = np.asarray(multihost_utils.process_allgather(rows))
     else:
-        rows = row[None]
+        rows = rows[None]
     return rows.tolist()
 
 
 def _seek_stream(source, extra: Dict[str, Any], log: Logger) -> None:
-    """Resume the stream position recorded in the checkpoint (one cursor
-    row per host). Host-count changes restart the stream from shard 0 —
-    the shard assignment itself changed, so old cursors are meaningless."""
+    """Resume the stream position recorded in the checkpoint (per host, one
+    cursor row per reader). Host-count OR reader-count changes restart the
+    stream from shard 0 — the shard assignment itself changed, so old
+    cursors are meaningless. Accepts the pre-r4 flat [shard, entry,
+    epochs]-per-host format as a 1-reader cursor."""
     rows = extra.get("stream")
-    if rows is None or not hasattr(source, "seek"):
+    if rows is None:
         return
     if len(rows) != jax.process_count():
         log.log(f"stream cursor in checkpoint covers {len(rows)} hosts, "
                 f"now {jax.process_count()}: restarting stream at shard 0")
         return
-    shard, entry, epochs = rows[jax.process_index()]
-    source.seek((shard, entry), epochs)
-    log.log(f"stream resumed at shard {shard} entry {entry} "
-            f"(epoch {epochs})")
+    host_rows = rows[jax.process_index()]
+    if host_rows and not isinstance(host_rows[0], list):
+        host_rows = [host_rows]  # legacy flat single-reader row
+    if hasattr(source, "seek_rows"):
+        if not source.seek_rows(host_rows):
+            log.log(f"stream cursor in checkpoint covers {len(host_rows)} "
+                    f"readers, source has a different count: restarting "
+                    f"stream at shard 0")
+            return
+    elif hasattr(source, "seek") and len(host_rows) == 1:
+        shard, entry, epochs = host_rows[0]
+        source.seek((shard, entry), epochs)
+    else:
+        return
+    pos = ", ".join(f"shard {s} entry {e} (epoch {ep})"
+                    for s, e, ep in host_rows)
+    log.log(f"stream resumed at {pos}")
 
 
 def _save_checkpoint(cfg: RunConfig, trainer, state, step: int,

@@ -142,22 +142,26 @@ class GraphNet:
     def _topo_order(self, fetches: Sequence[str]) -> List[NodeDef]:
         """Topological order of the ANCESTORS of `fetches` only — lazy, like
         a session run: unrelated subgraphs (e.g. an imported TF graph's
-        gradient machinery) are never touched."""
+        gradient machinery) are never touched. Explicit-stack DFS: an
+        imported chain graph can be thousands of nodes deep, far past
+        Python's recursion limit."""
         order, seen = [], set()
-
-        def visit(name: str):
-            if name in seen:
-                return
-            seen.add(name)
-            n = self._nodes.get(name)
-            if n is None:
-                raise KeyError(f"graph references unknown node {name!r}")
-            for i in n.inputs:
-                visit(i)
-            order.append(n)
-
         for f in fetches:
-            visit(f)
+            stack = [(f, False)]
+            while stack:
+                name, expanded = stack.pop()
+                if expanded:
+                    order.append(self._nodes[name])
+                    continue
+                if name in seen:
+                    continue
+                seen.add(name)
+                n = self._nodes.get(name)
+                if n is None:
+                    raise KeyError(f"graph references unknown node {name!r}")
+                stack.append((name, True))  # emit after the inputs
+                for i in reversed(n.inputs):  # visit in declaration order
+                    stack.append((i, False))
         return order
 
     def _eval(self, variables, batch, fetches: Sequence[str]):
@@ -451,19 +455,24 @@ class GraphNet:
                 out.append(n.name)
         return out
 
-    def _evaluable(self, name: str, _seen: Optional[set] = None) -> bool:
-        seen = _seen if _seen is not None else set()
-        if name in seen:
-            return True
-        seen.add(name)
-        n = self._nodes.get(name)
-        if n is None:  # unknown ref, e.g. 'node:1'
-            return False
-        if n.op.startswith("TF::"):
-            return False
-        if n.op in ("Placeholder", "Variable", "Const"):
-            return True
-        return all(self._evaluable(i, seen) for i in n.inputs)
+    def _evaluable(self, name: str) -> bool:
+        """True iff no ancestor is opaque (TF::*) or an unknown ref.
+        Explicit-stack DFS — must not inherit a recursion-depth limit from
+        the host (deep imported chains are legal graphs)."""
+        seen, stack = set(), [name]
+        while stack:
+            nm = stack.pop()
+            if nm in seen:
+                continue
+            seen.add(nm)
+            n = self._nodes.get(nm)
+            if n is None:  # unknown ref, e.g. 'node:1'
+                return False
+            if n.op.startswith("TF::"):
+                return False
+            if n.op not in ("Placeholder", "Variable", "Const"):
+                stack.extend(n.inputs)
+        return True
 
     def output_schema(self) -> Schema:
         outs = self.forward_shapes(self.output_names())

@@ -25,20 +25,29 @@ import numpy as np
 
 
 def load_label_map(path: str) -> Dict[str, int]:
-    """Parse 'filename label' lines (reference getLabels, lines 44-57)."""
+    """Parse 'filename label' lines (reference getLabels, lines 44-57).
+    Accepts a local path or a gs:// url (the reference read its label file
+    from S3 the same way, `ImageNetLoader.scala:44-57`)."""
+    from .gcs import gs_read, is_gs_path
+    text = (gs_read(path).decode() if is_gs_path(path)
+            else open(path).read())
     out: Dict[str, int] = {}
-    with open(path) as f:
-        for ln in f:
-            ln = ln.strip()
-            if not ln:
-                continue
-            name, _, label = ln.rpartition(" ")
-            out[name] = int(label)
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln:
+            continue
+        name, _, label = ln.rpartition(" ")
+        out[name] = int(label)
     return out
 
 
 def list_shards(root: str, prefix: str = "") -> List[str]:
-    """All .tar shard paths under root matching prefix, sorted."""
+    """All .tar shard paths under root matching prefix, sorted. A gs://
+    root lists the bucket natively (HTTP, no FUSE — the reference listed
+    its S3 bucket per run, `ImageNetLoader.scala:28-41`)."""
+    from .gcs import gs_list_shards, is_gs_path
+    if is_gs_path(root):
+        return gs_list_shards(root, prefix)
     shards = sorted(
         os.path.join(root, f) for f in os.listdir(root)
         if f.startswith(prefix) and f.endswith(".tar"))
@@ -46,6 +55,27 @@ def list_shards(root: str, prefix: str = "") -> List[str]:
         raise FileNotFoundError(f"no .tar shards under {root!r} "
                                 f"matching prefix {prefix!r}")
     return shards
+
+
+def path_size(path: str) -> int:
+    """Byte size of a local file or gs:// object (shard-weight estimates
+    and corpus identity use sizes; gs sizes come from the listing
+    metadata, cached — no extra round trip per shard)."""
+    from .gcs import gs_size, is_gs_path
+    return gs_size(path) if is_gs_path(path) else os.path.getsize(path)
+
+
+def _open_tar(path: str) -> tarfile.TarFile:
+    """Local shards open seekably; gs:// shards open as ONE streamed
+    ranged GET (`r|` mode) with transparent reconnect-resume — the
+    per-task streamed GetObject of the reference
+    (`ImageNetLoader.scala:62-63`). Entry-skip on resume reads through
+    the stream (tar offsets of entry N are unknown without an index),
+    which costs one partial shard download once per restart."""
+    from .gcs import gs_open_stream, is_gs_path
+    if is_gs_path(path):
+        return tarfile.open(fileobj=gs_open_stream(path), mode="r|*")
+    return tarfile.open(path, "r")
 
 
 def host_shards(shards: Sequence[str], host_id: int, host_count: int) -> List[str]:
@@ -84,6 +114,13 @@ class ShardedTarLoader:
         self.height = height
         self.width = width
         self.skipped = 0  # corrupt/unlabeled entries (counted, never looped on)
+        #: cumulative seconds inside decode calls (the OpenMP-parallel
+        #: stage) — wall and calling-thread CPU. Pipeline benchmarks
+        #: subtract the CPU figure from the producer's CPU time to get the
+        #: "serial residue" (tar read + buffer write + glue); CPU clocks
+        #: stay honest under GIL/core contention where wall clocks inflate
+        self.decode_s = 0.0
+        self.decode_cpu_s = 0.0
         self._decode = get_decoder()
         self._decode_batch = None
         try:
@@ -111,7 +148,7 @@ class ShardedTarLoader:
         chunk: List[Tuple[bytes, int, Tuple[int, int]]] = []
         for si in range(start_shard, len(self.shard_paths)):
             skip = start_entry if si == start_shard else 0
-            with tarfile.open(self.shard_paths[si], "r") as tar:
+            with _open_tar(self.shard_paths[si]) as tar:
                 entry = 0
                 for member in tar:  # ALWAYS advances (bug fix vs reference)
                     entry += 1
@@ -134,9 +171,13 @@ class ShardedTarLoader:
                       ) -> Iterator[Tuple[np.ndarray, int, Tuple[int, int]]]:
         """Decode a buffered chunk — multi-core via the native OpenMP batch
         kernel when available, else per-image fallback."""
+        import time
         if self._decode_batch is not None:
+            t0, c0 = time.perf_counter(), time.thread_time()
             images, ok = self._decode_batch([c[0] for c in chunk],
                                             self.height, self.width)
+            self.decode_s += time.perf_counter() - t0
+            self.decode_cpu_s += time.thread_time() - c0
             for i, (_, label, pos) in enumerate(chunk):
                 if ok[i]:
                     yield images[i], label, pos
@@ -145,7 +186,11 @@ class ShardedTarLoader:
             return
         for data, label, pos in chunk:
             try:
-                yield self._decode(data, self.height, self.width), label, pos
+                t0, c0 = time.perf_counter(), time.thread_time()
+                img = self._decode(data, self.height, self.width)
+                self.decode_s += time.perf_counter() - t0
+                self.decode_cpu_s += time.thread_time() - c0
+                yield img, label, pos
             except Exception:
                 self.skipped += 1
 

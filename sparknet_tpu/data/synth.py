@@ -1,0 +1,82 @@
+"""Deterministic synthetic CIFAR-like dataset (r4: offline accuracy proxy).
+
+Real CIFAR-10 is unreachable in this environment (PARITY.md), so the
+recipe-scale accuracy evidence runs on a synthetic stand-in with the same
+tensor statistics the reference pipeline feeds the net: 3x32x32, raw
+[0, 255] pixel scale, mean-image subtraction downstream (reference
+`loaders/CifarLoader.scala:60-66`), 10 balanced classes. Class-conditional
+and LEARNABLE but not trivial: each class is a smooth random template,
+each example a randomly shifted copy + pixel noise, so cifar10_quick must
+learn translation-tolerant features, not a lookup table.
+
+Fully deterministic in (seed, index): example i is the same bytes on every
+host, every run, every chunk size — the property the parity artifacts and
+the numpy-oracle trajectory test rely on.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+N_CLASSES = 10
+SHAPE = (3, 32, 32)
+_SHIFT = 6        # max |dx|, |dy| translation
+_NOISE = 75.0     # pixel noise std
+_AMP = 40.0       # template amplitude around mid-gray
+# calibration (r4): with shift 6 / noise 75 / amp 40, cifar10_quick reaches
+# ~0.5 test accuracy at 500 iters and keeps climbing through the 4000-iter
+# recipe — hard enough that the full run is informative, far above the 0.1
+# chance floor (the earlier 25/60 setting saturated at 0.99 by iter 100)
+
+
+def class_templates(seed: int = 0) -> np.ndarray:
+    """[10, 3, 32, 32] smooth random templates: 8x8 gaussian fields
+    bilinearly upsampled to 32x32, scaled to mid-gray +- _AMP."""
+    r = np.random.default_rng((seed, 0xC1A55))
+    low = r.standard_normal((N_CLASSES, 3, 8, 8))
+    # bilinear 8 -> 32 upsample via separable linear interpolation
+    xs = np.linspace(0, 7, 32)
+    i0 = np.clip(np.floor(xs).astype(int), 0, 6)
+    frac = xs - i0
+    up = low[..., i0, :] * (1 - frac)[None, None, :, None] + \
+        low[..., i0 + 1, :] * frac[None, None, :, None]
+    up = up[..., i0] * (1 - frac) + up[..., i0 + 1] * frac
+    return (128.0 + _AMP * up / np.abs(up).max()).astype(np.float32)
+
+
+def synthetic_cifar(n: int, seed: int = 0, start: int = 0
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Examples [start, start+n): (images [n,3,32,32] float32 in [0,255],
+    labels [n] int32). Label of example i is i % 10 (balanced)."""
+    tmpl = class_templates(seed)
+    pad = np.pad(tmpl, ((0, 0), (0, 0), (_SHIFT, _SHIFT), (_SHIFT, _SHIFT)),
+                 mode="edge")
+    images = np.empty((n,) + SHAPE, np.float32)
+    labels = np.empty((n,), np.int32)
+    for j in range(n):
+        i = start + j
+        r = np.random.default_rng((seed, 1, i))
+        c = i % N_CLASSES
+        dy, dx = r.integers(-_SHIFT, _SHIFT + 1, 2)
+        base = pad[c, :, _SHIFT + dy:_SHIFT + dy + 32,
+                   _SHIFT + dx:_SHIFT + dx + 32]
+        # NO clipping: clip-saturated pixels create masses of repeated
+        # values, whose conv outputs near-tie in max-pool windows — and a
+        # near-tie's argmax flips under 1-ulp implementation differences,
+        # injecting gradient-routing chaos that swamps trajectory
+        # comparisons (measured: conv1 L2 drift 6.6% by iter 10 with
+        # clipping, 100x less without). Float pixels are fine: the scale
+        # is still CIFAR-like and the mean subtraction downstream centers
+        # them either way.
+        images[j] = base + _NOISE * r.standard_normal(SHAPE, np.float32)
+        labels[j] = c
+    return images, labels
+
+
+def mean_image(seed: int = 0, n: int = 2000) -> np.ndarray:
+    """Deterministic mean image over the first n examples (the CifarLoader
+    computed the train-set mean; n=2000 is statistically equivalent here
+    and keeps artifact generation fast)."""
+    images, _ = synthetic_cifar(n, seed=seed)
+    return images.mean(axis=0)

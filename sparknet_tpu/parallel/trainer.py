@@ -188,7 +188,16 @@ class ParallelTrainer:
         local state with no continuity across a topology change; it is
         averaged over the old data groups (best effort — the reference
         had no resume at all, and momentum is stale-by-design across
-        rounds anyway, SURVEY §7 hard-part #2)."""
+        rounds anyway, SURVEY §7 hard-part #2).
+
+        Measured band (tests/test_apps.py::
+        test_elastic_resume_momentum_trajectory_band): on a learnable
+        synthetic task, resuming an 8-device run at 4 or 2 devices keeps
+        every subsequent round's loss within 10% / 31% respectively of
+        the uninterrupted 8-device trajectory over the next 8 rounds
+        (asserted at <=50%), still descending; a same-topology pass
+        through this path reproduces the trajectory to float noise
+        (<0.2%)."""
         old_tp_layers = {l.name for l in self.net.spec.layers
                          if tp_shards_layer(l, old_tp)}
 

@@ -28,6 +28,11 @@ class RunConfig:
     data_dir: str = "data"
     subtract_mean: bool = True
     crop: Optional[int] = None
+    # concurrent shard readers per host for streaming ingest (shards split
+    # j::N across readers; kills the per-reader serial ceiling — a single
+    # reader's tar-read/buffer-write residue caps it at ~5k img/s
+    # regardless of host cores, PERF.md input-pipeline model)
+    ingest_sources: int = 1
     # distribution
     n_devices: Optional[int] = None     # None = all visible
     tau: int = 10                       # local steps per sync round

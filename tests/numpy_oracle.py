@@ -1,0 +1,232 @@
+"""Independent numpy reimplementation of cifar10_quick forward/backward +
+Caffe SGD — the recipe-scale trajectory oracle (r4, VERDICT item 4b).
+
+Derived from the Caffe layer definitions the reference ran natively
+(conv/pool semantics per Caffe's ConvolutionLayer/PoolingLayer, SGD per
+SGDSolver::ComputeUpdateValue), NOT from sparknet_tpu's jax code: gradients
+come from hand-written im2col/col2im, window argmax routing, and clipped
+average-pool divisors. Agreement of a 50-iteration recipe-hyperparameter
+trajectory between this and the jitted framework step is evidence the
+framework's net+solver are RIGHT, not merely self-consistent.
+
+Layouts follow the framework's storage so states compare directly:
+activations NHWC, conv weights HWIO, ip weights (in, out). All math f32.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+from numpy.lib.stride_tricks import sliding_window_view
+
+
+# -- primitives --------------------------------------------------------------
+
+def _ceil_out(size: int, k: int, s: int) -> int:
+    # Caffe pool output (pad=0): ceil((size - k) / s) + 1
+    return int(np.ceil((size - k) / s)) + 1
+
+
+def conv_fwd(x, w, b, pad):
+    """x [N,H,W,C], w [k,k,C,O] (stride 1). Returns (y, cols)."""
+    n, h, wd, c = x.shape
+    k = w.shape[0]
+    xp = np.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    win = sliding_window_view(xp, (k, k), axis=(1, 2))  # N,OH,OW,C,k,k
+    cols = win.transpose(0, 1, 2, 4, 5, 3).reshape(
+        n, h, wd, k * k * c)  # taps row-major, channel minor == HWIO order
+    y = cols @ w.reshape(k * k * c, -1) + b
+    return y.astype(np.float32), cols
+
+
+def conv_bwd(dy, cols, x_shape, w, pad):
+    """Returns (dx, dw [k,k,C,O], db)."""
+    n, h, wd, c = x_shape
+    k = w.shape[0]
+    o = w.shape[-1]
+    wmat = w.reshape(k * k * c, o)
+    db = dy.sum(axis=(0, 1, 2))
+    dwmat = cols.reshape(-1, k * k * c).T @ dy.reshape(-1, o)
+    dcols = (dy.reshape(-1, o) @ wmat.T).reshape(n, h, wd, k, k, c)
+    dxp = np.zeros((n, h + 2 * pad, wd + 2 * pad, c), np.float32)
+    for ki in range(k):      # col2im: scatter-add each tap's contribution
+        for kj in range(k):
+            dxp[:, ki:ki + h, kj:kj + wd] += dcols[:, :, :, ki, kj]
+    dx = dxp[:, pad:pad + h, pad:pad + wd]
+    return dx, dwmat.reshape(w.shape).astype(np.float32), db.astype(np.float32)
+
+
+def _pool_windows(x, k, s):
+    """End-pad (value-agnostic caller pads) and window: returns padded x
+    dims + window view helper shapes."""
+    n, h, w, c = x.shape
+    oh, ow = _ceil_out(h, k, s), _ceil_out(w, k, s)
+    eh = (oh - 1) * s + k - h
+    ew = (ow - 1) * s + k - w
+    return oh, ow, max(eh, 0), max(ew, 0)
+
+
+def maxpool_fwd(x, k, s):
+    n, h, w, c = x.shape
+    oh, ow, eh, ew = _pool_windows(x, k, s)
+    xp = np.pad(x, ((0, 0), (0, eh), (0, ew), (0, 0)),
+                constant_values=-np.inf)
+    win = sliding_window_view(xp, (k, k), axis=(1, 2))[:, ::s, ::s]
+    # windows row-major: argmax picks the FIRST max (Caffe's recorded argmax)
+    flat = win.reshape(n, oh, ow, c, k * k)
+    arg = flat.argmax(axis=-1)
+    y = np.take_along_axis(flat, arg[..., None], axis=-1)[..., 0]
+    return y.astype(np.float32), arg
+
+
+def maxpool_bwd(dy, arg, x_shape, k, s):
+    n, h, w, c = x_shape
+    oh, ow = dy.shape[1:3]
+    dx = np.zeros((n, h + k, w + k, c), np.float32)  # slack for edge windows
+    ki, kj = np.divmod(arg, k)
+    ii = np.arange(oh)[None, :, None, None] * s + ki
+    jj = np.arange(ow)[None, None, :, None] * s + kj
+    nn = np.arange(n)[:, None, None, None]
+    cc = np.arange(c)[None, None, None, :]
+    np.add.at(dx, (nn, ii, jj, cc), dy)
+    return dx[:, :h, :w]
+
+
+def avepool_fwd(x, k, s):
+    n, h, w, c = x.shape
+    oh, ow, eh, ew = _pool_windows(x, k, s)
+    xp = np.pad(x, ((0, 0), (0, eh), (0, ew), (0, 0)))
+    win = sliding_window_view(xp, (k, k), axis=(1, 2))[:, ::s, ::s]
+    ssum = win.sum(axis=(-2, -1))  # N,OH,OW,C? (window axes last)
+    # Caffe divisor: window extent clipped to the (unpadded, pad=0) image
+    dh = np.minimum(np.arange(oh) * s + k, h) - np.arange(oh) * s
+    dw = np.minimum(np.arange(ow) * s + k, w) - np.arange(ow) * s
+    div = np.outer(dh, dw).astype(np.float32)
+    return (ssum / div[None, :, :, None]).astype(np.float32), div
+
+
+def avepool_bwd(dy, div, x_shape, k, s):
+    n, h, w, c = x_shape
+    oh, ow = dy.shape[1:3]
+    g = dy / div[None, :, :, None]
+    dx = np.zeros((n, h + k, w + k, c), np.float32)
+    for ki in range(k):
+        for kj in range(k):
+            ii = np.arange(oh) * s + ki
+            jj = np.arange(ow) * s + kj
+            dx[:, ii[:, None], jj[None, :], :] += g
+    return dx[:, :h, :w]
+
+
+def softmax_loss_fwd_bwd(logits, labels):
+    """Mean NLL over the batch (Caffe SoftmaxWithLoss default
+    normalization); returns (loss, dlogits)."""
+    z = logits - logits.max(axis=-1, keepdims=True)
+    e = np.exp(z)
+    p = e / e.sum(axis=-1, keepdims=True)
+    n = logits.shape[0]
+    nll = -np.log(p[np.arange(n), labels] + 1e-30)
+    d = p.copy()
+    d[np.arange(n), labels] -= 1.0
+    return float(nll.mean()), (d / n).astype(np.float32)
+
+
+# -- cifar10_quick network ---------------------------------------------------
+
+# (name, kind) in execution order; relu is in-place on its input blob
+ARCH = [("conv1", "conv", 2), ("pool1", "max"), ("relu1", "relu"),
+        ("conv2", "conv", 2), ("relu2", "relu"), ("pool2", "ave"),
+        ("conv3", "conv", 2), ("relu3", "relu"), ("pool3", "ave"),
+        ("ip1", "ip"), ("ip2", "ip")]
+POOL_K, POOL_S = 3, 2
+# cifar10_quick param multipliers (weight, bias): lr_mult (1, 2), decay (1, 1)
+LR_MULT = {"w": 1.0, "b": 2.0}
+DECAY_MULT = {"w": 1.0, "b": 1.0}
+
+
+def forward_backward(params: Dict[str, Dict[str, np.ndarray]],
+                     images_nhwc: np.ndarray, labels: np.ndarray
+                     ) -> Tuple[float, Dict[str, Dict[str, np.ndarray]]]:
+    """One f32 forward+backward of cifar10_quick; returns (loss, grads)."""
+    x = images_nhwc.astype(np.float32)
+    acts: List = []  # (kind, saved-for-backward...)
+    for entry in ARCH:
+        name, kind = entry[0], entry[1]
+        if kind == "conv":
+            pad = entry[2]
+            y, cols = conv_fwd(x, params[name]["w"], params[name]["b"], pad)
+            acts.append((name, kind, cols, x.shape, pad))
+            x = y
+        elif kind == "max":
+            y, arg = maxpool_fwd(x, POOL_K, POOL_S)
+            acts.append((name, kind, arg, x.shape))
+            x = y
+        elif kind == "ave":
+            y, div = avepool_fwd(x, POOL_K, POOL_S)
+            acts.append((name, kind, div, x.shape))
+            x = y
+        elif kind == "relu":
+            mask = x > 0
+            acts.append((name, kind, mask))
+            x = x * mask
+        elif kind == "ip":
+            shp = x.shape
+            # Caffe flattens NCHW-ordered (weight rows line up with an
+            # NCHW walk of the bottom blob)
+            flat = (x.transpose(0, 3, 1, 2).reshape(shp[0], -1)
+                    if x.ndim == 4 else x.reshape(shp[0], -1))
+            y = flat @ params[name]["w"] + params[name]["b"]
+            acts.append((name, kind, flat, shp))
+            x = y
+    loss, d = softmax_loss_fwd_bwd(x, labels)
+
+    grads: Dict[str, Dict[str, np.ndarray]] = {}
+    for entry in reversed(acts):
+        name, kind = entry[0], entry[1]
+        if kind == "ip":
+            _, _, flat, shp = entry
+            grads[name] = {"w": flat.T @ d, "b": d.sum(axis=0)}
+            d = d @ params[name]["w"].T
+            d = (d.reshape(shp[0], shp[3], shp[1], shp[2])
+                 .transpose(0, 2, 3, 1) if len(shp) == 4
+                 else d.reshape(shp))
+        elif kind == "relu":
+            d = d * entry[2]
+        elif kind == "ave":
+            _, _, div, x_shape = entry
+            d = avepool_bwd(d, div, x_shape, POOL_K, POOL_S)
+        elif kind == "max":
+            _, _, arg, x_shape = entry
+            d = maxpool_bwd(d, arg, x_shape, POOL_K, POOL_S)
+        elif kind == "conv":
+            _, _, cols, x_shape, pad = entry
+            d, dw, db = conv_bwd(d, cols, x_shape, params[name]["w"], pad)
+            grads[name] = {"w": dw, "b": db}
+    return loss, grads
+
+
+def sgd_update(params, velocity, grads, lr, momentum, weight_decay):
+    """Caffe SGDSolver::ComputeUpdateValue: V <- m*V + local_lr*(g + wd*W);
+    W <- W - V. In place on params/velocity."""
+    for lname in params:
+        for pname in params[lname]:
+            local_lr = lr * LR_MULT[pname]
+            local_wd = weight_decay * DECAY_MULT[pname]
+            g = grads[lname][pname] + local_wd * params[lname][pname]
+            velocity[lname][pname] = (momentum * velocity[lname][pname]
+                                      + local_lr * g)
+            params[lname][pname] = (params[lname][pname]
+                                    - velocity[lname][pname])
+
+
+def train(params, batches, lr, momentum, weight_decay) -> List[float]:
+    """Run the recipe loop over [(images_nhwc, labels), ...]; mutates
+    params; returns per-iteration losses."""
+    velocity = {l: {p: np.zeros_like(v) for p, v in lp.items()}
+                for l, lp in params.items()}
+    losses = []
+    for images, labels in batches:
+        loss, grads = forward_backward(params, images, labels)
+        sgd_update(params, velocity, grads, lr, momentum, weight_decay)
+        losses.append(loss)
+    return losses

@@ -395,8 +395,11 @@ def test_run_loop_checkpoint_carries_stream_cursor(tmp_path):
           logger=Logger(str(tmp_path / "l1.txt"), echo=False),
           batch_transform=GrayTo28())
     _, _, extra = ckpt.restore_flat(str(tmp_path / "ck"))
+    # one host, one reader: [[ [shard, entry, epochs] ]]
     assert "stream" in extra and len(extra["stream"]) == 1
-    shard, entry, epochs = extra["stream"][0]
+    (host_rows,) = extra["stream"]
+    assert len(host_rows) == 1
+    shard, entry, epochs = host_rows[0]
     assert (shard, entry) != (0, 0)
 
     train(make_cfg(4), spec, make_source(), None,
@@ -504,3 +507,193 @@ def test_load_all_limit_caps_decoding(tmp_path):
         height=32, width=32)
     images, labels = loader.load_all(5)
     assert len(images) == 5 and len(labels) == 5
+
+
+# -- Parallel multi-reader streaming (r4: per-source ceiling killer) ---------
+
+def _parallel_fixture(tmp_path, n_sources, n_shards=4, per_shard=8,
+                      w=2, b=2, tau=2):
+    from sparknet_tpu.data.streaming import make_parallel_source
+    root = str(tmp_path / "shards")
+    label_path = imagenet.write_synthetic_shards(
+        root, n_shards=n_shards, per_shard=per_shard, size=48)
+    return make_parallel_source(
+        imagenet.list_shards(root), imagenet.load_label_map(label_path),
+        w, b, tau, n_sources, height=32, width=32)
+
+
+def test_parallel_source_layout_blocks_by_reader(tmp_path):
+    """Round layout matches StreamingRoundSource ([tau, W*B, ...], batch
+    axis blocked by worker); with N == n_workers each worker's window is
+    exactly one reader's consecutive stream run over shards j::N."""
+    w, b, tau = 2, 2, 2  # round = 8, block = 4 per reader
+    src = _parallel_fixture(tmp_path, n_sources=2, w=w, b=b, tau=tau)
+    per_reader = [ld.__class__(ld.shard_paths, ld.label_map,
+                               height=32, width=32).load_all()
+                  for ld in src.loaders]
+    with src:
+        r = src.next_round(round_index=0)
+    assert r["data"].shape == (tau, w * b, 3, 32, 32)
+    assert r["label"].shape == (tau, w * b, 1)
+    for wk in range(w):  # worker wk's window = reader wk's stream[0:4]
+        block = np.concatenate(
+            [r["data"][t, wk * b:(wk + 1) * b] for t in range(tau)])
+        np.testing.assert_array_equal(block, per_reader[wk][0][:tau * b])
+        lbl = np.concatenate(
+            [r["label"][t, wk * b:(wk + 1) * b, 0] for t in range(tau)])
+        np.testing.assert_array_equal(lbl, per_reader[wk][1][:tau * b])
+
+
+def test_parallel_source_n1_matches_single_source(tmp_path):
+    """make_parallel_source(n=1) reproduces StreamingRoundSource's rounds
+    exactly — the parallel layout is a strict generalization."""
+    from sparknet_tpu.data.streaming import StreamingRoundSource
+    w, b, tau = 2, 2, 2
+    psrc = _parallel_fixture(tmp_path, n_sources=1, w=w, b=b, tau=tau)
+    loader = imagenet.ShardedTarLoader(
+        list(psrc.loaders[0].shard_paths), psrc.loaders[0].label_map,
+        height=32, width=32)
+    with psrc, StreamingRoundSource(loader, w, b, tau) as ssrc:
+        for _ in range(3):
+            pr, sr = psrc.next_round(), ssrc.next_round()
+            np.testing.assert_array_equal(pr["data"], sr["data"])
+            np.testing.assert_array_equal(pr["label"], sr["label"])
+
+
+def test_parallel_source_exactly_once_per_epoch(tmp_path):
+    """Every example is consumed exactly once per reader-epoch: 4 shards x
+    8 images, 2 readers of 16 each, 8-example rounds -> 4 rounds cover the
+    corpus exactly once (labels compared as multisets per reader)."""
+    src = _parallel_fixture(tmp_path, n_sources=2)  # block = 4
+    per_reader = [ld.__class__(ld.shard_paths, ld.label_map,
+                               height=32, width=32).load_all()
+                  for ld in src.loaders]
+    seen = [[] for _ in range(2)]
+    with src:
+        for i in range(4):
+            r = src.next_round(round_index=i)
+            for wk in range(2):
+                seen[wk].extend(np.concatenate(
+                    [r["label"][t, wk * 2:(wk + 1) * 2, 0]
+                     for t in range(2)]).tolist())
+        cursors = src.cursor_at(3)
+    for j in range(2):
+        assert sorted(seen[j]) == sorted(per_reader[j][1].tolist())
+    # end-of-pass cursor: position at the subset's last entry, epoch count
+    # still 0 until the wrap is observed (same semantics as the single
+    # source's cursor_at)
+    assert all(ep == 0 for (_, _), ep in cursors)
+
+
+def test_parallel_source_resume_continues_stream(tmp_path):
+    """The elastic-stream property with N readers: a fresh source
+    seek_rows'd to the cursors recorded after round R reproduces the
+    uninterrupted rounds R+1.. exactly — per-reader cursors, no re-stream,
+    no replay."""
+    src = _parallel_fixture(tmp_path, n_sources=2)
+    with src:
+        uninterrupted = [src.next_round(round_index=i) for i in range(5)]
+        cur = src.cursor_at(1)
+    assert cur is not None and len(cur) == 2
+    rows = [[s, e, ep] for (s, e), ep in cur]
+
+    resumed = _parallel_fixture(tmp_path, n_sources=2)
+    assert resumed.seek_rows(rows)
+    with resumed:
+        for want in uninterrupted[2:]:
+            got = resumed.next_round()
+            np.testing.assert_array_equal(got["data"], want["data"])
+            np.testing.assert_array_equal(got["label"], want["label"])
+
+
+def test_parallel_source_reader_count_change_refuses_cursors(tmp_path):
+    """A checkpoint from a different reader count reassigned the shards:
+    seek_rows must refuse (False) so the caller restarts cleanly."""
+    src = _parallel_fixture(tmp_path, n_sources=2)
+    assert not src.seek_rows([[0, 0, 0]])          # 1 row into 2 readers
+    assert not src.seek_rows([[0, 0, 0]] * 3)      # 3 rows into 2 readers
+    assert src.seek_rows([[0, 0, 0], [0, 0, 0]])   # matching count is fine
+    src.close()
+
+
+def test_parallel_source_invalid_construction(tmp_path):
+    """More sources than shards clamps (make_parallel_source); a round not
+    divisible by N fails loudly; an empty reader fails loudly."""
+    from sparknet_tpu.data.streaming import ParallelStreamingSource
+    src = _parallel_fixture(tmp_path, n_sources=99, n_shards=4)
+    assert src.n_sources == 4
+    src.close()
+    loaders = _parallel_fixture(tmp_path, n_sources=2).loaders
+    with pytest.raises(ValueError, match="not divisible"):
+        ParallelStreamingSource(loaders + [loaders[0]], 2, 2, 2)  # 8 % 3
+    empty = imagenet.ShardedTarLoader([], loaders[0].label_map)
+    with pytest.raises(ValueError, match="no shards"):
+        ParallelStreamingSource([loaders[0], empty], 2, 2, 2)
+
+
+def test_parallel_source_error_propagates(tmp_path):
+    """One reader failing must fail the consumer, not hang the round
+    barrier."""
+    src = _parallel_fixture(tmp_path, n_sources=2)
+    src.loaders[1].shard_paths = [str(tmp_path / "missing.tar")]
+    with pytest.raises(RuntimeError, match="streaming decode thread"):
+        for i in range(8):  # reader 0 alone can never complete a round
+            src.next_round(round_index=i)
+    src.close()
+
+
+def test_run_loop_checkpoint_carries_parallel_cursors(tmp_path):
+    """End to end through run_loop with 2 readers: the checkpoint carries
+    one cursor row PER READER, and the resumed run seeks all of them; a
+    resume with a different reader count restarts at shard 0 (logged)."""
+    from sparknet_tpu.apps.train_loop import train
+    from sparknet_tpu.data.streaming import make_parallel_source
+    from sparknet_tpu.utils import checkpoint as ckpt
+    from sparknet_tpu.utils.config import RunConfig
+    from sparknet_tpu.utils.logger import Logger
+    from sparknet_tpu.zoo import lenet
+    import jax
+
+    root = str(tmp_path / "shards")
+    label_path = imagenet.write_synthetic_shards(
+        root, n_shards=4, per_shard=16, size=28, n_classes=10)
+    n_local = jax.local_device_count()
+
+    def make_source(n):
+        return make_parallel_source(
+            imagenet.list_shards(root), imagenet.load_label_map(label_path),
+            n_local, 2, 2, n, height=28, width=28)
+
+    def make_cfg(rounds):
+        return RunConfig(model="lenet", tau=2, local_batch=2,
+                         max_rounds=rounds, workdir=str(tmp_path), seed=0,
+                         eval_every=0, checkpoint_dir=str(tmp_path / "ck"),
+                         checkpoint_every=2)
+
+    class GrayTo28:
+        def convert_batch(self, batch, train=True, rng=None):
+            x = batch["data"].astype(np.float32).mean(axis=1)  # CHW->HW
+            return {"data": x[..., None], "label": batch["label"]}
+
+    spec = lenet(batch=2)
+    train(make_cfg(2), spec, make_source(2), None,
+          logger=Logger(str(tmp_path / "l1.txt"), echo=False),
+          batch_transform=GrayTo28())
+    _, _, extra = ckpt.restore_flat(str(tmp_path / "ck"))
+    (host_rows,) = extra["stream"]
+    assert len(host_rows) == 2  # one cursor row per reader
+
+    train(make_cfg(4), spec, make_source(2), None,
+          logger=Logger(str(tmp_path / "l2.txt"), echo=False),
+          batch_transform=GrayTo28())
+    text = open(str(tmp_path / "l2.txt")).read()
+    assert "stream resumed at" in text
+    for s, e, ep in host_rows:
+        assert f"shard {s} entry {e}" in text
+
+    # reader-count change: cursors refused, stream restarts at zero
+    train(make_cfg(6), spec, make_source(4), None,
+          logger=Logger(str(tmp_path / "l3.txt"), echo=False),
+          batch_transform=GrayTo28())
+    text = open(str(tmp_path / "l3.txt")).read()
+    assert "restarting" in text and "stream resumed at" not in text

@@ -1,0 +1,236 @@
+"""Native gs:// ingest against a local fake-GCS server (r4: the r3 build
+delegated cloud storage to a FUSE mount; now the loader streams the bucket
+itself — listing, label fetch, ranged tar streams with reconnect-resume —
+the reference's per-task S3 GetObject path, `ImageNetLoader.scala:62-63`)."""
+import http.server
+import json
+import os
+import threading
+import urllib.parse
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data import imagenet
+
+
+class _FakeGcs(http.server.BaseHTTPRequestHandler):
+    """JSON-API subset: paginated listing, alt=media with Range, ?fields=size.
+    Knobs (class attrs set by the fixture):
+      fail_once    — object names whose next media GET truncates mid-body
+                     (Content-Length lies), exercising reconnect-resume
+      ignore_range — serve 200-from-zero despite a Range header (a broken
+                     middlebox); the client must fail loudly, not corrupt
+    """
+    objects = {}
+    fail_once = set()
+    ignore_range = False
+    page_size = 2
+    range_log = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        parsed = urllib.parse.urlparse(self.path)
+        qs = urllib.parse.parse_qs(parsed.query)
+        parts = parsed.path.split("/")
+        # /storage/v1/b/<bucket>/o[/<name>]
+        if len(parts) < 6 or parts[1:4] != ["storage", "v1", "b"] or \
+                parts[5] != "o":
+            self.send_error(404)
+            return
+        if len(parts) == 6:  # listing
+            prefix = qs.get("prefix", [""])[0]
+            names = sorted(n for n in self.objects if n.startswith(prefix))
+            start = int(qs.get("pageToken", ["0"])[0])
+            page = names[start:start + self.page_size]
+            d = {"items": [{"name": n, "size": str(len(self.objects[n]))}
+                           for n in page]}
+            if start + self.page_size < len(names):
+                d["nextPageToken"] = str(start + self.page_size)
+            self._json(d)
+            return
+        name = urllib.parse.unquote(parts[6])
+        if name not in self.objects:
+            self.send_error(404)
+            return
+        data = self.objects[name]
+        if qs.get("alt") == ["media"]:
+            start = 0
+            rng = self.headers.get("Range")
+            if rng:
+                type(self).range_log.append((name, rng))
+            if rng and not self.ignore_range:
+                start = int(rng.split("=")[1].split("-")[0])
+                self.send_response(206)
+            else:
+                self.send_response(200)
+            body = data[start:]
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if name in self.fail_once:  # truncate: client must resume
+                self.fail_once.discard(name)
+                self.wfile.write(body[: max(1, len(body) // 2)])
+                self.wfile.flush()
+                self.connection.close()
+                return
+            self.wfile.write(body)
+            return
+        self._json({"size": str(len(data))})  # metadata
+
+    def _json(self, d):
+        body = json.dumps(d).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture
+def gcs(tmp_path, monkeypatch):
+    """Fake bucket 'bkt' holding synthetic shards under imagenet/, with the
+    client pointed at it via STORAGE_EMULATOR_HOST."""
+    root = str(tmp_path / "local")
+    imagenet.write_synthetic_shards(root, n_shards=3, per_shard=6, size=48)
+    objects = {}
+    for f in sorted(os.listdir(root)):
+        with open(os.path.join(root, f), "rb") as fh:
+            objects[f"imagenet/{f}"] = fh.read()
+    _FakeGcs.objects = objects
+    _FakeGcs.fail_once = set()
+    _FakeGcs.ignore_range = False
+    _FakeGcs.range_log = []
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _FakeGcs)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    monkeypatch.setenv("STORAGE_EMULATOR_HOST",
+                       f"http://127.0.0.1:{srv.server_address[1]}")
+    monkeypatch.setenv("no_proxy", "*")
+    # retries back off 0.5*2^n seconds; keep the flaky-path test fast
+    from sparknet_tpu.data import gcs as gcs_mod
+    monkeypatch.setattr(gcs_mod, "BACKOFF_S", 0.01)
+    gcs_mod._SIZE_CACHE.clear()
+    yield "gs://bkt/imagenet", root
+    srv.shutdown()
+
+
+def test_list_and_labels_match_local(gcs):
+    url, root = gcs
+    remote = imagenet.list_shards(url, prefix="train.")
+    local = imagenet.list_shards(root, prefix="train.")
+    assert [os.path.basename(p) for p in remote] == \
+        [os.path.basename(p) for p in local]
+    assert len(remote) == 3  # > page_size: pagination exercised
+    assert imagenet.load_label_map(f"{url}/train.txt") == \
+        imagenet.load_label_map(os.path.join(root, "train.txt"))
+
+
+def test_gs_loader_bit_identical_to_local(gcs):
+    url, root = gcs
+    labels = imagenet.load_label_map(os.path.join(root, "train.txt"))
+    g = imagenet.ShardedTarLoader(imagenet.list_shards(url), labels,
+                                  height=32, width=32)
+    l = imagenet.ShardedTarLoader(imagenet.list_shards(root), labels,
+                                  height=32, width=32)
+    gi, gl = g.load_all()
+    li, ll = l.load_all()
+    np.testing.assert_array_equal(gi, li)
+    np.testing.assert_array_equal(gl, ll)
+    assert g.skipped == 0
+
+
+def test_gs_mid_shard_seek(gcs):
+    """iter_with_pos from a mid-shard cursor continues exactly like the
+    local loader — the streaming-resume path over the bucket."""
+    url, root = gcs
+    labels = imagenet.load_label_map(os.path.join(root, "train.txt"))
+
+    def fresh(src):
+        return imagenet.ShardedTarLoader(imagenet.list_shards(src), labels,
+                                         height=32, width=32)
+
+    all_pos = [(lbl, pos) for _, lbl, pos in fresh(root).iter_with_pos()]
+    mid = all_pos[7][1]
+    assert mid[0] > 0  # genuinely mid-stream, second shard
+    cont = [(lbl, pos) for _, lbl, pos in fresh(url).iter_with_pos(mid)]
+    assert cont == all_pos[8:]
+
+
+def test_gs_stream_resumes_after_disconnect(gcs):
+    """A connection dropped mid-tar (Content-Length lies, body truncated)
+    must reconnect with a nonzero Range offset and produce IDENTICAL data —
+    the multi-hour-epoch survival property FUSE could not give."""
+    url, root = gcs
+    labels = imagenet.load_label_map(os.path.join(root, "train.txt"))
+    shard = sorted(_FakeGcs.objects)[0]
+    _FakeGcs.fail_once = {shard}
+    g = imagenet.ShardedTarLoader(imagenet.list_shards(url), labels,
+                                  height=32, width=32)
+    l = imagenet.ShardedTarLoader(imagenet.list_shards(root), labels,
+                                  height=32, width=32)
+    gi, gl = g.load_all()
+    li, ll = l.load_all()
+    np.testing.assert_array_equal(gi, li)
+    np.testing.assert_array_equal(gl, ll)
+    resumes = [(n, r) for n, r in _FakeGcs.range_log
+               if n == shard and not r.endswith("=0-")]
+    assert resumes, f"no resumed Range request seen: {_FakeGcs.range_log}"
+
+
+def test_gs_range_ignored_fails_loudly(gcs):
+    """A server that ignores Range re-serves from byte 0; silently
+    accepting that would corrupt the tar mid-resume."""
+    url, _ = gcs
+    from sparknet_tpu.data.gcs import gs_open_stream
+    s = gs_open_stream(f"{url}/train.0000.tar", start=0)
+    head = s.read(100)
+    assert len(head) == 100
+    s.close()
+    _FakeGcs.ignore_range = True
+    s = gs_open_stream(f"{url}/train.0000.tar", start=50)
+    with pytest.raises(IOError, match="ignored Range"):
+        s.read(10)
+
+
+def test_gs_path_size_uses_listing_cache(gcs):
+    url, root = gcs
+    shards = imagenet.list_shards(url)
+    local = imagenet.list_shards(root)
+    for g, l in zip(shards, local):
+        assert imagenet.path_size(g) == os.path.getsize(l)
+    # cold-cache path: direct metadata GET
+    from sparknet_tpu.data import gcs as gcs_mod
+    gcs_mod._SIZE_CACHE.clear()
+    assert imagenet.path_size(shards[0]) == os.path.getsize(local[0])
+
+
+def test_gs_streaming_source_end_to_end(gcs):
+    """StreamingRoundSource over gs:// shards: rounds equal the local
+    stream's bit for bit (the full ingest path — ranged tar streams,
+    decode, round assembly — against the bucket)."""
+    from sparknet_tpu.data.streaming import StreamingRoundSource
+    url, root = gcs
+    labels = imagenet.load_label_map(f"{url}/train.txt")
+
+    def source(src_root):
+        loader = imagenet.ShardedTarLoader(
+            imagenet.list_shards(src_root), labels, height=32, width=32)
+        return StreamingRoundSource(loader, 2, 2, 2)
+
+    with source(url) as g, source(root) as l:
+        for i in range(3):
+            gr, lr = g.next_round(round_index=i), l.next_round(round_index=i)
+            np.testing.assert_array_equal(gr["data"], lr["data"])
+            np.testing.assert_array_equal(gr["label"], lr["label"])
+        assert g.cursor_at(2) == l.cursor_at(2)
+
+
+def test_parse_gs_url_rejects_malformed():
+    from sparknet_tpu.data.gcs import parse_gs_url
+    assert parse_gs_url("gs://b/a/c.tar") == ("b", "a/c.tar")
+    with pytest.raises(ValueError, match="not a gs"):
+        parse_gs_url("/local/path")
+    with pytest.raises(ValueError, match="missing bucket"):
+        parse_gs_url("gs://")
