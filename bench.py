@@ -123,23 +123,26 @@ def _time_rounds(trainer, state, batches, trials: int,
     return dt / trials
 
 
-def headline(profile_dir: str | None = None) -> None:
+def headline(profile_dir: str | None = None, batch: int = BATCH,
+             tau: int = TAU) -> None:
     from sparknet_tpu import precision
     from sparknet_tpu.utils import flops
     import jax
 
     precision.set_policy("bfloat16")  # MXU fast path; f32 accumulation
-    net, trainer, state = _build(BATCH, TAU)
-    batches = _device_batches(trainer, BATCH, TAU, 227, 1000)
+    net, trainer, state = _build(batch, tau)
+    batches = _device_batches(trainer, batch, tau, 227, 1000)
     best = _time_rounds(trainer, state, batches, TRIALS,
                         profile_dir=profile_dir)
 
-    img_per_sec = BATCH * TAU / best
+    img_per_sec = batch * tau / best
     out = {
         "metric": "caffenet_train_images_per_sec_per_chip",
         "value": round(img_per_sec, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_per_sec / REFERENCE_IMG_PER_SEC, 3),
+        "batch": batch,
+        "tau": tau,
     }
     peak = flops.peak_bf16_flops(jax.devices()[0].device_kind)
     if peak:
@@ -428,6 +431,11 @@ def main() -> None:
                    help="full streaming loop on the real chip, small shapes")
     p.add_argument("--profile", metavar="DIR", default=None,
                    help="capture a jax.profiler trace of the timed section")
+    p.add_argument("--batch", type=int, default=BATCH,
+                   help="headline per-chip batch (A/B experiments)")
+    p.add_argument("--tau", type=int, default=TAU,
+                   help="headline local steps per round (the reference "
+                   "ImageNet recipe is tau=5)")
     args = p.parse_args()
     if args.scaling:
         scaling()
@@ -436,7 +444,7 @@ def main() -> None:
     elif args.e2e_smoke:
         e2e_smoke()
     else:
-        headline(profile_dir=args.profile)
+        headline(profile_dir=args.profile, batch=args.batch, tau=args.tau)
 
 
 if __name__ == "__main__":

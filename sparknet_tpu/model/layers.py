@@ -123,6 +123,12 @@ def init_convolution(key, layer: LayerSpec, in_shapes) -> Params:
     return params
 
 
+#: grouped-conv lowering: "native" (feature_group_count — the measured
+#: default) or "split" (explicit per-group convs + concat) — an A/B lever
+#: for the 64%-of-MXU-peak grouped convs (PERF.md r4 experiment)
+CONV_GROUP_IMPL = "native"
+
+
 def _s2d_eligible(p, cin: int) -> bool:
     """Space-to-depth rewrite gate: strided, ungrouped, unpadded convs with
     few input channels — i.e. an image-stem conv like CaffeNet's conv1
@@ -178,6 +184,20 @@ def apply_convolution(layer: LayerSpec, params: Params, inputs, ctx: ApplyCtx):
             precision=precision.matmul_precision(),
             preferred_element_type=precision.preferred_out(),
         )[:, :oh, :ow]
+    elif p.group > 1 and CONV_GROUP_IMPL == "split":
+        # A/B lever (PERF.md r4): grouped convs as EXPLICIT per-group convs
+        # + concat, versus XLA's native feature_group_count lowering. Same
+        # math (disjoint channel blocks), different schedule.
+        xs = jnp.split(x, p.group, axis=-1)
+        ws = jnp.split(w, p.group, axis=-1)
+        y = jnp.concatenate([
+            lax.conv_general_dilated(
+                xg, wg, window_strides=(p.stride, p.stride),
+                padding=((p.pad, p.pad), (p.pad, p.pad)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                precision=precision.matmul_precision(),
+                preferred_element_type=precision.preferred_out())
+            for xg, wg in zip(xs, ws)], axis=-1)
     else:
         y = lax.conv_general_dilated(
             x, w,

@@ -113,3 +113,44 @@ def test_maxpool_tie_gradient_goes_to_first_max():
     g = jax.grad(lambda v: pool2d(v, "MAX", 2, 2, 0).sum())(jnp.asarray(x))
     np.testing.assert_array_equal(
         np.asarray(g)[0, :, :, 0], [[1.0, 0.0], [0.0, 0.0]])
+
+
+def test_grouped_conv_split_impl_matches_native(rng):
+    """The CONV_GROUP_IMPL='split' A/B lever (PERF.md r4) is the same math
+    as XLA's native feature_group_count: outputs and gradients must agree."""
+    import jax
+    import sparknet_tpu.model.layers as L
+    from sparknet_tpu.model.spec import (ConvolutionParam, Filler,
+                                         InputSpec, LayerSpec, NetSpec)
+    from sparknet_tpu import CompiledNet
+
+    spec = NetSpec(
+        name="g", inputs=(InputSpec("data", (2, 6, 8, 8)),),
+        layers=(LayerSpec(
+            name="conv", type="Convolution", bottoms=("data",),
+            tops=("conv",),
+            conv=ConvolutionParam(
+                num_output=8, kernel_size=3, pad=1, group=2,
+                weight_filler=Filler(type="gaussian", std=0.1))),))
+    net = CompiledNet.compile(spec)
+    params = net.init_params(jax.random.PRNGKey(0))
+    batch = {"data": rng.standard_normal((2, 8, 8, 6)).astype(np.float32)}
+
+    def out_sum(p):
+        return jnp.sum(net.apply(p, batch, train=False)["conv"] ** 2)
+
+    try:
+        y_nat = net.apply(params, batch, train=False)["conv"]
+        g_nat = jax.grad(out_sum)(params)
+        L.CONV_GROUP_IMPL = "split"
+        y_spl = net.apply(params, batch, train=False)["conv"]
+        g_spl = jax.grad(out_sum)(params)
+    finally:
+        L.CONV_GROUP_IMPL = "native"
+    np.testing.assert_allclose(np.asarray(y_spl), np.asarray(y_nat),
+                               rtol=1e-5, atol=1e-6)
+    for pname in g_nat["conv"]:
+        np.testing.assert_allclose(
+            np.asarray(g_spl["conv"][pname]),
+            np.asarray(g_nat["conv"][pname]), rtol=1e-5, atol=1e-6,
+            err_msg=pname)
