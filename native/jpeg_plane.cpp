@@ -205,3 +205,69 @@ void jp_crop_mean_nhwc_bf16(const uint8_t* images_chw, int n, int c, int h,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Tar member indexer — removes the Python tarfile walk (GIL-held, ~0.05
+// ms/image) from the streaming ingest hot loop. Parses plain POSIX/ustar
+// archives: 512-byte headers, octal sizes, data padded to 512. Returns the
+// member count, writing per-member data offset, size, an is-regular-file
+// flag, and the BASENAME (what the label map keys on, reference
+// ImageNetLoader.scala:71) truncated to name_cap-1.
+// Bails with -1 on GNU/pax extension headers (L/K/x/g) — their presence
+// would desynchronize member numbering from Python's tarfile, which hides
+// them; callers fall back to tarfile. Bails -2 on IO error, -3 if max_n
+// is too small.
+#include <cstdio>
+
+extern "C" long jp_tar_index(const char* path, long max_n, long* offsets,
+                             long* sizes, unsigned char* isfile, char* names,
+                             long name_cap) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -2;
+  long n = 0;
+  unsigned char hdr[512];
+  long pos = 0;
+  while (fread(hdr, 1, 512, f) == 512) {
+    pos += 512;
+    // end-of-archive: a zero block
+    bool all_zero = true;
+    for (int i = 0; i < 512 && all_zero; ++i) all_zero = hdr[i] == 0;
+    if (all_zero) break;
+    char type = char(hdr[156]);
+    if (type == 'L' || type == 'K' || type == 'x' || type == 'g') {
+      fclose(f);
+      return -1;  // extension headers: numbering would diverge
+    }
+    // size: octal at 124 (12 bytes); base-256 (high bit) unsupported
+    if (hdr[124] & 0x80) { fclose(f); return -1; }
+    long size = 0;
+    for (int i = 124; i < 136; ++i) {
+      unsigned char c = hdr[i];
+      if (c == 0 || c == ' ') continue;
+      if (c < '0' || c > '7') { fclose(f); return -2; }
+      size = size * 8 + (c - '0');
+    }
+    if (n >= max_n) { fclose(f); return -3; }
+    offsets[n] = pos;
+    sizes[n] = size;
+    // regular file: '0' or NUL typeflag
+    isfile[n] = (type == '0' || type == 0) ? 1 : 0;
+    // basename of name[0:100] (ustar prefix only affects directories we
+    // don't emit; basename is unchanged by it)
+    char full[101];
+    for (int i = 0; i < 100; ++i) full[i] = char(hdr[i]);
+    full[100] = 0;
+    const char* base = full;
+    for (const char* p = full; *p; ++p)
+      if (*p == '/') base = p + 1;
+    long j = 0;
+    for (; base[j] && j < name_cap - 1; ++j) names[n * name_cap + j] = base[j];
+    names[n * name_cap + j] = 0;
+    ++n;
+    long padded = (size + 511) & ~511L;
+    if (fseek(f, padded, SEEK_CUR) != 0) { fclose(f); return -2; }
+    pos += padded;
+  }
+  fclose(f);
+  return n;
+}

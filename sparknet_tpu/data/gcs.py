@@ -61,6 +61,28 @@ def is_gs_path(path: str) -> bool:
     return isinstance(path, str) and path.startswith("gs://")
 
 
+def http_get_with_retry(url: str, headers: Optional[dict] = None,
+                        timeout: float = 60.0):
+    """GET with retry on 429/5xx and connection errors; returns the open
+    response (caller reads/closes). 4xx other than 429 propagates
+    immediately — retrying a 403/404 only hides it. Shared by the GCS and
+    S3 clients (auth differs per caller; the transport does not)."""
+    last: Optional[BaseException] = None
+    for attempt in range(RETRIES):
+        req = urllib.request.Request(url, headers=headers or {})
+        try:
+            return urllib.request.urlopen(req, timeout=timeout)
+        except urllib.error.HTTPError as e:
+            if e.code not in (429, 500, 502, 503, 504):
+                raise
+            last = e
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            last = e
+        time.sleep(BACKOFF_S * 2 ** attempt)
+    raise ConnectionError(f"GET {url} failed after {RETRIES} attempts"
+                          ) from last
+
+
 class GcsClient:
     """Minimal GCS JSON-API client over urllib (stdlib only)."""
 
@@ -119,24 +141,9 @@ class GcsClient:
     # -- requests with retry -------------------------------------------------
 
     def _open(self, url: str, headers: Optional[dict] = None):
-        """GET with auth + retry on 429/5xx and connection errors. Returns
-        the open response (caller reads/closes). 4xx other than 429
-        propagates immediately — retrying a 403/404 only hides it."""
-        last: Optional[BaseException] = None
-        for attempt in range(RETRIES):
-            req = urllib.request.Request(
-                url, headers={**self._auth_header(), **(headers or {})})
-            try:
-                return urllib.request.urlopen(req, timeout=self.timeout)
-            except urllib.error.HTTPError as e:
-                if e.code not in (429, 500, 502, 503, 504):
-                    raise
-                last = e
-            except (urllib.error.URLError, ConnectionError, OSError) as e:
-                last = e
-            time.sleep(BACKOFF_S * 2 ** attempt)
-        raise ConnectionError(f"gcs: GET {url} failed after {RETRIES} "
-                              f"attempts") from last
+        """GET with auth + the shared retry loop."""
+        return http_get_with_retry(
+            url, {**self._auth_header(), **(headers or {})}, self.timeout)
 
     # -- API -----------------------------------------------------------------
 

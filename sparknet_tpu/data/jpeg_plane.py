@@ -67,6 +67,14 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int, ctypes.POINTER(ctypes.c_uint16)]
     except AttributeError:
         lib.jp_crop_mean_nhwc_bf16 = None  # pre-bf16 .so build
+    try:
+        lib.jp_tar_index.restype = ctypes.c_long
+        lib.jp_tar_index.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_char_p, ctypes.c_long]
+    except AttributeError:
+        lib.jp_tar_index = None  # pre-index .so build
     _lib = lib
     return _lib
 
@@ -155,3 +163,46 @@ def crop_mean_nhwc(images_chw_u8: np.ndarray,
     lib.jp_crop_mean_nhwc(
         *args, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
     return out
+
+
+def supports_tar_index() -> bool:
+    lib = _load()
+    return lib is not None and \
+        getattr(lib, "jp_tar_index", None) is not None
+
+
+def tar_index(path: str, name_cap: int = 128):
+    """Parse a local tar's member table in C (no GIL-held Python walk):
+    returns (data_offsets int64[n], sizes int64[n], isfile bool[n],
+    basenames list[str]) with member numbering identical to Python
+    tarfile iteration, or None when the archive uses extension headers
+    (GNU long names / pax) — callers fall back to tarfile."""
+    lib = _load()
+    assert lib is not None, "native plane unavailable"
+    if getattr(lib, "jp_tar_index", None) is None:
+        return None  # pre-index .so build
+    max_n = max(64, os.path.getsize(path) // 512 // 2 + 2)
+    offsets = np.zeros(max_n, dtype=np.int64)
+    sizes = np.zeros(max_n, dtype=np.int64)
+    isfile = np.zeros(max_n, dtype=np.uint8)
+    names = np.zeros(max_n * name_cap, dtype=np.uint8)
+    n = lib.jp_tar_index(
+        path.encode(), max_n,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        isfile.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        names.ctypes.data_as(ctypes.c_char_p), name_cap)
+    if n == -1:
+        return None  # extension headers: numbering would diverge
+    if n < 0:
+        raise OSError(f"tar index of {path!r} failed (rc={n})")
+    if n and int(offsets[n - 1] + sizes[n - 1]) > os.path.getsize(path):
+        # truncated archive: fseek past EOF "succeeds", so the C walk can
+        # index members whose data is missing. The tarfile path raises
+        # loudly on such shards; the fast path must not silently drop data
+        raise OSError(f"tar {path!r} is truncated (last member extends "
+                      f"past EOF)")
+    name_list = [bytes(names[i * name_cap:(i + 1) * name_cap]
+                       ).split(b"\0", 1)[0].decode("utf-8", "replace")
+                 for i in range(n)]
+    return offsets[:n], sizes[:n], isfile[:n].astype(bool), name_list

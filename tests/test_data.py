@@ -697,3 +697,82 @@ def test_run_loop_checkpoint_carries_parallel_cursors(tmp_path):
           batch_transform=GrayTo28())
     text = open(str(tmp_path / "l3.txt")).read()
     assert "restarting" in text and "stream resumed at" not in text
+
+
+# -- C tar member index (r4: GIL-free local shard walk) ----------------------
+
+def test_tar_index_matches_tarfile_path_exactly(tmp_path):
+    """The C member index must reproduce the tarfile path bit for bit:
+    same bytes, same labels, same cursor numbering (resume depends on it),
+    including unlabeled-entry skips and mid-shard seeks."""
+    from sparknet_tpu.data import jpeg_plane
+    if not jpeg_plane.supports_tar_index():
+        pytest.skip("native plane unavailable")
+    loader_idx = _stream_fixture(tmp_path, n_shards=2, per_shard=8)
+    loader_tar = _stream_fixture(tmp_path, n_shards=2, per_shard=8)
+    # drop one label so the unlabeled-skip path is exercised
+    victim = sorted(loader_idx.label_map)[3]
+    del loader_idx.label_map[victim]
+    del loader_tar.label_map[victim]
+    for p in loader_tar.shard_paths:
+        loader_tar._tar_indices[p] = None  # force the tarfile path
+    a = [(img.tobytes(), lbl, pos)
+         for img, lbl, pos in loader_idx.iter_with_pos()]
+    b = [(img.tobytes(), lbl, pos)
+         for img, lbl, pos in loader_tar.iter_with_pos()]
+    assert a == b and len(a) == 15
+    assert loader_idx.skipped == loader_tar.skipped == 1
+    mid = a[5][2]
+    c = [(img.tobytes(), lbl, pos) for img, lbl, pos
+         in _stream_fixture(tmp_path, n_shards=2,
+                            per_shard=8).iter_with_pos(mid)]
+    # fixture labels differ (fresh loader keeps victim's label): compare
+    # positions only for the seek check
+    assert [x[2] for x in c][:5] == [x[2] for x in a[6:11]]
+
+
+def test_tar_index_extension_headers_fall_back(tmp_path):
+    """A GNU long-name member desynchronizes C-vs-tarfile numbering, so
+    the indexer must refuse (None) and the loader silently use tarfile."""
+    import io as _io
+    import tarfile as _tarfile
+    from PIL import Image
+    from sparknet_tpu.data import jpeg_plane
+    if not jpeg_plane.supports_tar_index():
+        pytest.skip("native plane unavailable")
+    root = tmp_path / "ln"
+    root.mkdir()
+    long_name = "x" * 120 + ".JPEG"  # > 100 chars: GNU 'L' header
+    tar_path = str(root / "train.0000.tar")
+    buf = _io.BytesIO()
+    Image.fromarray(np.zeros((32, 32, 3), np.uint8)).save(buf, format="JPEG")
+    data = buf.getvalue()
+    with _tarfile.open(tar_path, "w", format=_tarfile.GNU_FORMAT) as tar:
+        info = _tarfile.TarInfo(name=long_name)
+        info.size = len(data)
+        tar.addfile(info, _io.BytesIO(data))
+    assert jpeg_plane.tar_index(tar_path) is None
+    loader = imagenet.ShardedTarLoader(
+        [tar_path], {long_name: 3}, height=32, width=32)
+    images, labels = loader.load_all()
+    assert len(images) == 1 and labels[0] == 3
+
+
+def test_truncated_shard_fails_loudly(tmp_path):
+    """A shard truncated mid-member (interrupted copy) must raise, not
+    silently drop the tail: the C index refuses (last member extends past
+    EOF) and the tarfile fallback then reports the corruption."""
+    from sparknet_tpu.data import jpeg_plane
+    if not jpeg_plane.supports_tar_index():
+        pytest.skip("native plane unavailable")
+    loader = _stream_fixture(tmp_path, n_shards=1, per_shard=8)
+    path = loader.shard_paths[0]
+    offsets, sizes, _, _ = jpeg_plane.tar_index(path)
+    with open(path, "r+b") as f:
+        # cut INTO the last member's data (tar pads archives with ~10KB of
+        # trailing zero blocks, so an end-relative truncate misses)
+        f.truncate(int(offsets[-1] + sizes[-1] // 2))
+    with pytest.raises(OSError, match="truncated"):
+        jpeg_plane.tar_index(path)
+    with pytest.raises(Exception):  # surfaced, not swallowed
+        loader.load_all()

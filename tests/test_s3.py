@@ -1,0 +1,221 @@
+"""Native s3:// ingest against a local fake-S3 server — full parity with
+the reference's actual data plane (it streamed ImageNet from S3 per task,
+`loaders/ImageNetLoader.scala:62-63`). The fake server VERIFIES the AWS
+Signature Version 4 on every request (recomputing it server-side from the
+shared secret), so the stdlib SigV4 implementation is tested end to end,
+not just exercised."""
+import datetime
+import hashlib
+import hmac
+import http.server
+import os
+import threading
+import urllib.parse
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data import imagenet
+
+ACCESS, SECRET = "AKTEST", "testsecret"
+
+
+def _expected_sig(method, path, query, headers_lower, signed, region):
+    """Server-side SigV4 recomputation (mirrors the spec, written against
+    the AWS docs independently of the client). `headers_lower` is the
+    received header map lowercased; `signed` the SignedHeaders list."""
+    amz_date = headers_lower["x-amz-date"]
+    datestamp = amz_date[:8]
+    canon_headers = "".join(
+        f"{k}:{headers_lower[k].strip()}\n" for k in signed.split(";"))
+    canonical = "\n".join([
+        method, urllib.parse.quote(path, safe="/-_.~"), query,
+        canon_headers, signed,
+        hashlib.sha256(b"").hexdigest()])
+    scope = f"{datestamp}/{region}/s3/aws4_request"
+    sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                     hashlib.sha256(canonical.encode()).hexdigest()])
+
+    def h(key, msg):
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+    key = h(h(h(h(("AWS4" + SECRET).encode(), datestamp),
+              region), "s3"), "aws4_request")
+    return hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+
+
+class _FakeS3(http.server.BaseHTTPRequestHandler):
+    objects = {}       # "bucket/key" -> bytes
+    fail_once = set()
+    region = "us-east-1"
+    verify_auth = True
+    page_size = 2
+
+    def log_message(self, *a):
+        pass
+
+    def _check_sig(self, path, query):
+        if not self.verify_auth:
+            return True
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith("AWS4-HMAC-SHA256"):
+            self.send_error(403, "missing SigV4")
+            return False
+        hdrs = {k.lower(): v for k, v in self.headers.items()}
+        signed = auth.split("SignedHeaders=")[1].split(",")[0].strip()
+        want = auth.split("Signature=")[1].strip()
+        got = _expected_sig("GET", path, query, hdrs, signed, self.region)
+        if want != got:
+            self.send_error(403, "bad signature")
+            return False
+        return True
+
+    def do_GET(self):
+        parsed = urllib.parse.urlparse(self.path)
+        qs = urllib.parse.parse_qs(parsed.query)
+        if not self._check_sig(parsed.path, parsed.query):
+            return
+        parts = parsed.path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        if not key:  # ListObjectsV2
+            prefix = qs.get("prefix", [""])[0]
+            names = sorted(k.split("/", 1)[1] for k in self.objects
+                           if k.startswith(bucket + "/"))
+            names = [n for n in names if n.startswith(prefix)]
+            start = int(qs.get("continuation-token", ["0"])[0])
+            page = names[start:start + self.page_size]
+            trunc = start + self.page_size < len(names)
+            items = "".join(
+                f"<Contents><Key>{n}</Key><Size>"
+                f"{len(self.objects[f'{bucket}/{n}'])}</Size></Contents>"
+                for n in page)
+            nxt = (f"<NextContinuationToken>{start + self.page_size}"
+                   f"</NextContinuationToken>" if trunc else "")
+            body = (f'<?xml version="1.0"?><ListBucketResult>'
+                    f"<IsTruncated>{'true' if trunc else 'false'}"
+                    f"</IsTruncated>{items}{nxt}</ListBucketResult>"
+                    ).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        obj = self.objects.get(f"{bucket}/{key}")
+        if obj is None:
+            self.send_error(404)
+            return
+        start = 0
+        rng = self.headers.get("Range")
+        if rng:
+            lo, _, hi = rng.split("=")[1].partition("-")
+            start = int(lo)
+            self.send_response(206)
+            end = int(hi) if hi else len(obj) - 1
+            body = obj[start:end + 1]
+            self.send_header("Content-Range",
+                             f"bytes {start}-{end}/{len(obj)}")
+        else:
+            self.send_response(200)
+            body = obj
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if key in self.fail_once:
+            self.fail_once.discard(key)
+            self.wfile.write(body[: max(1, len(body) // 2)])
+            self.wfile.flush()
+            self.connection.close()
+            return
+        self.wfile.write(body)
+
+
+@pytest.fixture
+def s3(tmp_path, monkeypatch):
+    root = str(tmp_path / "local")
+    imagenet.write_synthetic_shards(root, n_shards=3, per_shard=6, size=48)
+    objects = {}
+    for f in sorted(os.listdir(root)):
+        with open(os.path.join(root, f), "rb") as fh:
+            objects[f"bkt/imagenet/{f}"] = fh.read()
+    _FakeS3.objects = objects
+    _FakeS3.fail_once = set()
+    _FakeS3.verify_auth = True
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _FakeS3)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    monkeypatch.setenv("AWS_ENDPOINT_URL",
+                       f"http://127.0.0.1:{srv.server_address[1]}")
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", ACCESS)
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", SECRET)
+    monkeypatch.setenv("AWS_REGION", "us-east-1")
+    monkeypatch.setenv("no_proxy", "*")
+    from sparknet_tpu.data import gcs as gcs_mod, s3 as s3_mod
+    monkeypatch.setattr(gcs_mod, "BACKOFF_S", 0.01)
+    s3_mod._CLIENTS.clear()
+    s3_mod._SIZE_CACHE.clear()
+    yield "s3://bkt/imagenet", root
+    srv.shutdown()
+
+
+def test_s3_list_and_labels_signed(s3):
+    """Listing + label fetch work, and the server ACCEPTED the SigV4 it
+    verified — a wrong signature is rejected (negative control)."""
+    url, root = s3
+    remote = imagenet.list_shards(url, prefix="train.")
+    local = imagenet.list_shards(root, prefix="train.")
+    assert [os.path.basename(p) for p in remote] == \
+        [os.path.basename(p) for p in local]
+    assert len(remote) == 3  # > page_size: pagination exercised
+    assert imagenet.load_label_map(f"{url}/train.txt") == \
+        imagenet.load_label_map(os.path.join(root, "train.txt"))
+
+
+def test_s3_bad_secret_rejected(s3, monkeypatch):
+    from sparknet_tpu.data import s3 as s3_mod
+    import urllib.error
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "wrong")
+    s3_mod._CLIENTS.clear()
+    with pytest.raises(urllib.error.HTTPError):
+        imagenet.list_shards(s3[0])
+
+
+def test_s3_loader_bit_identical_to_local(s3):
+    url, root = s3
+    labels = imagenet.load_label_map(os.path.join(root, "train.txt"))
+    s = imagenet.ShardedTarLoader(imagenet.list_shards(url), labels,
+                                  height=32, width=32)
+    l = imagenet.ShardedTarLoader(imagenet.list_shards(root), labels,
+                                  height=32, width=32)
+    si, sl = s.load_all()
+    li, ll = l.load_all()
+    np.testing.assert_array_equal(si, li)
+    np.testing.assert_array_equal(sl, ll)
+
+
+def test_s3_stream_resumes_after_disconnect(s3):
+    """Truncated body mid-tar -> signed ranged reconnect -> identical
+    data (the reference's S3 streams had no such resilience)."""
+    url, root = s3
+    labels = imagenet.load_label_map(os.path.join(root, "train.txt"))
+    _FakeS3.fail_once = {"imagenet/train.0000.tar"}
+    s = imagenet.ShardedTarLoader(imagenet.list_shards(url), labels,
+                                  height=32, width=32)
+    l = imagenet.ShardedTarLoader(imagenet.list_shards(root), labels,
+                                  height=32, width=32)
+    np.testing.assert_array_equal(s.load_all()[0], l.load_all()[0])
+
+
+def test_s3_mid_shard_seek_and_size(s3):
+    url, root = s3
+    labels = imagenet.load_label_map(os.path.join(root, "train.txt"))
+    all_pos = [(lbl, pos) for _, lbl, pos in imagenet.ShardedTarLoader(
+        imagenet.list_shards(root), labels, 32, 32).iter_with_pos()]
+    mid = all_pos[7][1]
+    cont = [(lbl, pos) for _, lbl, pos in imagenet.ShardedTarLoader(
+        imagenet.list_shards(url), labels, 32, 32).iter_with_pos(mid)]
+    assert cont == all_pos[8:]
+    for g, l in zip(imagenet.list_shards(url), imagenet.list_shards(root)):
+        assert imagenet.path_size(g) == os.path.getsize(l)
+    # cold-cache size: ranged HEAD-equivalent (Content-Range total)
+    from sparknet_tpu.data import s3 as s3_mod
+    s3_mod._SIZE_CACHE.clear()
+    g0, l0 = imagenet.list_shards(url)[0], imagenet.list_shards(root)[0]
+    assert imagenet.path_size(g0) == os.path.getsize(l0)
