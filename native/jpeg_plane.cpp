@@ -216,7 +216,11 @@ void jp_crop_mean_nhwc_bf16(const uint8_t* images_chw, int n, int c, int h,
 // Bails with -1 on GNU/pax extension headers (L/K/x/g) — their presence
 // would desynchronize member numbering from Python's tarfile, which hides
 // them; callers fall back to tarfile. Bails -2 on IO error, -3 if max_n
-// is too small.
+// is too small, -4 when EOF arrives before the zero end-of-archive block
+// (an archive truncated AT a member boundary looks complete to a naive
+// walk — and to Python's tarfile, which iterates the partial archive
+// silently; requiring the terminator makes this the one place that
+// detects it).
 #include <cstdio>
 
 extern "C" long jp_tar_index(const char* path, long max_n, long* offsets,
@@ -227,12 +231,13 @@ extern "C" long jp_tar_index(const char* path, long max_n, long* offsets,
   long n = 0;
   unsigned char hdr[512];
   long pos = 0;
+  bool saw_end = false;
   while (fread(hdr, 1, 512, f) == 512) {
     pos += 512;
     // end-of-archive: a zero block
     bool all_zero = true;
     for (int i = 0; i < 512 && all_zero; ++i) all_zero = hdr[i] == 0;
-    if (all_zero) break;
+    if (all_zero) { saw_end = true; break; }
     char type = char(hdr[156]);
     if (type == 'L' || type == 'K' || type == 'x' || type == 'g') {
       fclose(f);
@@ -269,5 +274,5 @@ extern "C" long jp_tar_index(const char* path, long max_n, long* offsets,
     pos += padded;
   }
   fclose(f);
-  return n;
+  return saw_end ? n : -4;
 }

@@ -158,6 +158,31 @@ def shard_val(val_tar: str, val_label_file: str, out: str, shards: int,
     print(f"wrote {n} val shards + val.txt under {out}")
 
 
+def upload_dir(out: str, dest: str) -> int:
+    """Push every shard + label file under `out` to a gs:// or s3://
+    prefix — the reference sharder's upload side (put_imagenet_on_s3.py
+    pushed each chunk to S3 as it was built; here local shards are the
+    staging area and the push reuses the framework's native bucket
+    clients, so no cloud SDK is needed on the ingest box either)."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from sparknet_tpu.data.gcs import gs_write, is_gs_path
+    from sparknet_tpu.data.s3 import is_s3_path, s3_write
+    if not (is_gs_path(dest) or is_s3_path(dest)):
+        raise SystemExit(f"--upload must be gs:// or s3://, got {dest!r}")
+    write = gs_write if is_gs_path(dest) else s3_write
+    dest = dest.rstrip("/")
+    n = 0
+    for f in sorted(os.listdir(out)):
+        if not (f.endswith(".tar") or f.endswith(".txt")):
+            continue
+        with open(os.path.join(out, f), "rb") as fh:
+            write(f"{dest}/{f}", fh.read())
+        n += 1
+        print(f"uploaded {dest}/{f}")
+    return n
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--train-tar",
@@ -170,10 +195,14 @@ def main() -> None:
     p.add_argument("--val-shards", type=int, default=50)
     p.add_argument("--size", type=int, default=256)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--upload", metavar="gs://B/P|s3://B/P", default=None,
+                   help="after sharding, push shards + label files to this "
+                   "bucket prefix (native clients; no SDK)")
     args = p.parse_args()
 
-    if not args.train_tar and not args.val_tar:
-        p.error("nothing to do: pass --train-tar and/or --val-tar")
+    if not args.train_tar and not args.val_tar and not args.upload:
+        p.error("nothing to do: pass --train-tar and/or --val-tar "
+                "(and/or --upload to push an existing --out)")
     if args.val_tar and not args.val_label_file:
         p.error("--val-tar needs --val-label-file (ground-truth labels)")
     os.makedirs(args.out, exist_ok=True)
@@ -183,6 +212,9 @@ def main() -> None:
     if args.val_tar:
         shard_val(args.val_tar, args.val_label_file, args.out,
                   args.val_shards, args.size, args.seed)
+    if args.upload:
+        n = upload_dir(args.out, args.upload)
+        print(f"uploaded {n} files to {args.upload}")
 
 
 if __name__ == "__main__":

@@ -62,14 +62,18 @@ def is_gs_path(path: str) -> bool:
 
 
 def http_get_with_retry(url: str, headers: Optional[dict] = None,
-                        timeout: float = 60.0):
-    """GET with retry on 429/5xx and connection errors; returns the open
-    response (caller reads/closes). 4xx other than 429 propagates
+                        timeout: float = 60.0, method: str = "GET",
+                        data: Optional[bytes] = None):
+    """HTTP request with retry on 429/5xx and connection errors; returns
+    the open response (caller reads/closes). 4xx other than 429 propagates
     immediately — retrying a 403/404 only hides it. Shared by the GCS and
-    S3 clients (auth differs per caller; the transport does not)."""
+    S3 clients (auth differs per caller; the transport does not). Bodies
+    (`data`) are bytes held in memory, so retrying a PUT/POST re-sends the
+    identical payload."""
     last: Optional[BaseException] = None
     for attempt in range(RETRIES):
-        req = urllib.request.Request(url, headers=headers or {})
+        req = urllib.request.Request(url, headers=headers or {},
+                                     data=data, method=method)
         try:
             return urllib.request.urlopen(req, timeout=timeout)
         except urllib.error.HTTPError as e:
@@ -78,8 +82,9 @@ def http_get_with_retry(url: str, headers: Optional[dict] = None,
             last = e
         except (urllib.error.URLError, ConnectionError, OSError) as e:
             last = e
-        time.sleep(BACKOFF_S * 2 ** attempt)
-    raise ConnectionError(f"GET {url} failed after {RETRIES} attempts"
+        if attempt < RETRIES - 1:  # no dead-time sleep before the raise
+            time.sleep(BACKOFF_S * 2 ** attempt)
+    raise ConnectionError(f"{method} {url} failed after {RETRIES} attempts"
                           ) from last
 
 
@@ -257,7 +262,8 @@ class GcsRangeStream(io.RawIOBase):
                 except Exception:
                     pass
                 self._resp = None  # reconnect from self._pos
-                time.sleep(BACKOFF_S * 2 ** attempt)
+                if attempt < RETRIES - 1:
+                    time.sleep(BACKOFF_S * 2 ** attempt)
                 continue
             if data:
                 self._pos += len(data)
@@ -271,7 +277,8 @@ class GcsRangeStream(io.RawIOBase):
                 except Exception:
                     pass
                 self._resp = None
-                time.sleep(BACKOFF_S * 2 ** attempt)
+                if attempt < RETRIES - 1:
+                    time.sleep(BACKOFF_S * 2 ** attempt)
                 continue
             self._eof = True
             return data
@@ -358,3 +365,20 @@ def gs_read(url: str) -> bytes:
 def gs_open_stream(url: str, start: int = 0) -> GcsRangeStream:
     bucket, name = parse_gs_url(url)
     return _shared_client().open_stream(bucket, name, start)
+
+
+def gs_write(url: str, data: bytes) -> None:
+    """Upload bytes to a gs:// object (simple media upload) — the push
+    side of the ingest tooling (the reference's sharder uploaded its
+    chunks to the object store, `scripts/put_imagenet_on_s3.py`)."""
+    bucket, name = parse_gs_url(url)
+    client = _shared_client()
+    u = (f"{client.endpoint}/upload/storage/v1/b/"
+         f"{urllib.parse.quote(bucket, safe='')}/o?uploadType=media&name="
+         f"{urllib.parse.quote(name, safe='')}")
+    with http_get_with_retry(
+            u, {**client._auth_header(),
+                "Content-Type": "application/octet-stream"},
+            client.timeout, method="POST", data=data) as r:
+        r.read()
+    _SIZE_CACHE[url] = len(data)

@@ -226,7 +226,13 @@ class ShardedTarLoader:
                 from . import jpeg_plane
                 if jpeg_plane.supports_tar_index():
                     idx = jpeg_plane.tar_index(path)
-            except (ImportError, OSError):
+            except ImportError:
+                idx = None
+            except jpeg_plane.TruncatedTarError:
+                # do NOT fall back: tarfile iterates a boundary-truncated
+                # archive silently, which would train on partial data
+                raise
+            except OSError:
                 idx = None
         self._tar_indices[path] = idx
         return idx

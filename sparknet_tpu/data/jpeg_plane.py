@@ -165,6 +165,14 @@ def crop_mean_nhwc(images_chw_u8: np.ndarray,
     return out
 
 
+class TruncatedTarError(OSError):
+    """A shard is missing data (truncated mid-member or missing the tar
+    end-of-archive terminator). Distinct from plain OSError so callers
+    that fall back to tarfile on INDEXING problems still surface this
+    loudly — Python's tarfile iterates a boundary-truncated archive
+    silently, so falling back would train on partial data."""
+
+
 def supports_tar_index() -> bool:
     lib = _load()
     return lib is not None and \
@@ -194,14 +202,17 @@ def tar_index(path: str, name_cap: int = 128):
         names.ctypes.data_as(ctypes.c_char_p), name_cap)
     if n == -1:
         return None  # extension headers: numbering would diverge
+    if n == -4:
+        raise TruncatedTarError(
+            f"tar {path!r} ended without the zero end-of-archive block — "
+            f"truncated at a member boundary?")
     if n < 0:
         raise OSError(f"tar index of {path!r} failed (rc={n})")
     if n and int(offsets[n - 1] + sizes[n - 1]) > os.path.getsize(path):
         # truncated archive: fseek past EOF "succeeds", so the C walk can
-        # index members whose data is missing. The tarfile path raises
-        # loudly on such shards; the fast path must not silently drop data
-        raise OSError(f"tar {path!r} is truncated (last member extends "
-                      f"past EOF)")
+        # index members whose data is missing
+        raise TruncatedTarError(
+            f"tar {path!r} is truncated (last member extends past EOF)")
     name_list = [bytes(names[i * name_cap:(i + 1) * name_cap]
                        ).split(b"\0", 1)[0].decode("utf-8", "replace")
                  for i in range(n)]

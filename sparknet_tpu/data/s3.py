@@ -73,10 +73,11 @@ class S3Client:
     # -- SigV4 ---------------------------------------------------------------
 
     def _sign(self, method: str, host: str, path: str, query: str,
-              headers: dict) -> dict:
-        """Add Authorization (+ x-amz-*) headers for a bodyless request.
-        SigV4 per the AWS spec: canonical request -> string-to-sign ->
-        HMAC chain (date, region, service, 'aws4_request')."""
+              headers: dict, payload_hash: str = _EMPTY_SHA256) -> dict:
+        """Add Authorization (+ x-amz-*) headers. SigV4 per the AWS spec:
+        canonical request -> string-to-sign -> HMAC chain (date, region,
+        service, 'aws4_request'). `payload_hash` is sha256(body) for PUTs
+        (the empty-body hash for GETs)."""
         if not self.access_key or not self.secret_key:
             return headers  # anonymous (public bucket)
         now = datetime.datetime.now(datetime.timezone.utc)
@@ -84,7 +85,7 @@ class S3Client:
         datestamp = now.strftime("%Y%m%d")
         headers = dict(headers)
         headers["x-amz-date"] = amz_date
-        headers["x-amz-content-sha256"] = _EMPTY_SHA256
+        headers["x-amz-content-sha256"] = payload_hash
         if self.session_token:
             headers["x-amz-security-token"] = self.session_token
         all_h = {**headers, "host": host}
@@ -96,7 +97,7 @@ class S3Client:
             "".join(f"{k}:{all_h[k2].strip()}\n" for k, k2 in
                     sorted((k.lower(), k) for k in all_h)),
             signed,
-            _EMPTY_SHA256,
+            payload_hash,
         ])
         scope = f"{datestamp}/{self.region}/s3/aws4_request"
         sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
@@ -123,13 +124,18 @@ class S3Client:
         return f"https://{host}", host, ("/" + key if key else "/")
 
     def _request(self, bucket: str, key: str, query: str = "",
-                 headers: Optional[dict] = None):
+                 headers: Optional[dict] = None, method: str = "GET",
+                 data: Optional[bytes] = None):
         base, host, path = self._url_parts(bucket, key)
-        headers = self._sign("GET", host, path, query, headers or {})
+        payload = (hashlib.sha256(data).hexdigest() if data is not None
+                   else _EMPTY_SHA256)
+        headers = self._sign(method, host, path, query, headers or {},
+                             payload_hash=payload)
         url = base + urllib.parse.quote(path, safe="/-_.~")
         if query:
             url += "?" + query
-        return _gcs.http_get_with_retry(url, headers, self.timeout)
+        return _gcs.http_get_with_retry(url, headers, self.timeout,
+                                        method=method, data=data)
 
     # -- API -----------------------------------------------------------------
 
@@ -245,6 +251,17 @@ def s3_read(url: str) -> bytes:
 def s3_open_stream(url: str, start: int = 0) -> _S3RangeStream:
     bucket, key = parse_s3_url(url)
     return _shared_client().open_stream(bucket, key, start)
+
+
+def s3_write(url: str, data: bytes) -> None:
+    """Upload bytes to an s3:// object (SigV4-signed PUT with the payload
+    hash) — the reference sharder's upload side
+    (`scripts/put_imagenet_on_s3.py`)."""
+    bucket, key = parse_s3_url(url)
+    with _shared_client()._request(bucket, key, method="PUT",
+                                   data=data) as r:
+        r.read()
+    _SIZE_CACHE[url] = len(data)
 
 
 def s3_size(url: str) -> int:

@@ -772,7 +772,22 @@ def test_truncated_shard_fails_loudly(tmp_path):
         # cut INTO the last member's data (tar pads archives with ~10KB of
         # trailing zero blocks, so an end-relative truncate misses)
         f.truncate(int(offsets[-1] + sizes[-1] // 2))
-    with pytest.raises(OSError, match="truncated"):
+    with pytest.raises(jpeg_plane.TruncatedTarError):
         jpeg_plane.tar_index(path)
     with pytest.raises(Exception):  # surfaced, not swallowed
         loader.load_all()
+
+    # truncation exactly AT a member boundary is the sneaky case: the
+    # archive looks complete to a naive walk (and to Python's tarfile,
+    # which iterates the partial archive silently) — the missing zero
+    # end-of-archive block is the tell, and it must NOT fall back
+    loader2 = _stream_fixture(tmp_path.joinpath("b"), n_shards=1,
+                              per_shard=8)
+    path2 = loader2.shard_paths[0]
+    o2, s2, _, _ = jpeg_plane.tar_index(path2)
+    with open(path2, "r+b") as f:
+        f.truncate(int(o2[-1] + ((s2[-1] + 511) & ~511)))
+    with pytest.raises(jpeg_plane.TruncatedTarError):
+        jpeg_plane.tar_index(path2)
+    with pytest.raises(jpeg_plane.TruncatedTarError):
+        loader2.load_all()  # no silent tarfile fallback
