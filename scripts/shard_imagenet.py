@@ -176,7 +176,15 @@ def upload_dir(out: str, dest: str) -> int:
     for f in sorted(os.listdir(out)):
         if not (f.endswith(".tar") or f.endswith(".txt")):
             continue
-        with open(os.path.join(out, f), "rb") as fh:
+        path = os.path.join(out, f)
+        size = os.path.getsize(path)
+        if size > (1 << 30):
+            # each upload is one in-memory PUT (a retry re-sends the whole
+            # body); huge shards want more --shards, not multipart logic
+            print(f"warning: {f} is {size >> 20} MiB — single-shot upload "
+                  f"holds it in RAM and a retry re-sends it all; consider "
+                  f"more --shards for smaller chunks")
+        with open(path, "rb") as fh:
             write(f"{dest}/{f}", fh.read())
         n += 1
         print(f"uploaded {dest}/{f}")

@@ -22,9 +22,10 @@
 #        "python -m sparknet_tpu.apps.imagenet_app \
 #         --data-dir gs://mybucket/imagenet ingest_sources=8 \
 #         checkpoint_dir=/gcs/ckpts/run1"
-#    (--data-dir gs://… streams the bucket NATIVELY — ranged HTTP reads
-#    with reconnect-resume, sparknet_tpu/data/gcs.py; no FUSE mount in the
-#    data path. checkpoint_dir still wants a mounted/shared filesystem.)
+#    (--data-dir gs://… or s3://… streams the bucket NATIVELY — ranged
+#    HTTP reads with reconnect-resume, sparknet_tpu/data/{gcs,s3}.py; no
+#    FUSE mount and no cloud SDK in the data path. checkpoint_dir still
+#    wants a mounted/shared filesystem.)
 # 2. Capacity is reclaimed mid-run (state PREEMPTED, or the VM disappears).
 #    `watch` notices — either the ssh run dies and the state probe says so,
 #    or the next poll does — deletes the husk, recreates the VM (same TYPE,

@@ -75,6 +75,26 @@ def path_size(path: str) -> int:
     return os.path.getsize(path)
 
 
+def _check_tar_terminator(path: str) -> None:
+    """Raise TruncatedTarError when a LOCAL tar lacks its zero
+    end-of-archive blocks — a shard truncated exactly at a member boundary
+    otherwise looks complete to tarfile and trains on partial data. Best
+    effort (a member whose data ends in >=1 KiB of zeros could mask a
+    missing terminator), which still catches the realistic interrupted-
+    copy case the silent path would swallow."""
+    from .jpeg_plane import TruncatedTarError
+    size = os.path.getsize(path)
+    if size < 1024 or size % 512:
+        raise TruncatedTarError(f"tar {path!r}: size {size} is not a "
+                                f"whole number of 512-byte blocks")
+    with open(path, "rb") as f:
+        f.seek(size - 1024)
+        if f.read(1024).strip(b"\0"):
+            raise TruncatedTarError(
+                f"tar {path!r} ended without the zero end-of-archive "
+                f"block — truncated at a member boundary?")
+
+
 def _open_tar(path: str) -> tarfile.TarFile:
     """Local shards open seekably; gs://|s3:// shards open as ONE streamed
     ranged GET (`r|` mode) with transparent reconnect-resume — the
@@ -202,6 +222,14 @@ class ShardedTarLoader:
                             f"truncated?")
                     yield data, label, (si, e + 1)
             return
+        if not path.startswith(("gs://", "s3://")):
+            # tarfile iterates a boundary-truncated archive SILENTLY; the
+            # C indexer catches it via the missing terminator, and this
+            # closes the same hole on the fallback path (no native plane,
+            # extension-header archives). Remote objects are served
+            # consistently by the store, so a truncated UPLOAD is the
+            # uploader's bug — each ranged read is still length-checked.
+            _check_tar_terminator(path)
         with _open_tar(path) as tar:
             entry = 0
             for member in tar:  # ALWAYS advances (bug fix vs reference)

@@ -256,10 +256,13 @@ def s3_open_stream(url: str, start: int = 0) -> _S3RangeStream:
 def s3_write(url: str, data: bytes) -> None:
     """Upload bytes to an s3:// object (SigV4-signed PUT with the payload
     hash) — the reference sharder's upload side
-    (`scripts/put_imagenet_on_s3.py`)."""
+    (`scripts/put_imagenet_on_s3.py`). Content-Type is set (and signed)
+    explicitly: urllib would otherwise inject form-urlencoded, which S3
+    stores as the object's type."""
     bucket, key = parse_s3_url(url)
-    with _shared_client()._request(bucket, key, method="PUT",
-                                   data=data) as r:
+    with _shared_client()._request(
+            bucket, key, method="PUT", data=data,
+            headers={"Content-Type": "application/octet-stream"}) as r:
         r.read()
     _SIZE_CACHE[url] = len(data)
 

@@ -791,3 +791,9 @@ def test_truncated_shard_fails_loudly(tmp_path):
         jpeg_plane.tar_index(path2)
     with pytest.raises(jpeg_plane.TruncatedTarError):
         loader2.load_all()  # no silent tarfile fallback
+    # the PURE-tarfile path (no native plane / extension archives) has its
+    # own terminator check and must also refuse
+    loader3 = imagenet.ShardedTarLoader([path2], loader2.label_map, 32, 32)
+    loader3._tar_indices[path2] = None  # force the tarfile branch
+    with pytest.raises(jpeg_plane.TruncatedTarError):
+        loader3.load_all()

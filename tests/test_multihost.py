@@ -135,3 +135,104 @@ def test_two_process_training_world(tmp_path):
     # process 0 (and only process 0) wrote the checkpoint
     assert os.path.isdir(tmp_path / "w0" / "ck" / "step-3")
     assert not os.path.isdir(tmp_path / "w1" / "ck")
+
+
+_STREAM_WORKER = textwrap.dedent("""
+    import os, sys
+    pid, nproc, port, shards_dir, ckdir, workdir, rounds = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+        sys.argv[5], sys.argv[6], int(sys.argv[7]))
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from sparknet_tpu.parallel import initialize_multihost
+    initialize_multihost(coordinator=f"127.0.0.1:{port}",
+                         num_processes=nproc, process_id=pid)
+
+    import numpy as np
+    from sparknet_tpu.apps.train_loop import train, probe_value
+    from sparknet_tpu.data import imagenet
+    from sparknet_tpu.data.streaming import make_parallel_source
+    from sparknet_tpu.parallel.mesh import host_id_count
+    from sparknet_tpu.utils.config import RunConfig
+    from sparknet_tpu.utils.logger import Logger
+    from sparknet_tpu.solver import SolverConfig
+    from sparknet_tpu.zoo import lenet
+    from sparknet_tpu import CompiledNet
+
+    pi, pc = host_id_count()
+    shards = imagenet.host_shards(imagenet.list_shards(shards_dir), pi, pc)
+    labels = imagenet.load_label_map(os.path.join(shards_dir, "train.txt"))
+    src = make_parallel_source(shards, labels, jax.local_device_count(),
+                               2, 2, n_sources=2, height=28, width=28)
+    assert src.n_sources == 2
+
+    class GrayTo28:
+        def convert_batch(self, batch, train=True, rng=None):
+            x = batch["data"].astype(np.float32).mean(axis=1)
+            return {"data": x[..., None], "label": batch["label"]}
+
+    cfg = RunConfig(model="lenet",
+                    solver=SolverConfig(base_lr=0.01, momentum=0.9,
+                                        lr_policy="fixed"),
+                    tau=2, local_batch=2, eval_every=0, max_rounds=rounds,
+                    workdir=workdir, seed=0, checkpoint_dir=ckdir,
+                    checkpoint_every=1)
+    state = train(cfg, lenet(batch=2), src,
+                  logger=Logger(os.path.join(workdir, f"slog{pid}.txt"),
+                                echo=False),
+                  batch_transform=GrayTo28())
+    probe = probe_value(state, CompiledNet.compile(lenet(batch=2)))
+    print(f"RESULT pid={pid} probe={probe:.8f}", flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_parallel_streaming_cursors(tmp_path):
+    """The composed multihost ingest story: 2 hosts x 2 parallel shard
+    readers each; the checkpoint's stream cursors allgather as a per-host
+    [readers, 3] block (the shape that would die with ragged per-host
+    reader counts), and a relaunch resumes ALL four readers."""
+    from sparknet_tpu.data import imagenet
+    from sparknet_tpu.utils import checkpoint as ckpt
+
+    shards_dir = str(tmp_path / "shards")
+    imagenet.write_synthetic_shards(shards_dir, n_shards=8, per_shard=12,
+                                    size=28, n_classes=10)
+    ckdir = str(tmp_path / "ck")
+    script = str(tmp_path / "sworker.py")
+    with open(script, "w") as f:
+        f.write(_STREAM_WORKER)
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    for pid in range(2):
+        os.makedirs(tmp_path / f"w{pid}", exist_ok=True)
+
+    def launch(rounds):
+        port = _free_port()
+        procs = [subprocess.Popen(
+            [sys.executable, script, str(pid), "2", str(port), shards_dir,
+             ckdir, str(tmp_path / f"w{pid}"), str(rounds)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for pid in range(2)]
+        outs = [p.communicate(timeout=300)[0] for p in procs]
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        probes = sorted(ln.split("probe=")[1] for out in outs
+                        for ln in out.splitlines()
+                        if ln.startswith("RESULT"))
+        assert len(probes) == 2 and probes[0] == probes[1], probes
+        return probes[0]
+
+    launch(rounds=3)
+    _, step, extra = ckpt.restore_flat(ckdir)
+    assert step == 3
+    # 2 hosts x 2 readers x [shard, entry, epochs]
+    assert len(extra["stream"]) == 2
+    assert all(len(host_rows) == 2 for host_rows in extra["stream"])
+
+    launch(rounds=5)  # resume
+    for pid in range(2):
+        text = open(tmp_path / f"w{pid}" / f"slog{pid}.txt").read()
+        assert "resumed from checkpoint round 3" in text
+        assert "stream resumed at" in text and text.count("shard") >= 2
