@@ -173,8 +173,11 @@ def _load_or_compute_mean(cfg: RunConfig, train_loader, pi: int, pc: int,
                   file=sys.stderr)
             return mean
     # one streaming pass for the global mean reduce; never holds more
-    # than one decoded image + the float64 accumulator
-    s, n = streaming_sum_count(train_loader)
+    # than one decoded image + a float64 accumulator per worker thread.
+    # Fanned out over the host's shards like the training ingest — the
+    # serial pass decoded the whole corpus at one reader's rate
+    workers = max(cfg.ingest_sources, min(8, os.cpu_count() or 1))
+    s, n = streaming_sum_count(train_loader, workers=workers)
     mean = _combine_mean(s, float(n), pc)
     if side and pi == 0:
         os.makedirs(cfg.checkpoint_dir, exist_ok=True)

@@ -433,7 +433,7 @@ def test_mean_image_sidecar_skips_second_pass(tmp_path, monkeypatch):
     first = imagenet_app._load_or_compute_mean(cfg, loader, 0, 1, "t")
     assert (tmp_path / "ck" / "mean_image.npz").exists()
 
-    def boom(_):
+    def boom(*_a, **_k):
         raise AssertionError("second launch re-streamed the corpus")
 
     monkeypatch.setattr(imagenet_app, "streaming_sum_count", boom)
@@ -797,3 +797,15 @@ def test_truncated_shard_fails_loudly(tmp_path):
     loader3._tar_indices[path2] = None  # force the tarfile branch
     with pytest.raises(jpeg_plane.TruncatedTarError):
         loader3.load_all()
+
+
+def test_streaming_sum_count_parallel_matches_serial(tmp_path):
+    """The fanned-out mean pass is float64 partial sums over shard subsets
+    — identical to the serial pass, any worker count."""
+    from sparknet_tpu.data.streaming import streaming_sum_count
+    serial = streaming_sum_count(_stream_fixture(tmp_path, n_shards=4))
+    for w in (2, 3, 99):
+        par = streaming_sum_count(_stream_fixture(tmp_path, n_shards=4),
+                                  workers=w)
+        assert par[1] == serial[1]
+        np.testing.assert_array_equal(par[0], serial[0])
