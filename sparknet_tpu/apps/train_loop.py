@@ -251,17 +251,24 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     # then measures only the residual WAIT — ~0 when prep fully overlaps.
     prefetch = ThreadPoolExecutor(1, thread_name_prefix="round-prep")
     pending: Optional[Any] = None
-    deferred = None  # previous round's (rnd, device_loss, device_probe)
+    # pending (rnd, device_loss, device_probe) records, flushed (= the
+    # loop's host sync) every cfg.log_every rounds — holding device
+    # scalars is free; fetching one costs a full round trip
+    deferred: list = []
+
+    def flush_deferred() -> None:
+        while deferred:
+            flush_round_log(deferred.pop(0))
+
+    log_every = max(1, cfg.log_every)
     try:
         for rnd in range(start_round, cfg.max_rounds):
             if test_ds is not None and cfg.eval_every and \
                     rnd % cfg.eval_every == 0:
-                if deferred is not None:
-                    # keep log/JSONL round-ordered: round R-1's loss row
-                    # must precede round R's eval row (eval blocks on the
-                    # in-flight round anyway, so this costs no overlap)
-                    flush_round_log(deferred)
-                    deferred = None
+                # keep log/JSONL round-ordered: earlier loss rows must
+                # precede round R's eval row (eval blocks on the in-flight
+                # round anyway, so this costs no overlap)
+                flush_deferred()
                 with timers.phase("eval"):
                     acc = _evaluate(trainer, state, test_ds, cfg.eval_batch,
                                     n_local, transform=eval_transform)
@@ -284,32 +291,32 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
                     # async probe slice MUST precede the next dispatch
                     # (donation invalidates the old state buffers)
                     probe_val = probe(state) if probe else None
-                    if deferred is not None:
-                        flush_round_log(deferred)  # sync on round rnd-1
+                    if len(deferred) >= log_every:
+                        flush_deferred()  # sync on rounds <= rnd-1
             if profile_this:
                 log.log(f"profiler trace written to {cfg.profile_dir}", rnd)
-            # steady state, this measures one device round: dispatch of rnd
-            # + wait for rnd-1 (the two overlap by exactly one round)
+            # steady state (log_every=1), this measures one device round:
+            # dispatch of rnd + wait for rnd-1 (overlap of exactly one
+            # round); with log_every=K the sync cost amortizes over K
             round_dt = timers.total["train_round"] - before
             n_images = cfg.tau * cfg.local_batch * n_dev
             meter.add(n_images, round_dt)
-            deferred = (rnd, loss, probe_val)
+            deferred.append((rnd, loss, probe_val))
 
             if cfg.checkpoint_dir and cfg.checkpoint_every and \
                     (rnd + 1) % cfg.checkpoint_every == 0:
-                with timers.phase("checkpoint"):
+                flush_deferred()  # keep log rows round-ordered; the
+                with timers.phase("checkpoint"):  # save syncs anyway
                     _save_checkpoint(cfg, trainer, state, rnd + 1,
                                      source=source, last_round=rnd)
                 log.log("checkpoint saved", rnd)
             if round_hook:
                 round_hook(rnd, state)
-        if deferred is not None:
-            flush_round_log(deferred)
-            deferred = None
+        flush_deferred()
     finally:
-        if deferred is not None:  # loop aborted: drain the pending fetch
+        if deferred:  # loop aborted: drain the pending fetches
             try:
-                flush_round_log(deferred)
+                flush_deferred()
             except Exception:
                 pass
         if pending is not None:

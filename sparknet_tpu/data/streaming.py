@@ -79,6 +79,10 @@ def streaming_sum_count(loader: ShardedTarLoader, workers: int = 1
             parts = list(pool.map(one, subs))
         for sub in subs:
             loader.skipped += sub.skipped
+            # keep the shared loader's C member-index cache warm: the
+            # training stream reuses this loader (ingest_sources=1) and
+            # would otherwise re-walk every tar's headers
+            loader._tar_indices.update(sub._tar_indices)
         total, count = None, 0
         for t, c in parts:
             if t is not None:

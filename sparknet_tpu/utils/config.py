@@ -52,6 +52,13 @@ class RunConfig:
     # to $SPARKNET_HOME/training_log_<ms>.txt); tests set the env var to a
     # tmp dir so stray default-config runs never litter the repo root
     workdir: Optional[str] = None
+    # fetch/flush round metrics every K rounds (losses stay on device in
+    # between). The loop's ONLY per-round host sync is the deferred loss
+    # fetch; when rounds are shorter than the dispatch/fetch round trip
+    # (very fast models, or a high-latency dev tunnel where a fetch costs
+    # ~100 ms), K>1 amortizes that sync K-fold. Log content is identical,
+    # just flushed in batches.
+    log_every: int = 1
     seed: int = 0
     # jax.profiler capture: trace ONE steady-state round (start_round+1,
     # skipping the compile round) into this directory (SURVEY §5.1)

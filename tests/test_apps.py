@@ -402,3 +402,44 @@ def test_elastic_resume_momentum_trajectory_band(tmp_path):
         assert max(rel) < 0.5, (nd, losses, base)
         # and the continued run still LEARNS (not just stays close)
         assert np.mean(losses[-3:]) < losses[0], (nd, losses)
+
+
+def test_log_every_batches_metric_fetches(tmp_path):
+    """cfg.log_every=K amortizes the loop's per-round loss fetch (the only
+    host sync; ~one full round trip on high-latency links) K-fold; the
+    logged content must be IDENTICAL to log_every=1, rounds in order."""
+    import json
+    import re
+    from sparknet_tpu.apps.train_loop import train
+    from sparknet_tpu.zoo import lenet
+    from sparknet_tpu.data.dataset import ArrayDataset
+
+    r = np.random.default_rng(0)
+    ds = ArrayDataset({"data": r.standard_normal(
+        (256, 1, 28, 28)).astype(np.float32),
+        "label": r.integers(0, 10, (256, 1)).astype(np.int32)})
+
+    def run(log_every, tag):
+        jsonl = str(tmp_path / f"m{tag}.jsonl")
+        cfg = RunConfig(model="lenet", tau=2, local_batch=2, max_rounds=7,
+                        eval_every=3, eval_batch=64, seed=0,
+                        workdir=str(tmp_path), log_every=log_every)
+        train(cfg, lenet(batch=2), ds, ds,
+              logger=Logger(str(tmp_path / f"l{tag}.txt"), echo=False,
+                            jsonl_path=jsonl))
+        rows = [json.loads(ln) for ln in open(jsonl)]
+        text = open(str(tmp_path / f"l{tag}.txt")).read()
+        return rows, text
+
+    base_rows, base_text = run(1, "a")
+    k_rows, k_text = run(3, "b")
+
+    def semantic(rows):  # drop wall-clock fields ('t', throughput)
+        return [{k: r[k] for k in ("step", "loss", "test_accuracy")
+                 if k in r} for r in rows]
+
+    assert semantic(k_rows) == semantic(base_rows)  # same metrics, order
+    # round-ordered loss lines in the text log too
+    rounds = [int(m.group(1)) for m in
+              re.finditer(r"round loss: [\d.]+.*iteration = (\d+)", k_text)]
+    assert rounds == sorted(rounds) == list(range(7))
