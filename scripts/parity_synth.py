@@ -28,7 +28,6 @@ Run: python scripts/parity_synth.py [--iters 4000] [--out PARITY_SYNTH_r04.json]
 from __future__ import annotations
 
 import argparse
-import functools
 import json
 import os
 import sys

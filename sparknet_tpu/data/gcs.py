@@ -35,7 +35,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Iterator, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 _METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
                        "instance/service-accounts/default/token")
