@@ -42,6 +42,8 @@ def main() -> None:
     p.add_argument("--sources", type=int, default=4)
     p.add_argument("--shards", type=int, default=32)
     p.add_argument("--per-shard", type=int, default=256)
+    p.add_argument("--keep", action="store_true",
+                   help="keep the temp shard/work dirs (default: removed)")
     args = p.parse_args()
 
     from sparknet_tpu.apps.train_loop import train
@@ -58,10 +60,10 @@ def main() -> None:
     work = tempfile.mkdtemp(prefix="soak_work_")
     print(f"soak: building {args.shards}x{args.per_shard} synthetic shards "
           f"under {root}", file=sys.stderr)
-    imagenet.write_synthetic_shards(root, n_shards=args.shards,
-                                    per_shard=args.per_shard,
-                                    n_classes=16, size=size)
-    labels = imagenet.load_label_map(os.path.join(root, "train.txt"))
+    label_path = imagenet.write_synthetic_shards(
+        root, n_shards=args.shards, per_shard=args.per_shard,
+        n_classes=16, size=size)
+    labels = imagenet.load_label_map(label_path)
     src = make_parallel_source(imagenet.list_shards(root), labels, 1, b,
                                tau, args.sources, height=size, width=size)
     schema = Schema(Field("data", "float32", (crop, crop, 3)),
@@ -76,43 +78,57 @@ def main() -> None:
 
     t0 = time.time()
     samples = []
+    partial_path = args.out + ".partial.jsonl"
 
     def hook(rnd, state):
         if rnd % 50 == 0:
-            samples.append({"round": rnd, "rss_mb": round(rss_mb(), 1),
-                            "wall_s": round(time.time() - t0, 1),
-                            "skipped": int(src.skipped)})
+            s = {"round": rnd, "rss_mb": round(rss_mb(), 1),
+                 "wall_s": round(time.time() - t0, 1),
+                 "skipped": int(src.skipped)}
+            samples.append(s)
+            # incremental persistence: a soak that dies at round 5800 (the
+            # very leak/fault it hunts) must still leave its evidence
+            with open(partial_path, "a") as f:
+                f.write(json.dumps(s) + "\n")
             if rnd % 500 == 0:
-                print(f"soak round {rnd}: rss {samples[-1]['rss_mb']} MB "
-                      f"({samples[-1]['wall_s']:.0f}s)", file=sys.stderr)
+                print(f"soak round {rnd}: rss {s['rss_mb']} MB "
+                      f"({s['wall_s']:.0f}s)", file=sys.stderr)
 
     jsonl = os.path.join(work, "metrics.jsonl")
-    train(cfg, caffenet(batch=b, crop=crop, n_classes=16), src, None,
-          logger=Logger(os.path.join(work, "log.txt"), echo=False,
-                        jsonl_path=jsonl),
-          batch_transform=pp, round_hook=hook)
+    try:
+        train(cfg, caffenet(batch=b, crop=crop, n_classes=16), src, None,
+              logger=Logger(os.path.join(work, "log.txt"), echo=False,
+                            jsonl_path=jsonl),
+              batch_transform=pp, round_hook=hook)
 
-    losses = [json.loads(ln).get("loss") for ln in open(jsonl)
-              if "loss" in ln]
-    rss = [s["rss_mb"] for s in samples]
-    result = {
-        "rounds": args.rounds,
-        "images": args.rounds * b * tau,
-        "wall_s": round(time.time() - t0, 1),
-        "readers": src.n_sources,
-        "stream_epochs": max(ep for (_, _), ep in src.cursors),
-        "skipped": int(src.skipped),
-        "rss_mb": {"first": rss[0], "median": float(np.median(rss)),
-                   "last": rss[-1], "max": max(rss)},
-        "losses": {"n": len(losses), "first": losses[0],
-                   "last": losses[-1],
-                   "all_finite": bool(np.isfinite(losses).all())},
-        "rss_samples": samples[:: max(1, len(samples) // 60)],
-    }
-    with open(args.out, "w") as f:
-        json.dump(result, f, indent=1)
-    print(json.dumps({k: v for k, v in result.items()
-                      if k != "rss_samples"}))
+        losses = [json.loads(ln).get("loss") for ln in open(jsonl)
+                  if "loss" in ln]
+        rss = [s["rss_mb"] for s in samples]
+        result = {
+            "rounds": args.rounds,
+            "images": args.rounds * b * tau,
+            "wall_s": round(time.time() - t0, 1),
+            "readers": src.n_sources,
+            "stream_epochs": max(ep for (_, _), ep in src.cursors),
+            "skipped": int(src.skipped),
+            "rss_mb": {"first": rss[0], "median": float(np.median(rss)),
+                       "last": rss[-1], "max": max(rss)},
+            "losses": {"n": len(losses), "first": losses[0],
+                       "last": losses[-1],
+                       "all_finite": bool(np.isfinite(losses).all())},
+            "rss_samples": samples[:: max(1, len(samples) // 60)],
+        }
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+        if os.path.exists(partial_path):
+            os.remove(partial_path)  # superseded by the full artifact
+        print(json.dumps({k: v for k, v in result.items()
+                          if k != "rss_samples"}))
+    finally:
+        if not args.keep:
+            import shutil
+            shutil.rmtree(root, ignore_errors=True)
+            shutil.rmtree(work, ignore_errors=True)
 
 
 if __name__ == "__main__":
