@@ -1,0 +1,132 @@
+"""A/B/C the elastic-resume momentum policy (r4 verdict weak #4).
+
+`ParallelTrainer.adapt_state` must produce SOME momentum for the new
+topology out of the old per-worker velocities; r4 chose averaging and
+validated it stayed inside a wide band (<=10% loss inflation at 8->4,
+<=31% at 8->2) without comparing alternatives. This harness runs the
+same trajectory-band experiment (tests/test_apps.py::
+test_elastic_resume_momentum_trajectory_band shapes) for the three
+candidate policies over several seeds:
+
+  average       mean of the old data groups' velocities (r4 default)
+  zero          fresh zeros (momentum restarts after the resume)
+  norm_rescale  mean, rescaled back to the average per-worker norm
+                (averaging k decorrelated vectors shrinks the norm
+                ~1/sqrt(k); this undoes the shrink)
+
+Metric per (policy, new_n_dev, seed): max relative deviation of the 8
+post-resume round losses from the uninterrupted 8-device continuation,
+plus the mean of the last 3 losses (did it keep learning). Writes
+ELASTIC_AB_r05.json; the winner becomes adapt_state's default and the
+test band tightens to the measured envelope.
+
+Run: python scripts/elastic_momentum_ab.py   (CPU, ~2 min)
+"""
+import json
+import os
+import sys
+import tempfile
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, os.path.join(_ROOT, "tests"))
+sys.path.insert(0, _ROOT)
+from sparknet_tpu import CompiledNet, net_from_prototxt  # noqa: E402
+from sparknet_tpu.parallel import ParallelTrainer, make_mesh  # noqa: E402
+from sparknet_tpu.parallel.mesh import fetch_global  # noqa: E402
+from sparknet_tpu.solver import SolverConfig  # noqa: E402
+from sparknet_tpu.utils import checkpoint as ck  # noqa: E402
+from test_parallel import TINY_MLP  # noqa: E402
+
+TAU, B, ROUNDS_PRE, ROUNDS_POST = 3, 8, 4, 8
+POLICIES = ("average", "zero", "norm_rescale")
+SEEDS = (0, 1, 2)
+
+
+def batches(seed, n_dev):
+    r = np.random.default_rng(seed)
+    data = r.standard_normal((TAU, 8 * B, 6)).astype(np.float32)
+    label = (data.sum(-1, keepdims=True) > 0).astype(np.int32) + \
+        (data[..., :1] > 0.5).astype(np.int32)
+    return {"data": data[:, :n_dev * B], "label": label[:, :n_dev * B]}
+
+
+def run(trainer, state, rounds, n_dev, start=0, stream=0):
+    losses = []
+    for r in range(start, start + rounds):
+        state, loss = trainer.train_round(
+            state, batches(1000 * stream + r, n_dev),
+            jax.random.PRNGKey(7000 * stream + r))
+        losses.append(float(loss))
+    return state, losses
+
+
+def main():
+    net = CompiledNet.compile(net_from_prototxt(TINY_MLP))
+    scfg = SolverConfig(base_lr=0.05, momentum=0.9, weight_decay=0.001,
+                        lr_policy="fixed")
+    results = {p: {4: [], 2: []} for p in POLICIES}
+    for seed in SEEDS:
+        t8 = ParallelTrainer(net, scfg, make_mesh(8), tau=TAU)
+        s, _ = run(t8, t8.init_state(jax.random.PRNGKey(seed)),
+                   ROUNDS_PRE, 8, stream=seed)
+        with tempfile.TemporaryDirectory() as d:
+            ck.save(d, fetch_global(s), step=ROUNDS_PRE,
+                    extra={"n_devices": 8, "tp": 1})
+            flat, _, _ = ck.restore_flat(d)
+        _, base = run(t8, s, ROUNDS_POST, 8, start=ROUNDS_PRE, stream=seed)
+        for nd in (4, 2):
+            for pol in POLICIES:
+                t = ParallelTrainer(net, scfg, make_mesh(nd), tau=TAU)
+                st = t.adapt_state(flat, momentum_policy=pol)
+                _, losses = run(t, st, ROUNDS_POST, nd,
+                                start=ROUNDS_PRE, stream=seed)
+                rel = [abs(a - c) / c for a, c in zip(losses, base)]
+                results[pol][nd].append({
+                    "seed": seed,
+                    "max_rel_dev": round(max(rel), 4),
+                    "final3_mean": round(float(np.mean(losses[-3:])), 5),
+                    "base_final3_mean": round(
+                        float(np.mean(base[-3:])), 5),
+                    "descending": bool(np.mean(losses[-3:]) < losses[0]),
+                })
+                print(f"seed {seed} 8->{nd} {pol:12s} "
+                      f"max_rel={max(rel):.3f} "
+                      f"final3={np.mean(losses[-3:]):.4f} "
+                      f"(base {np.mean(base[-3:]):.4f})")
+
+    summary = {}
+    for pol in POLICIES:
+        worst = max(r["max_rel_dev"] for nd in (4, 2)
+                    for r in results[pol][nd])
+        per_nd = {str(nd): round(max(r["max_rel_dev"]
+                                     for r in results[pol][nd]), 4)
+                  for nd in (4, 2)}
+        summary[pol] = {"worst_max_rel_dev": worst, "per_nd": per_nd,
+                        "all_descending": all(
+                            r["descending"] for nd in (4, 2)
+                            for r in results[pol][nd])}
+    winner = min((p for p in POLICIES
+                  if summary[p]["all_descending"]),
+                 key=lambda p: summary[p]["worst_max_rel_dev"])
+    out = {"task": "TINY_MLP trajectory-band (tests/test_apps.py harness), "
+                   "3 seeds, 8->4 and 8->2 resumes, 8 post-resume rounds",
+           "results": results, "summary": summary, "winner": winner}
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "ELASTIC_AB_r05.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"\nwinner: {winner}  (summary: {json.dumps(summary)})")
+
+
+if __name__ == "__main__":
+    main()

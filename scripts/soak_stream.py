@@ -44,7 +44,18 @@ def main() -> None:
     p.add_argument("--per-shard", type=int, default=256)
     p.add_argument("--keep", action="store_true",
                    help="keep the temp shard/work dirs (default: removed)")
+    p.add_argument("--sample-every", type=int, default=50,
+                   help="rounds between RSS samples")
+    p.add_argument("--cpu-control", action="store_true",
+                   help="run the IDENTICAL loop on the CPU backend at the "
+                   "SAME shapes (r5: the r4 control ran ~1 MB rounds vs "
+                   "the TPU run's 4.3 MB — a size-dependent framework "
+                   "leak would have hidden; this control is size-matched)")
     args = p.parse_args()
+
+    if args.cpu_control:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
 
     from sparknet_tpu.apps.train_loop import train
     from sparknet_tpu.data import imagenet
@@ -81,7 +92,7 @@ def main() -> None:
     partial_path = args.out + ".partial.jsonl"
 
     def hook(rnd, state):
-        if rnd % 50 == 0:
+        if rnd % args.sample_every == 0:
             s = {"round": rnd, "rss_mb": round(rss_mb(), 1),
                  "wall_s": round(time.time() - t0, 1),
                  "skipped": int(src.skipped)}
@@ -106,6 +117,8 @@ def main() -> None:
         rss = [s["rss_mb"] for s in samples]
         result = {
             "rounds": args.rounds,
+            "backend": "cpu-control" if args.cpu_control else "device",
+            "round_batch_mb": round(tau * b * crop * crop * 3 * 2 / 1e6, 2),
             "images": args.rounds * b * tau,
             "wall_s": round(time.time() - t0, 1),
             "readers": src.n_sources,

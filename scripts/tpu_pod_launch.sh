@@ -64,6 +64,10 @@
 #   TPU_STAGE_DIR    dataset dir watch/resume re-stages after a recreate
 #   TPU_POLL_SECS    watch's between-retry poll interval (default 60);
 #                    also the backoff after a FAILED recreate (stockout)
+#   TPU_PROGRESS_SECS  a failed run that lasted at least this long
+#                    (default 900) counts as having made progress: its
+#                    failure resets watch's consecutive-failure count
+#                    instead of accumulating across a multi-day run
 #   ALLOW_NO_NATIVE=1  continue setup if the C++ data plane fails to build
 #
 # Multi-host run path: `run` executes the SAME command on every worker
@@ -88,6 +92,7 @@ TPU="gcloud compute tpus tpu-vm"
 QR="gcloud compute tpus queued-resources"
 TPU_SW_VERSION="${TPU_SW_VERSION:-v2-alpha-tpuv5-lite}"
 TPU_POLL_SECS="${TPU_POLL_SECS:-60}"
+TPU_PROGRESS_SECS="${TPU_PROGRESS_SECS:-900}"
 
 spot_flag() { [ -n "${TPU_SPOT:-}" ] && echo "--spot" || true; }
 
@@ -224,11 +229,27 @@ case "$CMD" in
         sleep "$TPU_POLL_SECS"; continue
       fi
       [ -z "$RECREATED" ] || ready_fails=0
+      run_began=$(date +%s)
       if do_run "$ARG2"; then
         echo "watch: command completed" >&2; break
       fi
+      run_secs=$(( $(date +%s) - run_began ))
       s=$(vm_state)
       if [ "$s" = "READY" ]; then
+        # a run that survived >= TPU_PROGRESS_SECS before dying made real
+        # progress (checkpoint resume turns its re-run into a
+        # continuation), so its failure doesn't count as a strike AT ALL
+        # — a multi-day run that ate one transient ssh drop in hour 1
+        # must not hard-exit on a second unrelated drop in hour 30. Only
+        # fast CONSECUTIVE failures (two in a row, each under the
+        # threshold) indicate a deterministic app error.
+        if [ "$run_secs" -ge "$TPU_PROGRESS_SECS" ]; then
+          ready_fails=0
+          echo "watch: run failed after ${run_secs}s of progress;" \
+               "strike count reset, retrying (checkpoint resume makes" \
+               "the re-run a continuation)" >&2
+          sleep "$TPU_POLL_SECS"; continue
+        fi
         ready_fails=$((ready_fails + 1))
         if [ "$ready_fails" -ge 2 ]; then
           echo "watch: command failed twice on a READY pod — app error," \

@@ -308,6 +308,19 @@ def prepare_data(cfg: RunConfig, args, label_shape: Tuple[int, ...] = (1,),
             total = len(imagenet.list_shards(cfg.data_dir,
                                              prefix=args.train_prefix))
             eff = max(1, min(cfg.ingest_sources, total // pc))
+            # ParallelStreamingSource requires n_sources | round_examples;
+            # the clamp above can land on a non-divisor (e.g. 112 shards /
+            # 16 hosts -> eff=7 vs round 5120) even when the operator's
+            # request was valid. Round DOWN to the nearest divisor (1 is
+            # always reachable) instead of aborting on a computed value.
+            round_examples = n_local * cfg.local_batch * cfg.tau
+            while round_examples % eff:
+                eff -= 1
+            if eff != cfg.ingest_sources:
+                print(f"{app_name}: ingest_sources reduced "
+                      f"{cfg.ingest_sources} -> {eff} "
+                      f"(shards={total}, hosts={pc}, "
+                      f"round={round_examples})", file=sys.stderr)
             train_raw = make_parallel_source(
                 train_loader.shard_paths, train_loader.label_map,
                 n_local, cfg.local_batch, cfg.tau, eff,

@@ -34,6 +34,7 @@ import sys
 import time
 import urllib.error
 import urllib.parse
+import warnings
 import urllib.request
 from typing import List, Optional, Tuple
 
@@ -231,6 +232,14 @@ class GcsRangeStream(io.RawIOBase):
         # EOF (a silently shortened tar would drop examples)
         cl = self._resp.headers.get("Content-Length")
         self._end = self._pos + int(cl) if cl is not None else None
+        if self._end is None:
+            # chunked-transfer proxy/emulator: a dropped connection then
+            # looks exactly like EOF — truncation detection is OFF. Say
+            # so once rather than silently degrade.
+            warnings.warn(
+                f"gcs: no Content-Length for gs://{self._bucket}/"
+                f"{self._name} — truncated-body detection disabled for "
+                f"this stream", RuntimeWarning, stacklevel=2)
 
     def readable(self) -> bool:
         return True

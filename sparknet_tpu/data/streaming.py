@@ -55,8 +55,11 @@ def streaming_sum_count(loader: ShardedTarLoader, workers: int = 1
     (decode and pread release the GIL): on real ImageNet this one-time
     pass decodes the host's whole corpus, which at a single reader's rate
     is tens of minutes a 40-core host spends 97% idle. Partial sums are
-    float64 and addition-reordering-exact, so the result is identical to
-    the serial pass."""
+    float64, and the per-subset partials are reduced in a fixed (subset-
+    index) order, so the result is deterministic for a given worker
+    count; it equals the serial pass up to float64 summation order (~1
+    ulp on uint8-sourced pixels), not bit-for-bit, since grouping
+    additions by subset reorders them."""
 
     def one(sub: ShardedTarLoader) -> Tuple[Optional[np.ndarray], int]:
         total: Optional[np.ndarray] = None

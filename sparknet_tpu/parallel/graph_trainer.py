@@ -101,8 +101,11 @@ class GraphTrainer:
         count (`checkpoint.restore_flat` output; keys 'variables/<name>',
         'slots/<name>', 'it'). Variables are replica-identical after a
         round (float ones pmean'd, int counters advance in lockstep) so
-        row 0 is THE value; worker-local slots are averaged over the old
-        workers (best effort, same policy as ParallelTrainer). A
+        row 0 is THE value; worker-local slots are plain-averaged over
+        the old workers (ParallelTrainer's pre-r5 policy — its r5 A/B
+        winner, norm-rescaling, was validated on the layer-IR backend's
+        Caffe-style velocity, not on in-graph slot variables, so the
+        graph backend keeps the plain mean). A
         checkpoint that does not cover this graph's variables (wrong
         backend / wrong graph) fails loudly, like the same-topology path."""
         if old_tp != 1:

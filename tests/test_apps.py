@@ -351,11 +351,13 @@ def _to_nhwc_eval(arrays):
 def test_elastic_resume_momentum_trajectory_band(tmp_path):
     """Momentum handling across an elastic resume, validated on the
     TRAJECTORY (r3 review item 6): continuing an 8-device run at 4 and at
-    2 devices (momentum averaged over old data groups, adapt_state) keeps
-    every subsequent round's loss within 50% of the uninterrupted
-    8-device run (measured: <=10% at 4 dev, <=31% at 2 dev — the band
-    documented at ParallelTrainer.adapt_state) and still descending;
-    a same-topology pass through adapt_state is exact to float noise."""
+    2 devices (norm-rescaled momentum average — the policy that won the
+    r5 A/B, scripts/elastic_momentum_ab.py / ELASTIC_AB_r05.json) keeps
+    every subsequent round's loss within 15% / 40% of the uninterrupted
+    8-device run (measured: <=10% at 4 dev, <=31% at 2 dev across 3
+    seeds — the band documented at ParallelTrainer.adapt_state) and
+    still descending; a same-topology pass through adapt_state is exact
+    to float noise."""
     import jax
     from sparknet_tpu import CompiledNet, net_from_prototxt
     from sparknet_tpu.parallel import ParallelTrainer, make_mesh
@@ -390,16 +392,18 @@ def test_elastic_resume_momentum_trajectory_band(tmp_path):
     flat, _, _ = ck.restore_flat(d)
     _, base = run(t8, s, 8, 8, start=4)  # uninterrupted continuation
 
-    # same topology through adapt_state: float noise only
+    # same topology through adapt_state: per-worker momentum rows are
+    # restored as written (no reconstruction policy) — exact to float
+    # noise of the save/restore round-trip
     t8b = ParallelTrainer(net, scfg, make_mesh(8), tau=tau)
     _, same = run(t8b, t8b.adapt_state(flat), 8, 8, start=4)
-    assert max(abs(a - c) / c for a, c in zip(same, base)) < 0.01
+    assert max(abs(a - c) / c for a, c in zip(same, base)) < 1e-5
 
-    for nd in (4, 2):
+    for nd, band in ((4, 0.15), (2, 0.40)):
         t = ParallelTrainer(net, scfg, make_mesh(nd), tau=tau)
         _, losses = run(t, t.adapt_state(flat), 8, nd, start=4)
         rel = [abs(a - c) / c for a, c in zip(losses, base)]
-        assert max(rel) < 0.5, (nd, losses, base)
+        assert max(rel) < band, (nd, losses, base)
         # and the continued run still LEARNS (not just stays close)
         assert np.mean(losses[-3:]) < losses[0], (nd, losses)
 

@@ -1,0 +1,150 @@
+"""Collective-traffic pin for the distributed round.
+
+BASELINE.md's >=90%-scaling claim rests on the round moving EXACTLY one
+copy of the net's parameters per τ-round (weight pmean; momentum stays
+worker-local — reference `libs/CaffeNet.scala:123-137` only ships net
+blobs). PERF.md §ici-scaling-model turns that byte count into predicted
+efficiency at 8/16/32 chips; this test pins the byte count itself by
+inspecting the compiled round's optimized HLO, so an accidental extra
+all-gather / per-step sync / momentum-on-the-wire regression fails CI
+instead of silently halving the predicted scaling.
+
+Pinned properties (on the 8-virtual-device CPU mesh, caffenet shapes):
+  1. bytes all-reduced per round ≈ one per-replica copy of the params
+     (+ the scalar loss pmean) — NOT ×τ, NOT params+momentum;
+  2. τ-invariance: compiling at τ=2 and τ=4 moves identical bytes
+     (averaging is per-round, never per-step);
+  3. op-count sanity: the number of collective ops stays bounded by the
+     param-leaf count + loss (XLA's combiner may merge below that).
+"""
+import re
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from sparknet_tpu import CompiledNet
+from sparknet_tpu.parallel import ParallelTrainer, make_mesh
+from sparknet_tpu.parallel.mesh import DATA_AXIS, place_global_state
+from sparknet_tpu.solver import SolverConfig
+from sparknet_tpu.zoo import caffenet
+
+N_DEV = 8
+LOCAL_B = 4
+CROP = 67
+N_CLASSES = 16
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2, "s16": 2,
+                "u16": 2, "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8,
+                "u64": 8}
+
+# result shapes of an HLO op line: `f32[1,96,3,11,11]{4,3,2,1,0}` tokens
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def _collective_lines(hlo: str):
+    """(op_kind, result_bytes) for every collective in the optimized HLO.
+
+    `-start` variants are the async halves of the same op — counting
+    `-done` too would double; we take only starts + synchronous forms."""
+    out = []
+    for line in hlo.splitlines():
+        m = re.search(r"= (.+?) (all-reduce|all-gather|reduce-scatter|"
+                      r"all-to-all|collective-permute)"
+                      r"(-start)?\(", line)
+        if not m:
+            continue
+        result, kind = m.group(1), m.group(2)
+        nbytes = 0
+        for dt, dims in _SHAPE_RE.findall(result):
+            if dt not in _DTYPE_BYTES:
+                continue  # layout annotation like {4,3,2,1,0}
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            nbytes += n * _DTYPE_BYTES[dt]
+        out.append((kind, nbytes))
+    return out
+
+
+def _build(tau: int):
+    net = CompiledNet.compile(
+        caffenet(batch=LOCAL_B, crop=CROP, n_classes=N_CLASSES))
+    mesh = make_mesh(N_DEV)
+    trainer = ParallelTrainer(
+        net, SolverConfig(base_lr=0.01, momentum=0.9, weight_decay=5e-4,
+                          lr_policy="fixed"), mesh, tau=tau)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    r = np.random.default_rng(0)
+    batches = {
+        "data": r.standard_normal(
+            (tau, N_DEV * LOCAL_B, CROP, CROP, 3)).astype(np.float32),
+        "label": r.integers(0, N_CLASSES,
+                            (tau, N_DEV * LOCAL_B, 1)).astype(np.int32)}
+    sharded = trainer._shard_batches(batches)
+    rngs = place_global_state(
+        jax.random.split(jax.random.PRNGKey(1), N_DEV),
+        trainer.mesh, P(DATA_AXIS))
+    return trainer, state, sharded, rngs
+
+
+def _round_collectives(tau: int):
+    trainer, state, sharded, rngs = _build(tau)
+    hlo = trainer._round.lower(state, sharded, rngs).compile().as_text()
+    per_replica_param_bytes = sum(
+        int(np.prod(leaf.shape[1:])) * leaf.dtype.itemsize
+        for lp in jax.tree.leaves(
+            state.params, is_leaf=lambda x: hasattr(x, "shape"))
+        for leaf in [lp])
+    n_leaves = len(jax.tree.leaves(state.params))
+    return _collective_lines(hlo), per_replica_param_bytes, n_leaves
+
+
+@pytest.fixture(scope="module")
+def tau2():
+    return _round_collectives(2)
+
+
+def test_round_moves_one_param_copy(tau2):
+    colls, param_bytes, n_leaves = tau2
+    assert colls, "no collectives found in the compiled round HLO"
+    kinds = {k for k, _ in colls}
+    # DP round: weight average + loss average are pmean -> all-reduce.
+    # Anything else on the wire is a regression.
+    assert kinds == {"all-reduce"}, f"unexpected collectives: {kinds}"
+    total = sum(b for _, b in colls)
+    # one param copy + the f32 loss scalar (combiner padding tolerance 1%)
+    assert param_bytes <= total <= int(param_bytes * 1.01) + 256, (
+        f"round all-reduces {total} bytes; params are {param_bytes} — "
+        f"{'momentum or batch data is on the wire' if total > param_bytes * 1.5 else 'short of one param copy'}")
+    assert len(colls) <= n_leaves + 1, (
+        f"{len(colls)} collective ops for {n_leaves} param leaves")
+
+
+def test_round_collective_bytes_tau_invariant(tau2):
+    colls2, param_bytes, _ = tau2
+    colls4, _, _ = _round_collectives(4)
+    assert sum(b for _, b in colls2) == sum(b for _, b in colls4), (
+        "collective bytes grew with tau — averaging has become per-step")
+
+
+def test_perf_md_documents_the_measured_bytes(tau2):
+    """PERF.md's ICI model must quote the same per-round byte count this
+    pin measures (so the analytic scaling numbers can't drift from the
+    compiled program)."""
+    _, param_bytes, _ = tau2
+    # the model is written for the FULL caffenet (crop 227, 1000 classes);
+    # recompute its param bytes analytically from the zoo spec
+    net = CompiledNet.compile(caffenet(batch=4, crop=227, n_classes=1000))
+    params = net.init_params(jax.random.PRNGKey(0))
+    full_bytes = sum(l.nbytes for l in jax.tree.leaves(params))
+    import pathlib
+    perf = pathlib.Path(__file__).resolve().parent.parent / "PERF.md"
+    text = perf.read_text()
+    mb = full_bytes / 1e6
+    assert f"{mb:.0f} MB" in text or f"{mb:.1f} MB" in text, (
+        f"PERF.md ici-scaling section must quote the pinned param volume "
+        f"({mb:.1f} MB)")

@@ -253,9 +253,17 @@ def test_resume_casts_momentum_to_configured_velocity_dtype(net, cfg, tmp_path):
         assert leaf.dtype == jnp.bfloat16
     for leaf in jax.tree.leaves(restored.params):
         assert leaf.dtype == jnp.float32  # params untouched
-    # elastic path (adapt_state -> state_from_params -> place)
-    adapted = bf16.adapt_state(flat)
+    # elastic path (adapt_state -> state_from_params -> place): use a
+    # DIFFERENT device count, or the r5 same-topology shortcut bypasses
+    # the reassembly this is meant to pin
+    bf16_half = ParallelTrainer(net, replace(cfg, velocity_dtype="bfloat16"),
+                                make_mesh(N_DEV // 2), tau=TAU)
+    adapted = bf16_half.adapt_state(flat)
     for leaf in jax.tree.leaves(adapted.momentum):
+        assert leaf.dtype == jnp.bfloat16
+    # and the same-topology shortcut path casts too
+    adapted_same = bf16.adapt_state(flat)
+    for leaf in jax.tree.leaves(adapted_same.momentum):
         assert leaf.dtype == jnp.bfloat16
     # the restored state trains (dtype layout matches the jitted round)
     restored, loss = bf16.train_round(restored, make_round_batches(1),
