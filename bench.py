@@ -204,7 +204,7 @@ def scaling(max_devices: int = 8, virtual: bool = True) -> dict:
     return result
 
 
-def e2e(sources: int = 1) -> dict:
+def e2e(sources: int = 1, store: str | None = None) -> dict:
     """End-to-end input-pipeline benchmark (SURVEY §7 hard-part #3: don't
     starve the chips).
 
@@ -229,8 +229,15 @@ def e2e(sources: int = 1) -> dict:
     is ~1000x that), so a tunnel-coupled e2e run measures the tunnel. The
     integrated loop (streaming source + preprocessor + trainer on the real
     chip) is instead proven by the app tests and the --e2e-smoke mode.
+
+    --store gs serves the same shards from a local fake-GCS server
+    (tests/fake_stores.py) and streams them as gs:// urls — the r5
+    bucket-path residue measurement (ranged HTTP streams + the member
+    carve path instead of local pread; the HTTP server's own CPU runs on
+    separate threads and is excluded by the thread-CPU accounting).
     """
     import os
+    import sys as _sys
     import tempfile
 
     from sparknet_tpu import precision
@@ -254,9 +261,24 @@ def e2e(sources: int = 1) -> dict:
             n_classes=1000, size=size)
         label_map = imagenet.load_label_map(os.path.join(root, "train.txt"))
         shards = imagenet.list_shards(root)
+        server = None
+        if store == "gs":
+            _sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tests"))
+            from fake_stores import serve_dir_as_gcs
+            server, endpoint = serve_dir_as_gcs(root)
+            os.environ["STORAGE_EMULATOR_HOST"] = endpoint
+            os.environ["no_proxy"] = "*"
+            shards = imagenet.list_shards("gs://bkt/imagenet")
+            assert len(shards) == n_shards, shards
+        elif store is not None:
+            raise SystemExit(f"--store {store!r}: only 'gs' is served "
+                             f"locally")
 
         # raw decode floor: the decode plane alone, bytes already in RAM
-        loader = imagenet.ShardedTarLoader(shards, label_map,
+        # (always from the LOCAL files — the floor is store-independent)
+        loader = imagenet.ShardedTarLoader(imagenet.list_shards(root),
+                                           label_map,
                                            height=size, width=size)
         raw = [d for d, _, _ in _tar_entries(loader, 256)]
         t0 = time.perf_counter()
@@ -297,6 +319,9 @@ def e2e(sources: int = 1) -> dict:
 
         e2e_rate, stats = measure(sources)
         base_stats = measure(1)[1] if sources > 1 else stats
+        if server is not None:
+            server.shutdown()
+            os.environ.pop("STORAGE_EMULATOR_HOST", None)
 
     device_rate = None
     try:
@@ -335,6 +360,7 @@ def e2e(sources: int = 1) -> dict:
         "vs_baseline": round(e2e_rate / 256.0, 3),  # reference CI floor:
         # 256 images preprocessed/sec/thread (PreprocessorSpec.scala:75)
         "sources": sources,
+        "store": store or "local",
         "decode_only_images_per_sec": round(decode_rate, 1),
         "pipeline_efficiency_vs_decode": round(e2e_rate / decode_rate, 3),
         "host_cores": os.cpu_count(),
@@ -527,6 +553,9 @@ def main() -> None:
                    help="concurrent shard readers for --e2e (N>1 also "
                    "measures the 1-reader baseline for the serial-residue "
                    "division)")
+    p.add_argument("--store", default=None, choices=("gs",),
+                   help="--e2e through a local fake object store instead "
+                   "of local files (bucket-path residue)")
     p.add_argument("--e2e-smoke", action="store_true",
                    help="full streaming loop on the real chip, small shapes")
     p.add_argument("--graph", action="store_true",
@@ -543,7 +572,7 @@ def main() -> None:
     if args.scaling:
         scaling()
     elif args.e2e:
-        e2e(sources=args.sources)
+        e2e(sources=args.sources, store=args.store)
     elif args.e2e_smoke:
         e2e_smoke()
     elif args.graph:

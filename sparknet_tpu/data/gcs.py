@@ -351,9 +351,11 @@ def gs_list_shards(root: str, prefix: str = "") -> List[str]:
     return sorted(out)
 
 
-def gs_size(url: str) -> int:
-    """Object byte size: listing cache first, else one metadata GET."""
-    if url in _SIZE_CACHE:
+def gs_size(url: str, fresh: bool = False) -> int:
+    """Object byte size: listing cache first, else one metadata GET.
+    `fresh=True` bypasses the cache (one metadata GET) — used to detect
+    an object replaced under a warm member index."""
+    if not fresh and url in _SIZE_CACHE:
         return _SIZE_CACHE[url]
     bucket, name = parse_gs_url(url)
     client = _shared_client()

@@ -62,16 +62,17 @@ def list_shards(root: str, prefix: str = "") -> List[str]:
     return shards
 
 
-def path_size(path: str) -> int:
+def path_size(path: str, fresh: bool = False) -> int:
     """Byte size of a local file or gs://|s3:// object (shard-weight
     estimates and corpus identity use sizes; bucket sizes come from the
-    listing metadata, cached — no extra round trip per shard)."""
+    listing metadata, cached — no extra round trip per shard).
+    `fresh=True` bypasses the bucket caches with one metadata request."""
     from .gcs import gs_size, is_gs_path
     from .s3 import is_s3_path, s3_size
     if is_gs_path(path):
-        return gs_size(path)
+        return gs_size(path, fresh=fresh)
     if is_s3_path(path):
-        return s3_size(path)
+        return s3_size(path, fresh=fresh)
     return os.path.getsize(path)
 
 
@@ -99,9 +100,12 @@ def _open_tar(path: str) -> tarfile.TarFile:
     """Local shards open seekably; gs://|s3:// shards open as ONE streamed
     ranged GET (`r|` mode) with transparent reconnect-resume — the
     per-task streamed GetObject of the reference
-    (`ImageNetLoader.scala:62-63`). Entry-skip on resume reads through
-    the stream (tar offsets of entry N are unknown without an index),
-    which costs one partial shard download once per restart."""
+    (`ImageNetLoader.scala:62-63`). Entry-skip on a COLD resume reads
+    through the stream (tar offsets of entry N are unknown without an
+    index), costing one partial shard download once per restart; once a
+    full pass has captured the member index (r5,
+    `ShardedTarLoader._bucket_indices`), later epochs and warm resumes
+    carve members by (offset, size) and open AT the target byte."""
     from .gcs import gs_open_stream, is_gs_path
     from .s3 import is_s3_path, s3_open_stream
     if is_gs_path(path):
@@ -148,6 +152,10 @@ class ShardedTarLoader:
         self.width = width
         self.skipped = 0  # corrupt/unlabeled entries (counted, never looped on)
         self._tar_indices: Dict[str, object] = {}  # path -> C member index
+        #: bucket url -> [(offset_data, size, isfile, basename)] captured
+        #: during the first full tarfile walk; epoch >= 2 carves members
+        #: from the ranged stream directly (no per-member header parsing)
+        self._bucket_indices: Dict[str, list] = {}
         #: cumulative seconds inside decode calls (the OpenMP-parallel
         #: stage) — wall and calling-thread CPU. Pipeline benchmarks
         #: subtract the CPU figure from the producer's CPU time to get the
@@ -222,7 +230,30 @@ class ShardedTarLoader:
                             f"truncated?")
                     yield data, label, (si, e + 1)
             return
-        if not path.startswith(("gs://", "s3://")):
+        is_bucket = path.startswith(("gs://", "s3://"))
+        if is_bucket:
+            cached = self._bucket_indices.get(path)
+            if cached is not None:
+                bidx, size_at_capture = cached
+                # a replaced object makes the recorded offsets garbage:
+                # one fresh metadata request per shard per epoch catches
+                # any size change and falls back to the tarfile walk
+                # (which re-captures). An EQUAL-size replacement still
+                # slips through — its members then fail JPEG decode and
+                # show in `skipped`, which the apps surface.
+                if path_size(path, fresh=True) != size_at_capture:
+                    del self._bucket_indices[path]
+                else:
+                    # epoch >= 2 (or post-resume with a warm index):
+                    # carve members straight out of ONE ranged stream by
+                    # recorded (offset, size) — no tarfile header
+                    # parsing, and the stream OPENS at the first needed
+                    # byte, so a mid-shard resume skips the prefix
+                    # download entirely
+                    yield from self._bucket_entries_indexed(path, si,
+                                                            skip, bidx)
+                    return
+        else:
             # tarfile iterates a boundary-truncated archive SILENTLY; the
             # C indexer catches it via the missing terminator, and this
             # closes the same hole on the fallback path (no native plane,
@@ -230,10 +261,15 @@ class ShardedTarLoader:
             # consistently by the store, so a truncated UPLOAD is the
             # uploader's bug — each ranged read is still length-checked.
             _check_tar_terminator(path)
+        index = []  # (offset_data, size, isfile, basename) per member
         with _open_tar(path) as tar:
             entry = 0
             for member in tar:  # ALWAYS advances (bug fix vs reference)
                 entry += 1
+                if is_bucket:
+                    index.append((member.offset_data, member.size,
+                                  member.isfile(),
+                                  os.path.basename(member.name)))
                 if entry <= skip or not member.isfile():
                     continue
                 name = os.path.basename(member.name)
@@ -242,6 +278,64 @@ class ShardedTarLoader:
                     self.skipped += 1
                     continue
                 yield tar.extractfile(member).read(), label, (si, entry)
+        if is_bucket and skip == 0:
+            # cache only a COMPLETE walk (a partial index would silently
+            # shorten the shard); skip>0 walks are resume continuations.
+            # The size rides along for the staleness check above.
+            self._bucket_indices[path] = (index, path_size(path,
+                                                           fresh=True))
+
+    #: forward gaps below this are read-and-discarded on the carve path;
+    #: larger ones reopen the ranged stream at the target offset
+    BUCKET_REOPEN_GAP = 1 << 20
+
+    def _bucket_entries_indexed(self, path: str, si: int, skip: int, index
+                                ) -> Iterator[Tuple[bytes, int,
+                                                    Tuple[int, int]]]:
+        """Indexed bucket read: one sequential ranged GET per epoch (like
+        the tarfile path) but members sliced by recorded (offset, size) —
+        the Python tar-header walk the C indexer removed for local shards
+        (PERF.md input pipeline) is gone here too. Short reads fail
+        loudly: a shortened member must not decode as routine corruption."""
+        from .gcs import gs_open_stream, is_gs_path
+        from .s3 import s3_open_stream
+        opener = gs_open_stream if is_gs_path(path) else s3_open_stream
+        stream, pos = None, 0
+        try:
+            for e in range(skip, len(index)):
+                offset, size, isfile, name = index[e]
+                if not isfile:
+                    continue
+                label = self.label_map.get(name)
+                if label is None:
+                    self.skipped += 1
+                    continue
+                if stream is None or offset - pos > self.BUCKET_REOPEN_GAP:
+                    if stream is not None:
+                        stream.close()
+                    stream, pos = opener(path, start=offset), offset
+                while pos < offset:  # discard inter-member gap
+                    chunk = stream.read(min(offset - pos, 1 << 16))
+                    if not chunk:
+                        raise IOError(f"{path}: EOF in gap before member "
+                                      f"{e + 1} at byte {pos}")
+                    pos += len(chunk)
+                parts = []
+                need = size
+                while need:
+                    chunk = stream.read(need)
+                    if not chunk:
+                        raise IOError(
+                            f"{path}: short read at member {e + 1} "
+                            f"({size - need} of {size} bytes) — object "
+                            f"shorter than its index?")
+                    parts.append(chunk)
+                    need -= len(chunk)
+                pos = offset + size
+                yield b"".join(parts), label, (si, e + 1)
+        finally:
+            if stream is not None:
+                stream.close()
 
     def _tar_index(self, path: str):
         """Cached C member index for a LOCAL shard; None -> tarfile path

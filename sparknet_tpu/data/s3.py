@@ -267,9 +267,9 @@ def s3_write(url: str, data: bytes) -> None:
     _SIZE_CACHE[url] = len(data)
 
 
-def s3_size(url: str) -> int:
+def s3_size(url: str, fresh: bool = False) -> int:
     import urllib.error
-    if url in _SIZE_CACHE:
+    if not fresh and url in _SIZE_CACHE:
         return _SIZE_CACHE[url]
     bucket, key = parse_s3_url(url)
     client = _shared_client()

@@ -80,3 +80,98 @@ def mean_image(seed: int = 0, n: int = 2000) -> np.ndarray:
     and keeps artifact generation fast)."""
     images, _ = synthetic_cifar(n, seed=seed)
     return images.mean(axis=0)
+
+
+# -- ImageNet-shaped corpus (r5: CaffeNet-scale convergence evidence) --------
+
+IMAGENET_SIZE = 256
+IMAGENET_CLASSES = 64
+_IN_SHIFT = 24     # max |dx|, |dy| translation (vs the 227/256 crop's 29)
+_IN_NOISE = 35.0   # pixel noise std (survives JPEG q=90: smooth template
+                   # carries the class signal, noise is the nuisance)
+_IN_AMP = 45.0     # template amplitude around mid-gray
+_IN_BRIGHT = 20.0  # per-image brightness jitter
+
+
+def imagenet_templates(seed: int = 0,
+                       n_classes: int = IMAGENET_CLASSES) -> np.ndarray:
+    """[C, 3, 256, 256] smooth random templates: 16x16 gaussian fields
+    bilinearly upsampled (same construction as the CIFAR stand-in at 4x
+    the spatial detail — enough structure that conv1 11x11/4 features,
+    LRN and the grouped tail all see realistic activation ranges)."""
+    r = np.random.default_rng((seed, 0x1A6E7))
+    low = r.standard_normal((n_classes, 3, 16, 16))
+    size = IMAGENET_SIZE
+    xs = np.linspace(0, 15, size)
+    i0 = np.clip(np.floor(xs).astype(int), 0, 14)
+    frac = xs - i0
+    up = low[..., i0, :] * (1 - frac)[None, None, :, None] + \
+        low[..., i0 + 1, :] * frac[None, None, :, None]
+    up = up[..., i0] * (1 - frac) + up[..., i0 + 1] * frac
+    return (128.0 + _IN_AMP * up / np.abs(up).max()).astype(np.float32)
+
+
+def synthetic_imagenet(n: int, seed: int = 0, start: int = 0,
+                       n_classes: int = IMAGENET_CLASSES):
+    """Examples [start, start+n): (images [n, 256, 256, 3] uint8 HWC —
+    JPEG-encodable, unlike the float CIFAR stand-in; labels [n] int32,
+    balanced i % n_classes). Each example is its class template randomly
+    shifted (edge-padded) + brightness jitter + pixel noise, clipped to
+    uint8. Deterministic in (seed, index)."""
+    tmpl = imagenet_templates(seed, n_classes)
+    s = _IN_SHIFT
+    pad = np.pad(tmpl, ((0, 0), (0, 0), (s, s), (s, s)), mode="edge")
+    size = IMAGENET_SIZE
+    images = np.empty((n, size, size, 3), np.uint8)
+    labels = np.empty((n,), np.int32)
+    for j in range(n):
+        i = start + j
+        r = np.random.default_rng((seed, 2, i))
+        c = i % n_classes
+        dy, dx = r.integers(-s, s + 1, 2)
+        base = pad[c, :, s + dy:s + dy + size, s + dx:s + dx + size]
+        img = (base + r.uniform(-_IN_BRIGHT, _IN_BRIGHT)
+               + _IN_NOISE * r.standard_normal((3, size, size),
+                                               np.float32))
+        images[j] = np.clip(img, 0, 255).astype(np.uint8).transpose(1, 2, 0)
+        labels[j] = c
+    return images, labels
+
+
+def write_synthetic_ilsvrc_tar(path: str, n: int, seed: int = 0,
+                               n_classes: int = IMAGENET_CLASSES,
+                               quality: int = 90) -> None:
+    """Write an ILSVRC2012-layout training tar-of-tars (outer tar of
+    per-synset `nXXXXXXXX.tar` members, each holding that class's JPEGs)
+    from the synthetic corpus — so `scripts/shard_imagenet.py` ingests it
+    through EXACTLY the path real ImageNet takes (synset discovery,
+    sorted-synset labels, shuffle, re-shard). Synset c is named
+    f"n{c:08d}", so sorted order == label order == template index."""
+    import io
+    import tarfile
+
+    from PIL import Image
+
+    members = {c: io.BytesIO() for c in range(n_classes)}
+    inner = {c: tarfile.open(fileobj=members[c], mode="w")
+             for c in range(n_classes)}
+    chunk = 512
+    for s0 in range(0, n, chunk):
+        images, labels = synthetic_imagenet(min(chunk, n - s0), seed=seed,
+                                            start=s0, n_classes=n_classes)
+        for k in range(len(labels)):
+            c = int(labels[k])
+            buf = io.BytesIO()
+            Image.fromarray(images[k]).save(buf, format="JPEG",
+                                            quality=quality)
+            data = buf.getvalue()
+            info = tarfile.TarInfo(name=f"n{c:08d}_{s0 + k}.JPEG")
+            info.size = len(data)
+            inner[c].addfile(info, io.BytesIO(data))
+    with tarfile.open(path, "w") as outer:
+        for c in range(n_classes):
+            inner[c].close()
+            blob = members[c].getvalue()
+            info = tarfile.TarInfo(name=f"n{c:08d}.tar")
+            info.size = len(blob)
+            outer.addfile(info, io.BytesIO(blob))

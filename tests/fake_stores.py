@@ -1,0 +1,121 @@
+"""Local fake object-store servers shared by the gs:// tests, the chaos
+test's bucket variant, and `bench.py --e2e --store gs` (the bucket-path
+ingest measurement). Moved out of test_gcs.py in r5 so non-pytest callers
+(bench, chaos subprocesses) can serve a bucket without importing a test
+module's fixtures.
+"""
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import threading
+import urllib.parse
+
+
+class FakeGcsHandler(http.server.BaseHTTPRequestHandler):
+    """JSON-API subset: paginated listing, alt=media with Range, ?fields=size.
+    Knobs (class attrs set by the caller):
+      fail_once    — object names whose next media GET truncates mid-body
+                     (Content-Length lies), exercising reconnect-resume
+      ignore_range — serve 200-from-zero despite a Range header (a broken
+                     middlebox); the client must fail loudly, not corrupt
+    """
+    objects = {}
+    fail_once = set()
+    ignore_range = False
+    page_size = 2
+    range_log = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        parsed = urllib.parse.urlparse(self.path)
+        qs = urllib.parse.parse_qs(parsed.query)
+        parts = parsed.path.split("/")
+        # /storage/v1/b/<bucket>/o[/<name>]
+        if len(parts) < 6 or parts[1:4] != ["storage", "v1", "b"] or \
+                parts[5] != "o":
+            self.send_error(404)
+            return
+        if len(parts) == 6:  # listing
+            prefix = qs.get("prefix", [""])[0]
+            names = sorted(n for n in self.objects if n.startswith(prefix))
+            start = int(qs.get("pageToken", ["0"])[0])
+            page = names[start:start + self.page_size]
+            d = {"items": [{"name": n, "size": str(len(self.objects[n]))}
+                           for n in page]}
+            if start + self.page_size < len(names):
+                d["nextPageToken"] = str(start + self.page_size)
+            self._json(d)
+            return
+        name = urllib.parse.unquote(parts[6])
+        if name not in self.objects:
+            self.send_error(404)
+            return
+        data = self.objects[name]
+        if qs.get("alt") == ["media"]:
+            start = 0
+            rng = self.headers.get("Range")
+            if rng:
+                type(self).range_log.append((name, rng))
+            if rng and not self.ignore_range:
+                start = int(rng.split("=")[1].split("-")[0])
+                self.send_response(206)
+            else:
+                self.send_response(200)
+            body = data[start:]
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if name in self.fail_once:  # truncate: client must resume
+                self.fail_once.discard(name)
+                self.wfile.write(body[: max(1, len(body) // 2)])
+                self.wfile.flush()
+                self.connection.close()
+                return
+            self.wfile.write(body)
+            return
+        self._json({"size": str(len(data))})  # metadata
+
+    def _json(self, d):
+        body = json.dumps(d).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):  # simple media upload
+        parsed = urllib.parse.urlparse(self.path)
+        qs = urllib.parse.parse_qs(parsed.query)
+        parts = parsed.path.split("/")
+        # /upload/storage/v1/b/<bucket>/o?uploadType=media&name=...
+        if len(parts) < 7 or parts[1] != "upload" or \
+                qs.get("uploadType") != ["media"] or "name" not in qs:
+            self.send_error(400)
+            return
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        self.objects[qs["name"][0]] = body
+        self._json({"name": qs["name"][0], "size": str(len(body))})
+
+
+def serve_dir_as_gcs(root: str, prefix: str = "imagenet"):
+    """Load every file under `root` into the fake bucket as
+    `<prefix>/<name>` and start a threaded server on 127.0.0.1:<free
+    port>. Returns (server, endpoint_url); caller sets
+    STORAGE_EMULATOR_HOST=endpoint_url and shuts the server down."""
+    objects = {}
+    for f in sorted(os.listdir(root)):
+        p = os.path.join(root, f)
+        if os.path.isfile(p):
+            with open(p, "rb") as fh:
+                objects[f"{prefix}/{f}"] = fh.read()
+    FakeGcsHandler.objects = objects
+    FakeGcsHandler.fail_once = set()
+    FakeGcsHandler.ignore_range = False
+    FakeGcsHandler.range_log = []
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), FakeGcsHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"

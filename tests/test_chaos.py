@@ -84,12 +84,13 @@ print('CHILD DONE')
 MAX_ROUNDS = 10
 
 
-def _launch(root, ckdir, hashlog):
+def _launch(root, ckdir, hashlog, env=None):
     return subprocess.Popen(
         [sys.executable, "-c", CHILD, root, ckdir, hashlog,
          str(MAX_ROUNDS)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        cwd=os.path.join(os.path.dirname(__file__), ".."))
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+        env=env)
 
 
 def _hashes(path):
@@ -107,18 +108,32 @@ def _hashes(path):
 
 
 @pytest.mark.slow
-def test_kill9_resume_matches_uninterrupted(tmp_path):
+@pytest.mark.parametrize("store", ["local", "gs"])
+def test_kill9_resume_matches_uninterrupted(tmp_path, store):
+    """`store='gs'` runs the SAME kill -9 chaos over a fake-GCS bucket —
+    the path a real pod streams (r5, VERDICT weak #5): children resume
+    their per-reader cursors against ranged HTTP tar streams (and the
+    member-carve fast path after each child's first full shard pass)
+    instead of local files."""
     from sparknet_tpu.data import imagenet
     from sparknet_tpu.utils import checkpoint as ckpt
 
     root = str(tmp_path / "shards")
     imagenet.write_synthetic_shards(root, n_shards=4, per_shard=12,
                                     size=28, n_classes=10)
+    env = None
+    srv = None
+    if store == "gs":
+        from fake_stores import serve_dir_as_gcs
+        srv, endpoint = serve_dir_as_gcs(root)
+        env = dict(os.environ, STORAGE_EMULATOR_HOST=endpoint,
+                   no_proxy="*")
+        root = "gs://bkt/imagenet"
 
     # uninterrupted reference run
     ck_a = str(tmp_path / "ck_a")
     hl_a = str(tmp_path / "hash_a.jsonl")
-    p = _launch(root, ck_a, hl_a)
+    p = _launch(root, ck_a, hl_a, env)
     out, _ = p.communicate(timeout=300)
     assert p.returncode == 0 and "CHILD DONE" in out, out
 
@@ -129,7 +144,7 @@ def test_kill9_resume_matches_uninterrupted(tmp_path):
     kills = 0
     for attempt in range(12):  # hard cap on relaunches
         before = len(_hashes(hl_b))
-        p = _launch(root, ck_b, hl_b)
+        p = _launch(root, ck_b, hl_b, env)
         if kills < 3:
             # wait for >= 1-2 fresh rounds to be produced, then kill -9
             want = before + int(rng.integers(1, 3))
@@ -176,3 +191,5 @@ def test_kill9_resume_matches_uninterrupted(tmp_path):
     assert sorted(fa) == sorted(fb)
     for k in fa:
         np.testing.assert_array_equal(fa[k], fb[k], err_msg=k)
+    if srv is not None:
+        srv.shutdown()

@@ -331,3 +331,78 @@ def test_parity_synth_round_matches_trainer():
             np.testing.assert_allclose(
                 np.asarray(ps_avg[l][p]), np.asarray(tr_params[l][p]),
                 rtol=2e-4, atol=2e-6, err_msg=f"{l}/{p}")
+
+
+def test_parity_caffenet_round_matches_trainer():
+    """The scanned-worker round in scripts/parity_caffenet.py (r5: device
+    uint8 corpus -> mean subtract -> random crop -> tau SGD steps with
+    dropout rng -> worker param mean) claims ParallelTrainer._round_impl's
+    math with the mesh axis scanned and the reference's ImageNet
+    preprocessing fused on device. Pin both claims: one round on identical
+    data (host-side preprocessing replicating the device math) and the
+    SAME per-worker dropout keys must reproduce the trainer's averaged
+    params and loss on the CPU mesh."""
+    import os
+    import sys
+    import jax
+    import jax.numpy as jnp
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    import parity_caffenet
+    from sparknet_tpu import CompiledNet
+    from sparknet_tpu.parallel import ParallelTrainer, make_mesh
+    from sparknet_tpu.parallel.mesh import DATA_AXIS, place_global_state
+    from sparknet_tpu.solver import SgdSolver
+    from sparknet_tpu.zoo import caffenet
+    from jax.sharding import PartitionSpec as P
+
+    W, tau, b, size, crop = 2, 2, 2, 80, 67
+    net = CompiledNet.compile(caffenet(batch=b, crop=crop, n_classes=16))
+    cfg = parity_caffenet.solver_config()
+    solver = SgdSolver(net, cfg)
+    r = np.random.default_rng(0)
+    corpus = r.integers(0, 256, (32, size, size, 3)).astype(np.uint8)
+    labels = r.integers(0, 16, 32).astype(np.int32)
+    mean_hwc = r.uniform(100, 156, (size, size, 3)).astype(np.float32)
+    idx = r.integers(0, 32, (W, tau, b)).astype(np.int32)
+    offs = r.integers(0, size - crop + 1, (W, tau, b, 2)).astype(np.int32)
+    keys = jax.random.split(jax.random.PRNGKey(3), W)
+
+    params0 = net.init_params(jax.random.PRNGKey(0))
+    stacked = jax.tree.map(
+        lambda x: jnp.asarray(jnp.broadcast_to(x[None], (W,) + x.shape)),
+        params0)
+    momentum = jax.tree.map(jnp.zeros_like, stacked)
+    round_fn = parity_caffenet.make_round_fn(net, solver, tau, crop=crop)
+    pc_params, _, pc_it, pc_loss = round_fn(
+        stacked, momentum, jnp.zeros((), jnp.int32), jnp.asarray(idx),
+        jnp.asarray(offs), keys, jnp.asarray(corpus), jnp.asarray(labels),
+        jnp.asarray(mean_hwc))
+    assert int(pc_it) == tau
+
+    # the real trainer on HOST-preprocessed identical batches + the SAME
+    # per-worker rng keys (trainer: rngs[d] -> split(tau) = our round's
+    # split of keys[w], so dropout masks match bit-for-bit)
+    trainer = ParallelTrainer(net, cfg, make_mesh(W), tau=tau)
+    state = trainer.state_from_params(params0)
+    data = np.zeros((tau, W * b, crop, crop, 3), np.float32)
+    lab = np.zeros((tau, W * b, 1), np.int32)
+    for w in range(W):
+        for t in range(tau):
+            for k in range(b):
+                img = corpus[idx[w, t, k]].astype(np.float32) - mean_hwc
+                y, x = offs[w, t, k]
+                data[t, w * b + k] = img[y:y + crop, x:x + crop]
+                lab[t, w * b + k] = labels[idx[w, t, k]]
+    rngs = place_global_state(keys, trainer.mesh, P(DATA_AXIS))
+    tr_state, tr_loss = trainer._round(
+        state, trainer._shard_batches({"data": data, "label": lab}), rngs)
+
+    assert float(pc_loss) == pytest.approx(float(tr_loss), rel=1e-5)
+    tr_params = trainer.averaged_params(tr_state)
+    pc_avg = jax.tree.map(lambda x: x[0], pc_params)
+    for l in tr_params:
+        for p in tr_params[l]:
+            np.testing.assert_allclose(
+                np.asarray(pc_avg[l][p]), np.asarray(tr_params[l][p]),
+                rtol=2e-4, atol=2e-6, err_msg=f"{l}/{p}")

@@ -268,3 +268,19 @@ def test_s3_upload_roundtrip_and_sharder_push(s3, tmp_path):
     np.testing.assert_array_equal(up.load_all()[0], local.load_all()[0])
     with pytest.raises(SystemExit, match="gs:// or s3://"):
         shard_imagenet.upload_dir(root, "/local/path")
+
+
+def test_s3_second_epoch_carve_bit_identical(s3):
+    """The r5 bucket member-carve path (see test_gcs) over the SigV4
+    transport: epoch 2 slices members by the captured index, bytes
+    identical to the tarfile epoch."""
+    url, root = s3
+    labels = imagenet.load_label_map(os.path.join(root, "train.txt"))
+    s = imagenet.ShardedTarLoader(imagenet.list_shards(url), labels,
+                                  height=32, width=32)
+    e1 = s.load_all()
+    assert s._bucket_indices  # index captured on the full first epoch
+    e2 = s.load_all()
+    np.testing.assert_array_equal(e1[0], e2[0])
+    np.testing.assert_array_equal(e1[1], e2[1])
+    assert s.skipped == 0
