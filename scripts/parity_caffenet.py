@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 
 from sparknet_tpu import CompiledNet, precision
+from sparknet_tpu.parallel.mesh import scan_unroll
 from sparknet_tpu.data import imagenet, synth
 from sparknet_tpu.data.streaming import streaming_sum_count
 from sparknet_tpu.solver import SgdSolver, SolverConfig, SolverState
@@ -175,7 +176,7 @@ def make_round_fn(net, solver, tau: int, crop: int = CROP):
 
         (params, st), losses = jax.lax.scan(
             step, (params, SolverState(momentum=momentum, it=it)),
-            (idx, offs, step_rngs))
+            (idx, offs, step_rngs), unroll=scan_unroll(tau))
         return params, st.momentum, st.it, jnp.mean(losses)
 
     def round_fn(params_w, momentum_w, it, idx, offs, keys, corpus,
@@ -188,7 +189,8 @@ def make_round_fn(net, solver, tau: int, crop: int = CROP):
             return None, (p, m, new_it, loss)
 
         _, (params_w, momentum_w, its, losses) = jax.lax.scan(
-            body, None, (params_w, momentum_w, idx, offs, keys))
+            body, None, (params_w, momentum_w, idx, offs, keys),
+            unroll=scan_unroll(jax.tree.leaves(params_w)[0].shape[0]))
         params_w = jax.tree.map(
             lambda x: jnp.broadcast_to(jnp.mean(x, axis=0, keepdims=True),
                                        x.shape), params_w)

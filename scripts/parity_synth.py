@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from sparknet_tpu import CompiledNet
+from sparknet_tpu.parallel.mesh import scan_unroll
 from sparknet_tpu.data import synth
 from sparknet_tpu.solver import SgdSolver, SolverConfig, SolverState
 from sparknet_tpu.zoo import cifar10_quick
@@ -77,7 +78,7 @@ def make_round_fn(net, solver, n_workers: int, tau: int, batch: int):
             p, st = solver.update(p, SolverState(momentum=m, it=i), grads)
             return (p, st.momentum, st.it), loss
         (params, momentum, it), losses = jax.lax.scan(
-            step, (params, momentum, it), idx)
+            step, (params, momentum, it), idx, unroll=scan_unroll(tau))
         return params, momentum, it, losses
 
     @jax.jit

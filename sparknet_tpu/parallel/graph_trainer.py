@@ -31,7 +31,7 @@ from jax import shard_map
 
 from ..backend.graph_net import GraphNet
 from .mesh import (DATA_AXIS, local_device_rows, place_global_state,
-                   put_device_axis)
+                   put_device_axis, scan_unroll)
 
 PyTree = Any
 
@@ -160,7 +160,8 @@ class GraphTrainer:
             carry, loss = self._step(carry, batch)
             return carry, loss
 
-        local, losses = lax.scan(local_step, local, batches)
+        local, losses = lax.scan(local_step, local, batches,
+                                 unroll=scan_unroll(self.tau))
 
         # THE sync: float variables pmean'd, ints + slots stay local.
         def avg(x):

@@ -45,7 +45,7 @@ from ..model.layers import tp_shards_layer
 from ..model.net import CompiledNet, PyTree
 from ..solver import SgdSolver, SolverConfig, SolverState
 from .mesh import (DATA_AXIS, MODEL_AXIS, local_device_rows,
-                   place_global_state, put_device_axis)
+                   place_global_state, put_device_axis, scan_unroll)
 
 
 @jax.tree_util.register_dataclass
@@ -351,7 +351,7 @@ class ParallelTrainer:
         step_rngs = jax.random.split(rng, self.tau)
         (params, sstate), losses = lax.scan(
             local_step, (params, SolverState(momentum=momentum, it=it)),
-            (batches, step_rngs))
+            (batches, step_rngs), unroll=scan_unroll(self.tau))
 
         if self.mode == "local_sgd":
             # THE sync: weight averaging as an in-pod allreduce OVER THE

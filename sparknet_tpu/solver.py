@@ -191,8 +191,10 @@ class SgdSolver:
                         jax.tree.map(lambda a, b: a + b / k, acc_g, g)), None
 
             zeros = jax.tree.map(jnp.zeros_like, params)
+            from .parallel.mesh import scan_unroll
             (loss, grads), _ = jax.lax.scan(
-                accum, (jnp.zeros((), jnp.float32), zeros), (micro, rngs))
+                accum, (jnp.zeros((), jnp.float32), zeros), (micro, rngs),
+                unroll=scan_unroll(k))
         new_params, new_state = self.update(params, state, grads)
         return new_params, new_state, loss
 
