@@ -265,11 +265,9 @@ def e2e(sources: int = 1, store: str | None = None) -> dict:
         if store == "gs":
             _sys.path.insert(0, os.path.join(
                 os.path.dirname(os.path.abspath(__file__)), "tests"))
-            from fake_stores import serve_dir_as_gcs
-            server, endpoint = serve_dir_as_gcs(root)
-            os.environ["STORAGE_EMULATOR_HOST"] = endpoint
-            os.environ["no_proxy"] = "*"
-            shards = imagenet.list_shards("gs://bkt/imagenet")
+            from fake_stores import serve_dir_for_ingest
+            server, gs_root = serve_dir_for_ingest(root)
+            shards = imagenet.list_shards(gs_root)
             assert len(shards) == n_shards, shards
         elif store is not None:
             raise SystemExit(f"--store {store!r}: only 'gs' is served "
@@ -320,8 +318,8 @@ def e2e(sources: int = 1, store: str | None = None) -> dict:
         e2e_rate, stats = measure(sources)
         base_stats = measure(1)[1] if sources > 1 else stats
         if server is not None:
-            server.shutdown()
-            os.environ.pop("STORAGE_EMULATOR_HOST", None)
+            from fake_stores import stop_serving
+            stop_serving(server)
 
     device_rate = None
     try:

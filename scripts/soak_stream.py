@@ -51,6 +51,11 @@ def main() -> None:
                    "SAME shapes (r5: the r4 control ran ~1 MB rounds vs "
                    "the TPU run's 4.3 MB — a size-dependent framework "
                    "leak would have hidden; this control is size-matched)")
+    p.add_argument("--store", default=None, choices=("gs",),
+                   help="serve the shards from a local fake-GCS server "
+                   "and stream them as gs:// urls (r5: endurance for the "
+                   "ranged-HTTP + member-carve bucket path — connection "
+                   "reuse, per-epoch freshness checks, index cache)")
     args = p.parse_args()
 
     if args.cpu_control:
@@ -75,7 +80,17 @@ def main() -> None:
         root, n_shards=args.shards, per_shard=args.per_shard,
         n_classes=16, size=size)
     labels = imagenet.load_label_map(label_path)
-    src = make_parallel_source(imagenet.list_shards(root), labels, 1, b,
+    shards = imagenet.list_shards(root)
+    server = None
+    if args.store == "gs":
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tests"))
+        from fake_stores import serve_dir_for_ingest
+        server, gs_root = serve_dir_for_ingest(root)
+        shards = imagenet.list_shards(gs_root)
+        print(f"soak: streaming {gs_root} via the in-process fake server",
+              file=sys.stderr)
+    src = make_parallel_source(shards, labels, 1, b,
                                tau, args.sources, height=size, width=size)
     schema = Schema(Field("data", "float32", (crop, crop, 3)),
                     Field("label", "int32", (1,)))
@@ -118,6 +133,7 @@ def main() -> None:
         result = {
             "rounds": args.rounds,
             "backend": "cpu-control" if args.cpu_control else "device",
+            "store": args.store or "local",
             "round_batch_mb": round(tau * b * crop * crop * 3 * 2 / 1e6, 2),
             "images": args.rounds * b * tau,
             "wall_s": round(time.time() - t0, 1),
@@ -138,6 +154,9 @@ def main() -> None:
         print(json.dumps({k: v for k, v in result.items()
                           if k != "rss_samples"}))
     finally:
+        if server is not None:
+            from fake_stores import stop_serving
+            stop_serving(server)
         if not args.keep:
             import shutil
             shutil.rmtree(root, ignore_errors=True)

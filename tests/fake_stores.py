@@ -58,7 +58,7 @@ class FakeGcsHandler(http.server.BaseHTTPRequestHandler):
         if qs.get("alt") == ["media"]:
             start = 0
             rng = self.headers.get("Range")
-            if rng:
+            if rng and len(type(self).range_log) < RANGE_LOG_CAP:
                 type(self).range_log.append((name, rng))
             if rng and not self.ignore_range:
                 start = int(rng.split("=")[1].split("-")[0])
@@ -98,6 +98,29 @@ class FakeGcsHandler(http.server.BaseHTTPRequestHandler):
         body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
         self.objects[qs["name"][0]] = body
         self._json({"name": qs["name"][0], "size": str(len(body))})
+
+
+#: range_log entries are capped so a long in-process soak (which measures
+#: its OWN RSS) doesn't accumulate instrumentation forever; tests clear
+#: the log before asserting and never approach the cap
+RANGE_LOG_CAP = 10_000
+
+
+def serve_dir_for_ingest(root: str, prefix: str = "imagenet"):
+    """serve_dir_as_gcs + the env wiring ingest callers need
+    (STORAGE_EMULATOR_HOST, no_proxy). Returns (server, gs_url_root);
+    call `stop_serving(server)` when done — shared by `bench.py --store
+    gs` and `scripts/soak_stream.py --store gs` so the setup/cleanup
+    can't drift between them."""
+    srv, endpoint = serve_dir_as_gcs(root, prefix)
+    os.environ["STORAGE_EMULATOR_HOST"] = endpoint
+    os.environ["no_proxy"] = "*"
+    return srv, f"gs://bkt/{prefix}"
+
+
+def stop_serving(server) -> None:
+    server.shutdown()
+    os.environ.pop("STORAGE_EMULATOR_HOST", None)
 
 
 def serve_dir_as_gcs(root: str, prefix: str = "imagenet"):
