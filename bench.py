@@ -91,36 +91,50 @@ def _device_batches(trainer, batch: int, tau: int, crop: int,
     return {"data": data, "label": label}
 
 
-def _time_rounds(trainer, state, batches, trials: int,
-                 profile_dir: str | None = None) -> float:
+def _pipelined_window(step, trials: int,
+                      profile_dir: str | None = None) -> float:
     """Mean steady-state round time over a PIPELINED window — the loss
     fetch lags one round behind the dispatch, exactly as the training loop
     runs (train_loop defers round R's log until R+1 is in flight). Only a
     scalar D2H fetch synchronizes (the axon relay treats block_until_ready
-    as a no-op). The profiler trace covers ONLY the timed window — compile
-    + warmup happen before it starts, else the capture is dominated by
-    compilation."""
+    as a no-op). `step()` dispatches one round and returns its loss as a
+    device scalar; the first call primes the pipeline before the clock
+    starts, and the profiler trace covers ONLY the timed window."""
+    from sparknet_tpu.utils.profiling import maybe_trace
+
+    prev = step()
+    with maybe_trace(profile_dir):
+        t0 = time.perf_counter()
+        for _ in range(trials):
+            loss = step()
+            float(prev)  # sync on the PREVIOUS round; this one overlaps
+            prev = loss
+        dt = time.perf_counter() - t0
+    assert float(prev) > 0  # drain outside the timed window
+    return dt / trials
+
+
+def _time_rounds(trainer, state, batches, trials: int,
+                 profile_dir: str | None = None) -> float:
+    """ParallelTrainer round timing via `_pipelined_window` (compile +
+    warmup happen before the window, else a profile capture is dominated
+    by compilation)."""
     import jax
     from jax.sharding import PartitionSpec as P
     from sparknet_tpu.parallel.mesh import DATA_AXIS, place_global_state
-    from sparknet_tpu.utils.profiling import maybe_trace
 
     rngs = place_global_state(
         jax.random.split(jax.random.PRNGKey(1), trainer.n_devices),
         trainer.mesh, P(DATA_AXIS))
     state, loss = trainer._round(state, batches, rngs)  # compile + warm
     assert float(loss) > 0
-    # prime the pipeline: one round in flight before the clock starts
-    state, prev = trainer._round(state, batches, rngs)
-    with maybe_trace(profile_dir):
-        t0 = time.perf_counter()
-        for _ in range(trials):
-            state, loss = trainer._round(state, batches, rngs)
-            float(prev)  # sync on the PREVIOUS round; this one overlaps
-            prev = loss
-        dt = time.perf_counter() - t0
-    assert float(prev) > 0  # drain outside the timed window
-    return dt / trials
+
+    def step():
+        nonlocal state
+        state, loss = trainer._round(state, batches, rngs)
+        return loss
+
+    return _pipelined_window(step, trials, profile_dir)
 
 
 def headline(profile_dir: str | None = None, batch: int = BATCH,
@@ -237,7 +251,6 @@ def e2e(sources: int = 1, store: str | None = None) -> dict:
     separate threads and is excluded by the thread-CPU accounting).
     """
     import os
-    import sys as _sys
     import tempfile
 
     from sparknet_tpu import precision
@@ -263,7 +276,7 @@ def e2e(sources: int = 1, store: str | None = None) -> dict:
         shards = imagenet.list_shards(root)
         server = None
         if store == "gs":
-            _sys.path.insert(0, os.path.join(
+            sys.path.insert(0, os.path.join(
                 os.path.dirname(os.path.abspath(__file__)), "tests"))
             from fake_stores import serve_dir_for_ingest
             server, gs_root = serve_dir_for_ingest(root)
@@ -341,10 +354,10 @@ def e2e(sources: int = 1, store: str | None = None) -> dict:
     def crit(ss):
         per_own = max(s["serial_s"] / max(1, s["images"]) for s in ss)
         # serial_s clamps to 0 when decode CPU >= busy CPU on a short
-        # noisy window; the ceiling division below then has no
-        # measurement to report — flag it rather than fabricate one
+        # noisy window; every derived division below is gated on the
+        # clamped flag, reporting null rather than a fabricated ceiling
         ms = per_own / len(ss) * 1e3
-        return (max(ms, 1e-6), ms <= 0)
+        return (ms, ms <= 0)
 
     (crit_ms, crit_clamped), (base_crit_ms, base_clamped) = (
         crit(stats), crit(base_stats))
@@ -432,7 +445,6 @@ def graph_headline(batch: int = BATCH, tau: int = TAU,
     from sparknet_tpu.parallel.graph_trainer import GraphTrainer
     from sparknet_tpu.parallel.mesh import DATA_AXIS
     from sparknet_tpu.utils import flops
-    from sparknet_tpu.utils.profiling import maybe_trace
 
     n_classes = 1000
     precision.set_policy("bfloat16")
@@ -453,17 +465,13 @@ def graph_headline(batch: int = BATCH, tau: int = TAU,
 
     state, loss = trainer._round(state, batches)  # compile + warm
     assert float(loss) > 0
-    state, prev = trainer._round(state, batches)  # prime the pipeline
-    with maybe_trace(profile_dir):
-        t0 = time.perf_counter()
-        for _ in range(TRIALS):
-            state, loss = trainer._round(state, batches)
-            float(prev)
-            prev = loss
-        dt = time.perf_counter() - t0
-    assert float(prev) > 0
-    best = dt / TRIALS
 
+    def step():
+        nonlocal state
+        state, loss = trainer._round(state, batches)
+        return loss
+
+    best = _pipelined_window(step, TRIALS, profile_dir)
     img_per_sec = batch * tau / best
     out = {
         "metric": "alexnet_graph_backend_images_per_sec_per_chip",

@@ -141,6 +141,16 @@ def load_split(data_dir: str, prefix: str, labels_file: str):
 
 # -- the round: reference preprocessing + ParallelTrainer math, on device ----
 
+def _crop_one(crop: int):
+    """Per-example random-crop slice (vmapped by callers): the device
+    form of the reference's subarray-view crop
+    (`Preprocessor.scala:75-77`)."""
+    def fn(img, off):
+        return jax.lax.dynamic_slice(img, (off[0], off[1], jnp.int32(0)),
+                                     (crop, crop, 3))
+    return fn
+
+
 def make_round_fn(net, solver, tau: int, crop: int = CROP):
     """One jitted round over W scanned workers. Per worker: τ SGD steps,
     each gathering its device-resident uint8 images, subtracting the
@@ -151,10 +161,7 @@ def make_round_fn(net, solver, tau: int, crop: int = CROP):
     corpus inside HBM."""
     loss_fn = net.loss_fn("loss")
     cdt = precision.compute_dtype()
-
-    def crop_one(img, off):
-        return jax.lax.dynamic_slice(img, (off[0], off[1], jnp.int32(0)),
-                                     (crop, crop, 3))
+    crop_one = _crop_one(crop)
 
     def prep(corpus, mean_hwc, ix, offs):
         x = jnp.take(corpus, ix, axis=0).astype(jnp.float32) - mean_hwc
@@ -205,10 +212,7 @@ def make_eval_fn(net, batch: int, n_val: int):
     Top-1 from the fc8 argmax (the prototxt's accuracy layer semantics)."""
     n_batches = n_val // batch
     cdt = precision.compute_dtype()
-
-    def crop_one(img, off):
-        return jax.lax.dynamic_slice(img, (off[0], off[1], jnp.int32(0)),
-                                     (CROP, CROP, 3))
+    crop_one = _crop_one(CROP)
 
     @jax.jit
     def eval_all(params, corpus, labels, offs, mean_hwc):
