@@ -148,3 +148,86 @@ def test_perf_md_documents_the_measured_bytes(tau2):
     assert f"{mb:.0f} MB" in text or f"{mb:.1f} MB" in text, (
         f"PERF.md ici-scaling section must quote the pinned param volume "
         f"({mb:.1f} MB)")
+
+
+def _tp_round_collectives(tau: int = 2, dp: int = 4, tp: int = 2):
+    """Compile the DP×TP hybrid round on TINY_MLP shapes and parse its
+    collectives. ip1 (num_output 16) and ip2 (4) are both divisible by
+    tp=2, so both are column-sharded; conv-free, so every all-gather in
+    the program is the TP feature gather."""
+    from test_parallel import TINY_MLP
+    from sparknet_tpu import net_from_prototxt
+
+    net = CompiledNet.compile(net_from_prototxt(TINY_MLP))
+    mesh = make_mesh(dp * tp, axis_names=("data", "model"),
+                     shape=(dp, tp))
+    trainer = ParallelTrainer(
+        net, SolverConfig(base_lr=0.01, momentum=0.9, lr_policy="fixed"),
+        mesh, tau=tau)
+    r = np.random.default_rng(0)
+    b = 4
+    batches = {
+        "data": r.standard_normal((tau, dp * b, 6)).astype(np.float32),
+        "label": r.integers(0, 4, (tau, dp * b, 1)).astype(np.int32)}
+    sharded = trainer._shard_batches(batches)
+    rngs = place_global_state(
+        jax.random.split(jax.random.PRNGKey(1), dp),
+        trainer.mesh, P(DATA_AXIS))
+    hlo = trainer._round.lower(
+        trainer.init_state(jax.random.PRNGKey(0)), sharded,
+        rngs).compile().as_text()
+    params = net.init_params(jax.random.PRNGKey(0))
+    per_replica_param_bytes = sum(
+        l.nbytes for l in jax.tree.leaves(params))
+    return _collective_lines(hlo), per_replica_param_bytes
+
+
+@pytest.fixture(scope="module")
+def tp_tau2():
+    return _tp_round_collectives(tau=2)
+
+
+def test_tp_round_collective_kinds_and_weight_bytes(tp_tau2):
+    """The DP×TP hybrid round's wire traffic, pinned: the weight-average
+    all-reduce stays ONE param copy per round — but a LOGICAL copy, i.e.
+    column-sharded layers contribute 1/tp each per model rank (shard
+    identity is preserved across the data-axis pmean; a full-size
+    all-reduce here would mean shards were being summed together — the
+    r3 bug class this guards). TP additionally puts all-gathers on the
+    wire (the Megatron feature gather + its transpose), which the DP-only
+    test asserts are ABSENT; their per-activation bytes scale with
+    batch×features, pinned loosely here (presence + τ-scaling) since
+    XLA may fuse them."""
+    tp = 2
+    colls, full_param_bytes = tp_tau2
+    kinds = {k for k, _ in colls}
+    assert "all-reduce" in kinds, kinds
+    assert "all-gather" in kinds, (
+        f"TP round emitted no all-gather — column sharding is not "
+        f"actually sharded? kinds={kinds}")
+    ar_bytes = sum(b for k, b in colls if k == "all-reduce")
+    # sharded-layer params (here: ALL layers are TP-shardable InnerProducts)
+    # cross the wire as 1/tp each; ONLY the f32 loss scalar rides along
+    # (tight absolute slack: at these ~360-byte shapes a single layer's
+    # shards-summed regression is only ~130 bytes — a big blanket slack
+    # would mask exactly the bug class this pins)
+    logical = full_param_bytes / tp
+    assert logical <= ar_bytes <= logical + 16, (
+        f"weight-average all-reduce moved {ar_bytes} bytes; expected "
+        f"~{int(logical)} (one LOGICAL copy: full {full_param_bytes} / "
+        f"tp {tp})")
+
+
+def test_tp_round_allgather_bytes_tau_scale(tp_tau2):
+    """The TP feature gathers happen INSIDE every local step, so their
+    bytes scale ~linearly with τ (unlike the weight all-reduce, pinned
+    τ-invariant above) — τ=4 must carry ~2x the all-gather bytes of τ=2,
+    and the all-reduce must not grow."""
+    c2, _ = tp_tau2
+    c4, _ = _tp_round_collectives(tau=4)
+    ag2 = sum(b for k, b in c2 if k == "all-gather")
+    ag4 = sum(b for k, b in c4 if k == "all-gather")
+    assert ag2 > 0 and 1.8 * ag2 <= ag4 <= 2.2 * ag2, (ag2, ag4)
+    ar2 = sum(b for k, b in c2 if k == "all-reduce")
+    ar4 = sum(b for k, b in c4 if k == "all-reduce")
+    assert ar2 == ar4, (ar2, ar4)
