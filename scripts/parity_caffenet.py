@@ -64,8 +64,10 @@ TAU = 5              # syncInterval = 5 (ImageNetApp.scala:128)
 SIZE, CROP = 256, 227
 N_TRAIN = 16384      # 64 classes x 256 examples
 N_VAL = 2048
-EVAL_EVERY = 10      # rounds (= 50 iters: the interesting region is the
-                     # symmetry-breaking breakout, keep it resolved)
+EVAL_ITERS = 50      # evaluate at (the first round boundary at/after)
+                     # every 50 ITERATIONS — an iteration grid, not a
+                     # round grid, so runs at different tau produce
+                     # comparable curves (quantization <= tau-1 iters)
 
 
 def solver_config() -> SolverConfig:
@@ -102,6 +104,11 @@ def ensure_dataset(data_dir: str, n_train: int, seed: int = 0,
     if os.path.exists(marker):
         return
     os.makedirs(data_dir, exist_ok=True)
+    import glob
+    for stale in glob.glob(os.path.join(data_dir, ".complete_*")):
+        os.remove(stale)  # an in-place rebuild must invalidate OTHER
+        #                   generators' markers, or a later call with the
+        #                   old params would silently reuse this corpus
     sharder = _load_sharder()
     t0 = time.time()
     train_tot = os.path.join(data_dir, "_synth_ilsvrc_train.tar")
@@ -246,14 +253,16 @@ def make_eval_fn(net, batch: int, n_val: int):
     return eval_all
 
 
-def run(n_workers: int, iters: int, data, seed: int = 0):
+def run(n_workers: int, iters: int, data, seed: int = 0,
+        tau: int = TAU):
     (corpus_dev, labels_dev, mean_dev, val_dev, val_labels_dev,
      n_train) = data
     precision.set_policy("bfloat16")
     net = CompiledNet.compile(caffenet(batch=BATCH, crop=CROP,
                                        n_classes=1000))
     solver = SgdSolver(net, solver_config())
-    rounds = iters // TAU
+    rounds = -(-iters // tau)  # ceil: tau runs compare at >= iters, and
+    #                            the artifact records the actual count
     t0 = time.time()
 
     params0 = net.init_params(jax.random.PRNGKey(seed))
@@ -264,22 +273,22 @@ def run(n_workers: int, iters: int, data, seed: int = 0):
     momentum = jax.tree.map(jnp.zeros_like, params)
     it = jnp.zeros((), jnp.int32)
 
-    round_fn = make_round_fn(net, solver, TAU)
+    round_fn = make_round_fn(net, solver, tau)
     eval_fn = make_eval_fn(net, BATCH, N_VAL)
 
     part = n_train // n_workers
-    assert part >= TAU * BATCH, (
-        f"partition {part} < one round window {TAU * BATCH}")
+    assert part >= tau * BATCH, (
+        f"partition {part} < one round window {tau * BATCH}")
     r = np.random.default_rng((seed, n_workers))
 
     def round_inputs(rnd):
-        idx = np.empty((n_workers, TAU, BATCH), np.int32)
+        idx = np.empty((n_workers, tau, BATCH), np.int32)
         for w in range(n_workers):
-            start = w * part + r.integers(0, part - TAU * BATCH + 1)
-            idx[w] = np.arange(start, start + TAU * BATCH).reshape(TAU,
+            start = w * part + r.integers(0, part - tau * BATCH + 1)
+            idx[w] = np.arange(start, start + tau * BATCH).reshape(tau,
                                                                    BATCH)
         offs = r.integers(0, SIZE - CROP + 1,
-                          (n_workers, TAU, BATCH, 2)).astype(np.int32)
+                          (n_workers, tau, BATCH, 2)).astype(np.int32)
         keys = jax.random.split(
             jax.random.fold_in(jax.random.PRNGKey(1000 + seed), rnd),
             n_workers)
@@ -297,21 +306,22 @@ def run(n_workers: int, iters: int, data, seed: int = 0):
     curve = []
     loss = None
     for rnd in range(rounds):
-        if rnd % EVAL_EVERY == 0:
-            acc = evaluate(params)
-            curve.append({"iter": rnd * TAU,
+        if (rnd * tau) % EVAL_ITERS < tau:  # first round at/after each
+            acc = evaluate(params)          # 50-iteration boundary
+            curve.append({"iter": rnd * tau,
                           "val_accuracy": round(acc, 4)})
-            print(f"[{n_workers}w] iter {rnd * TAU}: val acc {acc:.4f} "
+            print(f"[{n_workers}w] iter {rnd * tau}: val acc {acc:.4f} "
                   f"({time.time() - t0:.0f}s)", file=sys.stderr)
         idx, offs, keys = round_inputs(rnd)
         params, momentum, it, loss = round_fn(params, momentum, it, idx,
                                               offs, keys, corpus_dev,
                                               labels_dev, mean_dev)
     final = evaluate(params)
-    curve.append({"iter": rounds * TAU, "val_accuracy": round(final, 4)})
-    print(f"[{n_workers}w] FINAL iter {rounds * TAU}: val acc {final:.4f} "
+    curve.append({"iter": rounds * tau, "val_accuracy": round(final, 4)})
+    print(f"[{n_workers}w] FINAL iter {rounds * tau}: val acc {final:.4f} "
           f"({time.time() - t0:.0f}s)", file=sys.stderr)
-    return {"workers": n_workers, "tau": TAU if n_workers > 1 else 1,
+    return {"workers": n_workers, "tau": tau if n_workers > 1 else 1,
+            "iters_actual": rounds * tau,
             "final_val_accuracy": round(final, 4), "curve": curve,
             "final_mean_round_loss": float(loss),
             "wall_s": round(time.time() - t0, 1)}
@@ -321,7 +331,10 @@ def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--iters", type=int, default=1500)
     p.add_argument("--n-train", type=int, default=N_TRAIN)
-    p.add_argument("--workers-runs", default="1,8")
+    p.add_argument("--workers-runs", default="1,8",
+                   help="comma list of runs: N workers at the recipe "
+                   "tau, or N@T for an explicit sync interval "
+                   "(e.g. '1,8,8@1' adds a sync-every-step control)")
     p.add_argument("--data-dir", default=os.path.join(_ROOT, ".cache",
                                                       "synth_imagenet"))
     p.add_argument("--out", default="PARITY_CAFFENET_r05.json")
@@ -363,8 +376,11 @@ def main():
             jax.device_put(val_y), len(train_x))
     print(f"corpus on device ({time.time() - t0:.0f}s)", file=sys.stderr)
 
-    runs = [run(int(w), args.iters, data, seed=args.seed)
-            for w in args.workers_runs.split(",")]
+    runs = []
+    for spec in args.workers_runs.split(","):
+        w, _, t = spec.partition("@")  # "8@1" = 8 workers at tau=1
+        runs.append(run(int(w), args.iters, data, seed=args.seed,
+                        tau=int(t) if t else TAU))
     results = {
         "recipe": {"model": "bvlc_reference_caffenet", "base_lr": 0.01,
                    "momentum": 0.9, "weight_decay": 0.0005,
@@ -389,7 +405,7 @@ def main():
     if serial and multi:
         results["summary"] = {
             "serial_final": serial["final_val_accuracy"],
-            f"avg{multi['workers']}_tau{TAU}_final":
+            f"avg{multi['workers']}_tau{multi['tau']}_final":
                 multi["final_val_accuracy"],
             "gap": round(serial["final_val_accuracy"]
                          - multi["final_val_accuracy"], 4)}
