@@ -9,8 +9,13 @@ Reference shape (`apps/CifarApp.scala:100-149`):
       log conv1[0] divergence probe            -> probe_value()
 
 Additions the reference lacked (SURVEY §5.3-5.5): checkpoint/resume of the
-full TrainState + round counter, metrics JSONL, per-phase timing, and a
-termination condition (max_rounds instead of `while(true)`).
+full TrainState + round counter, metrics JSONL, per-phase timing, a
+termination condition (max_rounds instead of `while(true)`), and the
+training health supervisor: on-device anomaly signals classified per flush,
+skip-and-continue for isolated loss spikes, rollback to the newest verified
+checkpoint (with LR backoff and an advanced data order for the retried
+window) for nonfinite rounds or repeated spikes, and a loud hard-fail once
+the rollback budget is spent (utils/health.py).
 """
 from __future__ import annotations
 
@@ -28,9 +33,17 @@ from ..data.dataset import ArrayDataset, RoundSampler
 from ..utils import checkpoint as ckpt
 from ..utils import profiling
 from ..utils.config import RunConfig
+from ..utils.health import (HealthConfig, HealthMonitor, TrainingHealthError,
+                            poison_batch)
 from ..utils.logger import Logger, default_logger
 from ..utils.metrics import PhaseTimers, ThroughputMeter
 from .. import precision
+
+#: retried rounds sample a disjoint deterministic data window: round R on
+#: rollback generation g draws as logical round R + g * _RETRY_DATA_OFFSET
+#: (stateless samplers only — a streaming source simply continues forward,
+#: which advances the data order by construction)
+_RETRY_DATA_OFFSET = 1 << 20
 
 
 def resolve_spec(cfg: RunConfig, **input_shapes) -> NetSpec:
@@ -100,7 +113,8 @@ def train(cfg: RunConfig, spec: NetSpec, train_ds: ArrayDataset,
     mesh = make_mesh(cfg.n_devices)
     n_dev = int(np.prod(mesh.devices.shape))
     trainer = ParallelTrainer(net, cfg.solver, mesh, tau=cfg.tau,
-                              mode=cfg.mode)
+                              mode=cfg.mode,
+                              compute_health=cfg.health.enabled)
     log.log(f"mesh: {n_dev} devices; tau={cfg.tau} mode={cfg.mode} "
             f"local_batch={cfg.local_batch} precision={cfg.precision}")
     if batch_transform is None:
@@ -115,22 +129,50 @@ def train(cfg: RunConfig, spec: NetSpec, train_ds: ArrayDataset,
 
 
 def prepare_round_batches(source, rnd: int, tau: int, seed: int,
-                          batch_transform, compute_dt) -> Dict[str, Any]:
+                          batch_transform, compute_dt, retry: int = 0,
+                          health: Optional[HealthConfig] = None,
+                          first_pass: bool = True) -> Dict[str, Any]:
     """One round's host-side work: sample -> per-τ-slice preprocessing
     (e.g. fresh random crops; rng keyed (seed, round, slice) so resume
     reproduces identical crops) -> compute-dtype cast. The cast happens
     here, on the prefetch thread — at dispatch time it would serialize a
     full-batch astype into the pipelined path (`compute_dt` must be
     captured on the MAIN thread; the precision policy is thread-local).
-    Module-level so `bench.py --e2e` times exactly this code path."""
-    batches = source.next_round(round_index=rnd)
+    Module-level so `bench.py --e2e` times exactly this code path.
+
+    `retry` is the health supervisor's rollback generation: a retried
+    window must be deterministic-but-DIFFERENT, so stateless samplers
+    (RoundSampler) draw from an offset logical round and the per-slice
+    transform rng is re-keyed. Stateful streaming sources keep their true
+    round index (their cursor bookkeeping is keyed on it) — continuing the
+    stream already advances the data order. `health` enables the
+    deterministic fault-injection hooks: on the FIRST pass over a
+    configured round (`first_pass` — the loop tracks the highest round
+    already executed, so a retried window is clean but LATER configured
+    rounds still fire after an earlier rollback) the prepared batch is
+    poisoned before the precision cast, so chaos tests exercise
+    detect -> rollback -> recover without flakiness."""
+    stateless = isinstance(source, RoundSampler) or \
+        getattr(source, "stateless_rounds", False)
+    data_rnd = rnd + retry * _RETRY_DATA_OFFSET if retry and stateless else rnd
+    batches = source.next_round(round_index=data_rnd)
     if batch_transform is not None:
         slices = [batch_transform.convert_batch(
             {k: v[t] for k, v in batches.items()}, train=True,
-            rng=np.random.default_rng((seed, rnd, t)))
+            rng=np.random.default_rng((seed, data_rnd, retry, t)
+                                      if retry else (seed, rnd, t)))
             for t in range(tau)]
         batches = {k: np.stack([s[k] for s in slices])
                    for k in slices[0]}
+    if health is not None and health.enabled and first_pass:
+        # injection is inert when the supervisor is off: poisoning a run
+        # with nothing watching would recreate exactly the silent-NaN
+        # failure mode this subsystem exists to prevent
+        if rnd in health.inject_nan_rounds:
+            batches = poison_batch(batches, "nan")
+        elif rnd in health.inject_spike_rounds:
+            batches = poison_batch(batches, "spike",
+                                   scale=health.inject_spike_scale)
     return precision.cast_host_inputs(batches, compute_dt)
 
 
@@ -178,45 +220,21 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
 
     state = trainer.init_state(jax.random.PRNGKey(cfg.seed))
     start_round = 0
+    resumed_extra: Dict[str, Any] = {}
     if cfg.checkpoint_dir and cfg.resume:
         last = ckpt.latest_step(cfg.checkpoint_dir)
         if last is not None:
             flat, start_round, extra = ckpt.restore_flat(cfg.checkpoint_dir)
-            tp_now = getattr(trainer, "tp", 1)
-            # the elastic path is keyed on the SAVED topology, never on a
-            # shape error: an architecture change on the same topology must
-            # fail loudly through unflatten_like, not be silently adapted.
-            # Pre-topology-metadata checkpoints carry no n_devices/tp keys;
-            # infer the saved device count from the leading replica axis of
-            # the 'it' counter (every state layout tiles it [n_devices])
-            # instead of assuming same-topology and dying in unflatten_like.
-            saved_dev = extra.get("n_devices")
-            if saved_dev is None and "it" in flat:
-                it_arr = np.asarray(flat["it"])
-                if it_arr.ndim:
-                    saved_dev = it_arr.shape[0]
-            same_topo = (
-                int(saved_dev or trainer.n_devices) == trainer.n_devices
-                and int(extra.get("tp", tp_now)) == tp_now)
+            state, same_topo = _restore_state(trainer, state, flat, extra)
             if same_topo:
-                state = trainer.place(ckpt.unflatten_like(state, flat))
                 log.log(f"resumed from checkpoint round {start_round}")
             else:
-                if not hasattr(trainer, "adapt_state"):
-                    raise ValueError(
-                        f"checkpoint topology {extra} != current "
-                        f"({trainer.n_devices} devices, tp={tp_now}) and "
-                        f"this trainer cannot adapt — resume on the "
-                        f"original topology")
-                # ELASTIC resume: params re-tiled exactly, momentum
-                # averaged (ParallelTrainer.adapt_state)
-                state = trainer.adapt_state(flat,
-                                            old_tp=int(extra.get("tp", 1)))
                 log.log(f"ELASTIC resume from round {start_round}: "
                         f"{extra.get('n_devices', '?')} devices (tp="
                         f"{extra.get('tp', 1)}) -> {trainer.n_devices} "
-                        f"(tp={tp_now})")
+                        f"(tp={getattr(trainer, 'tp', 1)})")
             _seek_stream(source, extra, log)
+            resumed_extra = extra
 
     timers = PhaseTimers()
     meter = ThroughputMeter(n_chips=n_dev)
@@ -228,47 +246,148 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     # the prefetch thread would otherwise see the default
     compute_dt = precision.compute_dtype()
 
-    def prepare_round(rnd: int) -> Dict[str, np.ndarray]:
+    health_cfg = cfg.health if cfg.health is not None else HealthConfig()
+    monitor = HealthMonitor(health_cfg) if health_cfg.enabled else None
+    # rollback generation: bumped per recovery; folds into the round rng
+    # and the sampler's logical round so the retried window is
+    # deterministic-but-different. retry == 0 reproduces the legacy
+    # schedule bit-exactly (resume/replay invariants depend on that).
+    # Recovery state RESUMES from the checkpoint: a preemption after a
+    # rollback must not silently revert the LR backoff / retried data
+    # order / rollback budget the supervisor configured.
+    saved_health = resumed_extra.get("health", {})
+    retry = int(saved_health.get("retry", 0))
+    lr_scale = float(saved_health.get("lr_scale", 1.0))
+    if monitor is not None:
+        monitor.rollbacks = int(saved_health.get("rollbacks", 0))
+    if retry or lr_scale != 1.0:
+        log.log(f"health state resumed: retry={retry} "
+                f"lr_scale={lr_scale} rollbacks="
+                f"{saved_health.get('rollbacks', 0)}")
+    supports_lr = bool(getattr(trainer, "supports_lr_scale", False))
+    # highest round already dispatched THIS process: rounds at or below it
+    # are retries/replays (fault injection only fires above it, so a
+    # retried window is clean but later configured rounds still fire)
+    high_water = start_round - 1
+
+    def prepare_round(rnd: int, retry_: int,
+                      first_pass: bool) -> Dict[str, np.ndarray]:
         return prepare_round_batches(source, rnd, cfg.tau, cfg.seed,
-                                     batch_transform, compute_dt)
+                                     batch_transform, compute_dt,
+                                     retry=retry_, health=health_cfg,
+                                     first_pass=first_pass)
 
     def flush_round_log(rec) -> None:
         """Emit round R's metrics. `float(loss)` here is the pipeline's
         REAL synchronization — deferred one round so round R+1's dispatch
         overlaps round R's device execution (the reference fetched loss
         synchronously every round and stalled the accelerator; on a TPU the
-        dispatch+fetch round trip is a large fraction of a round)."""
-        rnd_, loss_, probe_ = rec
+        dispatch+fetch round trip is a large fraction of a round). The
+        health scalars ride the same deferred fetch: classification
+        happens here, so anomaly detection costs no extra per-round sync
+        and latches a recovery decision at the same log_every cadence."""
+        rnd_, loss_, probe_, health_ = rec
         loss_ = float(loss_)
+        kv: Dict[str, Any] = {}
+        gnorm = nonf = None
+        if health_ is not None:
+            gnorm = float(health_["grad_norm"])
+            nonf = float(health_["nonfinite"])
+            kv["grad_norm"] = gnorm
+        cls = None
+        if monitor is not None:
+            cls = monitor.observe(rnd_, loss_, grad_norm=gnorm,
+                                  nonfinite_count=nonf or 0.0)
+            if cls != "ok":
+                kv["health"] = cls
         probe_txt = (f"  probe: {float(probe_):.6f}"
                      if probe_ is not None else "")
-        log.log(f"round loss: {loss_:.4f}{probe_txt}", rnd_)
+        health_txt = f"  HEALTH: {cls}" if cls not in (None, "ok") else ""
+        log.log(f"round loss: {loss_:.4f}{probe_txt}{health_txt}", rnd_)
         log.metrics(rnd_, loss=loss_, images_per_sec_per_chip=round(
-            meter.images_per_sec_per_chip(), 2))
+            meter.images_per_sec_per_chip(), 2), **kv)
+        if cls == "spike" and not monitor.rollback_needed:
+            # every supervisor DECISION is an event record: this spike was
+            # skipped (excluded from the stats window, training continues)
+            log.event(rnd_, "spike_skip", loss=loss_)
 
     # one-deep host prefetch: round R+1 is sampled/decoded/preprocessed on
     # this thread pool while round R's XLA program runs. The "sample" phase
     # then measures only the residual WAIT — ~0 when prep fully overlaps.
     prefetch = ThreadPoolExecutor(1, thread_name_prefix="round-prep")
     pending: Optional[Any] = None
-    # pending (rnd, device_loss, device_probe) records, flushed (= the
-    # loop's host sync) every cfg.log_every rounds — holding device
-    # scalars is free; fetching one costs a full round trip
+    # pending (rnd, device_loss, device_probe, device_health) records,
+    # flushed (= the loop's host sync) every cfg.log_every rounds —
+    # holding device scalars is free; fetching one costs a full round trip
     deferred: list = []
 
     def flush_deferred() -> None:
         while deferred:
             flush_round_log(deferred.pop(0))
 
+    def recover(state):
+        """Roll back to the newest VERIFIED non-anomalous checkpoint.
+        Returns (restored_state, restored_round). Deterministic across
+        hosts: the trigger scalars are mesh-reduced (identical on every
+        process) and the checkpoint dir is shared, so every process picks
+        the same target with no extra communication. Raises
+        TrainingHealthError when the rollback budget is exhausted or no
+        verified checkpoint exists to roll back to."""
+        nonlocal retry, lr_scale, pending
+        flush_deferred()  # drain in-flight records of the same incident
+        reason = monitor.consume_rollback()  # raises once budget is spent
+        if not cfg.checkpoint_dir:
+            raise TrainingHealthError(
+                f"training health: {reason} detected but no checkpoint_dir "
+                f"is configured — nothing to roll back to. Enable "
+                f"checkpointing or disable cfg.health.")
+        found = ckpt.restore_newest_verified(cfg.checkpoint_dir,
+                                             skip_anomalous=True)
+        if found is None:
+            raise TrainingHealthError(
+                f"training health: {reason} detected and no verified "
+                f"non-anomalous checkpoint exists under "
+                f"{cfg.checkpoint_dir!r} — cannot recover.")
+        flat, ck_round, extra = found
+        target = ck_round
+        try:
+            # the verified target may predate an elastic relaunch (old
+            # topology): the shared dispatch re-tiles it like resume would
+            state, _ = _restore_state(trainer, state, flat, extra)
+        except ValueError as e:
+            raise TrainingHealthError(
+                f"training health: rollback target step {target} cannot "
+                f"be loaded — {e}") from e
+        retry += 1
+        if supports_lr and health_cfg.lr_backoff != 1.0:
+            lr_scale *= health_cfg.lr_backoff
+        if pending is not None:
+            if not pending.cancel():
+                try:  # already running: WAIT — the prep thread must not
+                    pending.result()  # race the retried round's inline
+                except Exception:  # prep on the shared (streaming) source
+                    pass
+            pending = None
+        log.event(ck_round, "rollback", reason=reason, target_step=target,
+                  rollbacks=monitor.rollbacks, retry=retry,
+                  lr_scale=round(lr_scale, 6))
+        return state, ck_round
+
     log_every = max(1, cfg.log_every)
+    rnd = start_round
     try:
-        for rnd in range(start_round, cfg.max_rounds):
+        while rnd < cfg.max_rounds:
+            if monitor is not None and monitor.rollback_needed:
+                state, rnd = recover(state)
+                continue
             if test_ds is not None and cfg.eval_every and \
                     rnd % cfg.eval_every == 0:
                 # keep log/JSONL round-ordered: earlier loss rows must
                 # precede round R's eval row (eval blocks on the in-flight
                 # round anyway, so this costs no overlap)
                 flush_deferred()
+                if monitor is not None and monitor.rollback_needed:
+                    continue  # don't eval a poisoned state
                 with timers.phase("eval"):
                     acc = _evaluate(trainer, state, test_ds, cfg.eval_batch,
                                     n_local, transform=eval_transform)
@@ -277,17 +396,27 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
 
             with timers.phase("sample"):
                 batches = (pending.result() if pending is not None
-                           else prepare_round(rnd))
+                           else prepare_round(rnd, retry,
+                                              rnd > high_water))
+            pending = None
             if rnd + 1 < cfg.max_rounds:
-                pending = prefetch.submit(prepare_round, rnd + 1)
+                pending = prefetch.submit(prepare_round, rnd + 1, retry,
+                                          rnd + 1 > high_water)
+            high_water = max(high_water, rnd)
             sub = jax.random.fold_in(base_rng, rnd)
+            if retry:  # deterministic-but-different retried window
+                sub = jax.random.fold_in(sub, retry)
             before = timers.total.get("train_round", 0.0)
             # trace ONE steady-state round (the first would trace compile)
             profile_this = cfg.profile_dir and rnd == start_round + 1
             with profiling.maybe_trace(cfg.profile_dir if profile_this
                                        else None):
                 with timers.phase("train_round"):
-                    state, loss = trainer.train_round(state, batches, sub)
+                    if supports_lr and lr_scale != 1.0:
+                        state, loss = trainer.train_round(
+                            state, batches, sub, lr_scale=lr_scale)
+                    else:
+                        state, loss = trainer.train_round(state, batches, sub)
                     # async probe slice MUST precede the next dispatch
                     # (donation invalidates the old state buffers)
                     probe_val = probe(state) if probe else None
@@ -301,18 +430,37 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
             round_dt = timers.total["train_round"] - before
             n_images = cfg.tau * cfg.local_batch * n_dev
             meter.add(n_images, round_dt)
-            deferred.append((rnd, loss, probe_val))
+            deferred.append((rnd, loss, probe_val,
+                             getattr(trainer, "last_health", None)))
 
             if cfg.checkpoint_dir and cfg.checkpoint_every and \
                     (rnd + 1) % cfg.checkpoint_every == 0:
                 flush_deferred()  # keep log rows round-ordered; the
+                if monitor is not None and monitor.rollback_needed:
+                    continue  # NEVER checkpoint over good state with a
+                    #           poisoned one; loop top recovers instead
+                anomalous = (monitor is not None
+                             and monitor.recently_anomalous(rnd))
                 with timers.phase("checkpoint"):  # save syncs anyway
                     _save_checkpoint(cfg, trainer, state, rnd + 1,
-                                     source=source, last_round=rnd)
+                                     source=source, last_round=rnd,
+                                     anomalous=anomalous,
+                                     health_state=_health_state(
+                                         retry, lr_scale, monitor))
+                if anomalous:
+                    log.event(rnd, "anomalous_checkpoint",
+                              checkpoint_step=rnd + 1)
                 log.log("checkpoint saved", rnd)
             if round_hook:
                 round_hook(rnd, state)
-        flush_deferred()
+            rnd += 1
+            if rnd >= cfg.max_rounds:
+                # the final rounds' health records are still on device:
+                # flush so an anomaly in the tail window triggers recovery
+                # BEFORE the loop exits and the final checkpoint is written
+                flush_deferred()
+                if monitor is not None and monitor.rollback_needed:
+                    state, rnd = recover(state)
     finally:
         if deferred:  # loop aborted: drain the pending fetches
             try:
@@ -332,9 +480,53 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         # cursor (cursor_at has seen no rounds), destroying the resume
         # position a later extended run needs
         _save_checkpoint(cfg, trainer, state, cfg.max_rounds, retain=False,
-                         source=source, last_round=cfg.max_rounds - 1)
+                         source=source, last_round=cfg.max_rounds - 1,
+                         anomalous=(monitor is not None and
+                                    monitor.recently_anomalous(
+                                        cfg.max_rounds - 1)),
+                         health_state=_health_state(retry, lr_scale,
+                                                    monitor))
+    if monitor is not None and (monitor.counts["spike"]
+                                or monitor.counts["nonfinite"]):
+        log.log(f"health summary: {monitor.counts['spike']} spikes, "
+                f"{monitor.counts['nonfinite']} nonfinite rounds, "
+                f"{monitor.rollbacks} rollbacks")
     log.log(f"done; phase means: {timers.summary()}")
     return state
+
+
+def _restore_state(trainer, state, flat: Dict[str, np.ndarray],
+                   extra: Dict[str, Any]):
+    """Load a restored flat checkpoint into the trainer's state layout:
+    same-topology place, or the elastic adapt_state path. Returns
+    (state, same_topology). Shared by resume and health rollback so the
+    two cannot drift.
+
+    The elastic path is keyed on the SAVED topology, never on a shape
+    error: an architecture change on the same topology must fail loudly
+    through unflatten_like, not be silently adapted. Pre-topology-metadata
+    checkpoints carry no n_devices/tp keys; infer the saved device count
+    from the leading replica axis of the 'it' counter (every state layout
+    tiles it [n_devices]) instead of assuming same-topology and dying in
+    unflatten_like."""
+    tp_now = getattr(trainer, "tp", 1)
+    saved_dev = extra.get("n_devices")
+    if saved_dev is None and "it" in flat:
+        it_arr = np.asarray(flat["it"])
+        if it_arr.ndim:
+            saved_dev = it_arr.shape[0]
+    same_topo = (int(saved_dev or trainer.n_devices) == trainer.n_devices
+                 and int(extra.get("tp", tp_now)) == tp_now)
+    if same_topo:
+        return trainer.place(ckpt.unflatten_like(state, flat)), True
+    if not hasattr(trainer, "adapt_state"):
+        raise ValueError(
+            f"checkpoint topology {extra} != current "
+            f"({trainer.n_devices} devices, tp={tp_now}) and this trainer "
+            f"cannot adapt — resume on the original topology")
+    # ELASTIC: params re-tiled exactly, momentum reconstructed
+    # (ParallelTrainer.adapt_state)
+    return trainer.adapt_state(flat, old_tp=int(extra.get("tp", 1))), False
 
 
 def _stream_rows(source, last_round: Optional[int]) -> Optional[list]:
@@ -393,15 +585,32 @@ def _seek_stream(source, extra: Dict[str, Any], log: Logger) -> None:
     log.log(f"stream resumed at {pos}")
 
 
+def _health_state(retry: int, lr_scale: float,
+                  monitor: Optional[HealthMonitor]) -> Optional[Dict[str,
+                                                                     Any]]:
+    """Supervisor recovery state for the checkpoint `extra` — only when it
+    differs from a fresh run's (vanilla checkpoints stay byte-identical to
+    the pre-health format)."""
+    rollbacks = monitor.rollbacks if monitor is not None else 0
+    if not retry and lr_scale == 1.0 and not rollbacks:
+        return None
+    return {"retry": int(retry), "lr_scale": float(lr_scale),
+            "rollbacks": int(rollbacks)}
+
+
 def _save_checkpoint(cfg: RunConfig, trainer, state, step: int,
                      retain: bool = True, source=None,
-                     last_round: Optional[int] = None) -> None:
+                     last_round: Optional[int] = None,
+                     anomalous: bool = False,
+                     health_state: Optional[Dict[str, Any]] = None) -> None:
     """Allgather (a collective — every host must call this) then write from
     process 0 only. Momentum is worker-local, so the gather is substantive,
     not a replica read. The saved topology (device count, tp) lets a
     differently-sized job resume elastically; streaming sources also
     record their per-host stream cursor so resume seeks instead of
-    re-streaming from shard 0."""
+    re-streaming from shard 0. `anomalous=True` tags a checkpoint taken
+    during an unhealthy training window (recent spike/nonfinite rounds) so
+    the health supervisor's rollback skips it."""
     host_state = fetch_global(state)
     stream = _stream_rows(source, last_round) if source is not None else None
     if jax.process_index() == 0:
@@ -409,6 +618,10 @@ def _save_checkpoint(cfg: RunConfig, trainer, state, step: int,
                  "tp": getattr(trainer, "tp", 1)}
         if stream is not None:
             extra["stream"] = stream
+        if anomalous:
+            extra["anomalous"] = True
+        if health_state is not None:
+            extra["health"] = health_state
         ckpt.save(cfg.checkpoint_dir, host_state, step=step, extra=extra)
         if retain:
             ckpt.retain(cfg.checkpoint_dir, keep=3)

@@ -29,6 +29,7 @@ import http.client
 import io
 import json
 import os
+import random
 import subprocess
 import sys
 import time
@@ -45,6 +46,27 @@ _METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
 #: retryable HTTP errors (429/5xx)
 RETRIES = 5
 BACKOFF_S = 0.5
+
+
+def retry_delay(attempt: int, err: Optional[BaseException] = None) -> float:
+    """Seconds to sleep before retry `attempt` + 1: FULL-JITTER exponential
+    backoff — uniform in [0, BACKOFF_S * 2^attempt]. The previous
+    deterministic `BACKOFF_S * 2^attempt` synchronized every reader into a
+    thundering herd: after a shared 429 all `ingest_sources` readers (and
+    all hosts of a pod) slept the exact same time and re-arrived together,
+    earning the next 429. A 429's `Retry-After` header (seconds form), when
+    present, is honored as a FLOOR under the jittered delay — the server
+    knows when capacity returns; arriving earlier just burns an attempt."""
+    delay = random.uniform(0.0, BACKOFF_S * (2 ** attempt))
+    if isinstance(err, urllib.error.HTTPError) and err.code == 429:
+        ra = (err.headers.get("Retry-After")
+              if err.headers is not None else None)
+        try:
+            if ra is not None:
+                delay = max(delay, float(ra))
+        except ValueError:
+            pass  # HTTP-date form: rare from GCS; keep the jittered delay
+    return delay
 
 
 def parse_gs_url(url: str) -> Tuple[str, str]:
@@ -84,7 +106,7 @@ def http_get_with_retry(url: str, headers: Optional[dict] = None,
         except (urllib.error.URLError, ConnectionError, OSError) as e:
             last = e
         if attempt < RETRIES - 1:  # no dead-time sleep before the raise
-            time.sleep(BACKOFF_S * 2 ** attempt)
+            time.sleep(retry_delay(attempt, last))
     raise ConnectionError(f"{method} {url} failed after {RETRIES} attempts"
                           ) from last
 
@@ -272,7 +294,7 @@ class GcsRangeStream(io.RawIOBase):
                     pass
                 self._resp = None  # reconnect from self._pos
                 if attempt < RETRIES - 1:
-                    time.sleep(BACKOFF_S * 2 ** attempt)
+                    time.sleep(retry_delay(attempt, last))
                 continue
             if data:
                 self._pos += len(data)
@@ -287,7 +309,7 @@ class GcsRangeStream(io.RawIOBase):
                     pass
                 self._resp = None
                 if attempt < RETRIES - 1:
-                    time.sleep(BACKOFF_S * 2 ** attempt)
+                    time.sleep(retry_delay(attempt, last))
                 continue
             self._eof = True
             return data

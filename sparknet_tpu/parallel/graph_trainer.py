@@ -27,11 +27,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from ..backend.graph_net import GraphNet
 from .mesh import (DATA_AXIS, local_device_rows, place_global_state,
-                   put_device_axis, scan_unroll)
+                   put_device_axis, scan_unroll, shard_map)
 
 PyTree = Any
 
@@ -48,7 +47,8 @@ class GraphTrainer:
 
     def __init__(self, net: GraphNet, mesh: Mesh, tau: int = 10,
                  loss_name: Optional[str] = None,
-                 acc_name: Optional[str] = "accuracy"):
+                 acc_name: Optional[str] = "accuracy",
+                 compute_health: bool = True):
         self.net = net
         self.mesh = mesh
         self.tau = tau
@@ -57,14 +57,28 @@ class GraphTrainer:
         self.n_devices = int(np.prod(mesh.devices.shape))
         self.n_local_devices = len(local_device_rows(mesh))
         self._step = net.make_train_step(self.loss_name)
+        # False compiles the original round: no isfinite/delta reductions,
+        # no extra scalar collectives (ParallelTrainer contract)
+        self.compute_health = bool(compute_health)
 
         dev = P(DATA_AXIS)
         batch_spec = P(None, DATA_AXIS)  # [tau, global_batch, ...]
+        health_specs = ({"grad_norm": P(), "nonfinite": P()}
+                        if self.compute_health else {})
         self._round = jax.jit(
             shard_map(self._round_impl, mesh=mesh,
                       in_specs=(dev, batch_spec),
-                      out_specs=(dev, P())),
+                      out_specs=(dev, P(), health_specs)),
             donate_argnums=(0,))
+        #: device health scalars from the LAST train_round (the layer-IR
+        #: trainer's contract): "grad_norm" here is the applied-update norm
+        #: of the float variables (grads live inside the imported graph's
+        #: optimizer, so the per-round weight delta is the observable
+        #: equivalent), "nonfinite" the count of workers whose round
+        #: produced a NaN/Inf loss or variable.
+        self.last_health = None
+        #: in-graph optimizer owns the LR — no runtime backoff knob
+        self.supports_lr_scale = False
         self._eval = jax.jit(
             shard_map(self._eval_impl, mesh=mesh,
                       in_specs=(dev, P(DATA_AXIS)),
@@ -155,6 +169,9 @@ class GraphTrainer:
 
     def _round_impl(self, state, batches):
         local = jax.tree.map(lambda x: x[0], state)
+        float_vars = [k for k, v in local["variables"].items()
+                      if jnp.issubdtype(v.dtype, jnp.floating)]
+        old_float_vars = {k: local["variables"][k] for k in float_vars}
 
         def local_step(carry, batch):
             carry, loss = self._step(carry, batch)
@@ -162,6 +179,15 @@ class GraphTrainer:
 
         local, losses = lax.scan(local_step, local, batches,
                                  unroll=scan_unroll(self.tau))
+
+        # health signal: each worker's OWN float-variable delta over the τ
+        # steps, squared — measured BEFORE the averaging collective (after
+        # it every worker holds the same mean and the psum would inflate
+        # by the worker count)
+        delta_sq = (sum(
+            jnp.sum(jnp.square(local["variables"][k].astype(jnp.float32)
+                               - old_float_vars[k].astype(jnp.float32)))
+            for k in float_vars) if self.compute_health else None)
 
         # THE sync: float variables pmean'd, ints + slots stay local.
         def avg(x):
@@ -172,7 +198,20 @@ class GraphTrainer:
         local["variables"] = {k: avg(v)
                               for k, v in local["variables"].items()}
         mean_loss = lax.pmean(jnp.mean(losses), DATA_AXIS)
-        return jax.tree.map(lambda x: x[None], local), mean_loss
+
+        # on-device health scalars (ParallelTrainer contract): the graph's
+        # gradients are internal to the imported optimizer, so the round's
+        # applied-update norm stands in for the gradient norm; nonfinite
+        # checks the round outputs (a NaN/Inf gradient poisons them)
+        health = {}
+        if self.compute_health:
+            grad_norm = jnp.sqrt(lax.psum(delta_sq, DATA_AXIS))
+            finite = jnp.all(jnp.isfinite(losses))
+            for k in float_vars:
+                finite &= jnp.all(jnp.isfinite(local["variables"][k]))
+            nonfinite = lax.psum((~finite).astype(jnp.float32), DATA_AXIS)
+            health = {"grad_norm": grad_norm, "nonfinite": nonfinite}
+        return jax.tree.map(lambda x: x[None], local), mean_loss, health
 
     def _eval_impl(self, state, batch):
         variables = jax.tree.map(lambda x: x[0], state["variables"])
@@ -191,7 +230,10 @@ class GraphTrainer:
         train loop pipeline the fetch one round behind the dispatch.
         `rng` is accepted for trainer-interface parity and ignored (graph
         execution is deterministic; dropout-free eval semantics)."""
-        return self._round(state, self._shard_batches(batches))
+        new_state, loss, health = self._round(state,
+                                              self._shard_batches(batches))
+        self.last_health = health or None  # {} when compute_health=False
+        return new_state, loss
 
     def evaluate(self, state: PyTree, batch: Dict[str, np.ndarray]) -> float:
         sharded = {

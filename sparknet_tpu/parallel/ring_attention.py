@@ -25,8 +25,10 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import axis_size, pvary, shard_map
 
 from ..ops.attention import (block_accumulate, finalize_accumulator,
                              init_accumulator)
@@ -42,7 +44,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     Device i initially holds KV shard i; after step t it holds shard
     (i - t) mod n — offsets for causal masking are derived from that.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     me = lax.axis_index(axis_name)
     lq = q.shape[1]
     perm = [(j, (j + 1) % n) for j in range(n)]
@@ -61,12 +63,9 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
 
     o, m, l = init_accumulator(q.shape)
     # zeros/full constants are replicated; mark them device-varying so the
-    # scan carry type matches the per-device accumulation results.
-    # (pcast is the non-deprecated spelling of pvary in jax >= 0.9)
-    if hasattr(lax, "pcast"):
-        o, m, l = lax.pcast((o, m, l), (axis_name,), to="varying")
-    else:
-        o, m, l = lax.pvary((o, m, l), (axis_name,))
+    # scan carry type matches the per-device accumulation results (vma
+    # compat shim: pcast in jax >= 0.9, pvary in 0.5-0.8, no-op before)
+    o, m, l = pvary((o, m, l), (axis_name,))
     (o, m, l, _, _), _ = lax.scan(body, (o, m, l, k, v), jnp.arange(n))
     return finalize_accumulator(o, m, l, q.dtype)
 
@@ -80,7 +79,7 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     exact attention locally, then back.
     """
     from ..ops.attention import attention
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     assert q.shape[2] % n == 0, (
         f"heads {q.shape[2]} not divisible by seq-axis size {n}")
     # [B, L/n, H, D] -> gather seq, scatter heads -> [B, L, H/n, D]
@@ -101,9 +100,16 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = SEQ_AXIS,
     fn = ring_attention if impl == "ring" else ulysses_attention
     inner = functools.partial(fn, axis_name=axis_name, causal=causal)
     spec = P(None, axis_name, None, None)
+    kw = {}
+    import inspect
+    if "check_rep" in inspect.signature(shard_map).parameters:
+        # old-jax (<= 0.4.x) replication checking miscounts the scan carry
+        # under grad (jax advises check_rep=False as the workaround); newer
+        # jax's vma tracking handles it via the pvary marking above
+        kw["check_rep"] = False
     mapped = jax.jit(shard_map(
         lambda q, k, v: inner(q, k, v),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **kw))
 
     def apply(q, k, v):
         sharding = NamedSharding(mesh, spec)

@@ -39,13 +39,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from ..model.layers import tp_shards_layer
 from ..model.net import CompiledNet, PyTree
 from ..solver import SgdSolver, SolverConfig, SolverState
 from .mesh import (DATA_AXIS, MODEL_AXIS, local_device_rows,
-                   place_global_state, put_device_axis, scan_unroll)
+                   place_global_state, put_device_axis, scan_unroll,
+                   shard_map)
 
 
 @jax.tree_util.register_dataclass
@@ -78,7 +78,8 @@ class ParallelTrainer:
 
     def __init__(self, net: CompiledNet, solver_cfg: SolverConfig, mesh: Mesh,
                  tau: int = 10, mode: str = "local_sgd",
-                 loss_blob: str = "loss", acc_blob: Optional[str] = None):
+                 loss_blob: str = "loss", acc_blob: Optional[str] = None,
+                 compute_health: bool = True):
         assert mode in ("local_sgd", "sync_sgd")
         if mode == "sync_sgd":
             assert tau == 1, "sync_sgd averages every step; tau must be 1"
@@ -119,11 +120,31 @@ class ParallelTrainer:
         batch_spec = P(None, DATA_AXIS)
         state_specs = TrainState(params=dev, momentum=dev, it=dev)
 
+        # compute_health=False compiles the ORIGINAL round — no isfinite
+        # passes over the state, no per-step grad-norm reduction, no extra
+        # scalar collectives (for runs that disable the supervisor, e.g.
+        # deliberate-divergence fixtures or wire-byte-pinned benchmarks)
+        self.compute_health = bool(compute_health)
+        health_specs = ({"grad_norm": P(), "nonfinite": P()}
+                        if self.compute_health else {})
         self._round = jax.jit(
             shard_map(self._round_impl, mesh=mesh,
-                      in_specs=(state_specs, batch_spec, P(DATA_AXIS)),
-                      out_specs=(state_specs, P())),
+                      in_specs=(state_specs, batch_spec, P(DATA_AXIS), P()),
+                      out_specs=(state_specs, P(), health_specs)),
             donate_argnums=(0,))
+        #: device scalars from the LAST train_round (fetch with float()):
+        #: {"grad_norm": sqrt of the psum over workers of each worker's
+        #: WORST-step squared grad norm (max-over-τ runs before the psum,
+        #: so the wire cost is one scalar; can exceed the true per-step
+        #: global norm by up to sqrt(n_data) when workers peak on
+        #: different steps), "nonfinite": count of data groups whose round
+        #: produced a NaN/Inf loss, param, or momentum}. None when
+        #: compute_health=False. Kept OFF the train_round return so
+        #: existing (state, loss) callers are untouched; the train loop
+        #: reads them at its log_every flush — no extra per-round host
+        #: sync.
+        self.last_health: Optional[Dict[str, jax.Array]] = None
+        self._lr_scale_dev: Optional[Tuple[float, jax.Array]] = None
         self._eval = jax.jit(
             shard_map(self._eval_impl, mesh=mesh,
                       in_specs=(dev, P(DATA_AXIS)),
@@ -310,7 +331,7 @@ class ParallelTrainer:
 
     # -- one training round (runs INSIDE shard_map; axis = DATA_AXIS) --------
 
-    def _round_impl(self, state: TrainState, batches, rng):
+    def _round_impl(self, state: TrainState, batches, rng, lr_scale):
         # shapes here are per-device: params [1, ...]; batches [tau, local_b, ...]
         params = jax.tree.map(lambda x: x[0], state.params)
         momentum = jax.tree.map(lambda x: x[0], state.momentum)
@@ -342,14 +363,23 @@ class ParallelTrainer:
                 lambda p: loss_fn(p, batch, step_rng),
                 has_aux=True)(params)
             grads = fix_tp_grads(grads)
+            # health signal: this step's LOCAL squared gradient norm (a
+            # per-leaf reduction fused into the compiled step, no host
+            # sync). Taken BEFORE the sync_sgd pmean so the later psum
+            # yields the true concatenated-across-workers norm in both
+            # modes — post-pmean it would inflate by sqrt(n_data).
+            grad_sq = (sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                           for g in jax.tree.leaves(grads))
+                       if self.compute_health else jnp.zeros((), jnp.float32))
             if self.mode == "sync_sgd":
                 grads = lax.pmean(grads, DATA_AXIS)
                 loss = lax.pmean(loss, DATA_AXIS)
-            params, sstate = self.solver.update(params, sstate, grads)
-            return (params, sstate), loss
+            params, sstate = self.solver.update(params, sstate, grads,
+                                                lr_scale=lr_scale)
+            return (params, sstate), (loss, grad_sq)
 
         step_rngs = jax.random.split(rng, self.tau)
-        (params, sstate), losses = lax.scan(
+        (params, sstate), (losses, grad_sqs) = lax.scan(
             local_step, (params, SolverState(momentum=momentum, it=it)),
             (batches, step_rngs), unroll=scan_unroll(self.tau))
 
@@ -360,6 +390,29 @@ class ParallelTrainer:
             # averaged (reference parity, SURVEY §7).
             params = lax.pmean(params, DATA_AXIS)
         mean_loss = lax.pmean(jnp.mean(losses), DATA_AXIS)
+
+        # -- on-device health scalars (utils/health.py is the host half) --
+        # global gradient norm: each worker's WORST-step squared norm,
+        # summed across workers (the max-over-τ runs BEFORE the psum so
+        # the wire cost is one f32 scalar, tau-invariant — the collective
+        # pins in tests/test_collectives.py hold). NaN/Inf detection runs
+        # on the round's OUTPUTS (losses + post-averaging params/momentum):
+        # a nonfinite gradient necessarily poisons the updated params, so
+        # one reduction per leaf per ROUND suffices — no per-step isfinite.
+        health = {}
+        if self.compute_health:
+            grad_norm = jnp.sqrt(lax.psum(jnp.max(grad_sqs), DATA_AXIS))
+            finite = jnp.all(jnp.isfinite(losses))
+            for leaf in (jax.tree.leaves(params)
+                         + jax.tree.leaves(sstate.momentum)):
+                finite &= jnp.all(jnp.isfinite(leaf.astype(jnp.float32)))
+            nonfinite = lax.psum((~finite).astype(jnp.float32), DATA_AXIS)
+            if self._tp_axis is not None:
+                # numerically (near-)no-ops — TP replicas compute identical
+                # flags; clears the model-axis vma so P() typechecks
+                grad_norm = lax.pmean(grad_norm, self._tp_axis)
+                nonfinite = lax.pmean(nonfinite, self._tp_axis)
+            health = {"grad_norm": grad_norm, "nonfinite": nonfinite}
         if self._tp_axis is not None:
             # numerically a no-op (TP replicas compute identical losses);
             # clears the model-axis vma so the P() out_spec typechecks
@@ -370,7 +423,7 @@ class ParallelTrainer:
             momentum=jax.tree.map(lambda x: x[None], sstate.momentum),
             it=sstate.it[None],
         )
-        return new_state, mean_loss
+        return new_state, mean_loss, health
 
     # -- distributed eval ----------------------------------------------------
 
@@ -390,8 +443,13 @@ class ParallelTrainer:
 
     # -- public API ----------------------------------------------------------
 
+    #: run_loop keys LR backoff on this: the layer-IR solver takes a
+    #: runtime lr_scale; the graph backend's in-graph optimizer does not
+    supports_lr_scale = True
+
     def train_round(self, state: TrainState, batches: Dict[str, np.ndarray],
-                    rng: jax.Array) -> Tuple[TrainState, float]:
+                    rng: jax.Array, lr_scale: float = 1.0
+                    ) -> Tuple[TrainState, float]:
         """One outer round: τ local steps per device + averaging.
 
         `batches[input]` has shape [tau, host_batch, ...] with host_batch =
@@ -399,13 +457,24 @@ class ParallelTrainer:
         devices along axis 1. Single-process, host_batch == the global
         batch; multi-host, each process passes only its own hosts' examples
         (disjoint data — the reference's per-executor partitions).
+
+        `lr_scale` multiplies the lr-policy rate for this round (health
+        supervisor backoff; a traced input, so changing it does not
+        recompile). Health scalars from the round land in `last_health`
+        as device scalars — see its comment.
         """
         # one rng row per DATA group, same on every host; TP replicas in a
         # model group share the row (dropout masks must agree on the
         # gathered activations)
         rngs = jax.random.split(rng, self.n_data)
         rngs = place_global_state(rngs, self.mesh, P(DATA_AXIS))
-        new_state, loss = self._round(state, self._shard_batches(batches), rngs)
+        if self._lr_scale_dev is None or \
+                self._lr_scale_dev[0] != float(lr_scale):
+            self._lr_scale_dev = (float(lr_scale),
+                                  jnp.asarray(lr_scale, jnp.float32))
+        new_state, loss, health = self._round(
+            state, self._shard_batches(batches), rngs, self._lr_scale_dev[1])
+        self.last_health = health or None  # {} when compute_health=False
         return new_state, loss
 
     def evaluate(self, state: TrainState, batch: Dict[str, np.ndarray]) -> float:

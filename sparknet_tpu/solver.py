@@ -139,10 +139,15 @@ class SgdSolver:
 
     # -- single-step update (pure) ------------------------------------------
 
-    def update(self, params: PyTree, state: SolverState, grads: PyTree
-               ) -> Tuple[PyTree, SolverState]:
-        """Apply one Caffe-SGD update given precomputed grads (pure fn)."""
-        rate = learning_rate(self.cfg, state.it)
+    def update(self, params: PyTree, state: SolverState, grads: PyTree,
+               lr_scale: Any = 1.0) -> Tuple[PyTree, SolverState]:
+        """Apply one Caffe-SGD update given precomputed grads (pure fn).
+
+        `lr_scale` is a runtime (traceable) multiplier on the policy rate —
+        the health supervisor's LR-backoff knob. It is an input, not a
+        config field, so backing off after a rollback does NOT recompile
+        the round (SolverConfig values are baked in at trace time)."""
+        rate = learning_rate(self.cfg, state.it) * lr_scale
 
         def upd(path_key, w, v, g):
             lr_mult, decay_mult = path_key

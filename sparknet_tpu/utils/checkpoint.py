@@ -8,16 +8,34 @@ exactly, so a resumed run continues bit-identically.
 
 Format: a directory with
   - state.npz   — flattened pytree leaves, keys are /-joined paths
-  - meta.json   — {"round": N, "tree": <pytree structure descriptor>}
-Atomic via write-to-temp + rename. `latest`/`step-N` naming with retention.
+  - meta.json   — {"step": N, "keys": [...], "digests": {key: sha256-hex},
+                   "extra": {...}}
+Atomic via write-to-temp + rename. `step-N` naming with retention.
+
+Integrity (the health supervisor's substrate): `save` records a SHA-256
+digest of every array's bytes in meta.json; `verify` recomputes them, and
+`restore_flat` (auto-latest) falls back to the newest checkpoint that
+verifies instead of dying on a torn/corrupt latest — a byte flipped by a
+bad disk or a truncated copy on a network FS is detected and skipped, with
+a warning. The digest schema is a compatibility surface: checkpoints
+written before it (no "digests" key) still restore — their integrity check
+is vacuous beyond "meta parses and every key loads".
+
+`retain` never deletes the newest checkpoint that verifies, even when a
+newer (corrupt) one would otherwise push it out of the keep window — the
+rollback target must survive retention. Checkpoints written during an
+unhealthy training window carry `extra["anomalous"] = True`;
+`newest_verified_step(skip_anomalous=True)` is the rollback selector.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
 import tempfile
-from typing import Any, Dict, Optional, Tuple
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import ml_dtypes
@@ -28,6 +46,11 @@ import numpy as np
 # stored as same-width uint views with the real dtype name recorded in
 # meta.json, and re-viewed on restore.
 _UINT_OF_SIZE = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint directory exists but fails integrity verification
+    (unreadable meta.json / state.npz, missing keys, or digest mismatch)."""
 
 
 def _is_extension_dtype(dt: np.dtype) -> bool:
@@ -56,10 +79,34 @@ def _path_str(p) -> str:
     return str(p)
 
 
+def _digest(arr: np.ndarray) -> str:
+    """SHA-256 over the array's C-order bytes (the exact bytes savez
+    writes; tobytes() serializes non-contiguous arrays in C order too)."""
+    return hashlib.sha256(arr.tobytes()).hexdigest()
+
+
+def _sweep_stale_tmp(directory: str) -> None:
+    """Remove `.tmp-*` work directories left behind by a previous process
+    killed mid-save (e.g. the chaos test's SIGKILL between mkdtemp and
+    rename) — otherwise they leak in checkpoint_dir forever. Only one
+    writer per directory is supported (process 0 of one run), so any
+    existing tmp dir is stale by definition."""
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return
+    for d in entries:
+        if d.startswith(".tmp-"):
+            shutil.rmtree(os.path.join(directory, d), ignore_errors=True)
+
+
 def save(directory: str, tree: Any, *, step: int,
          extra: Optional[Dict[str, Any]] = None) -> str:
-    """Atomically write checkpoint `step-N` under directory; returns path."""
+    """Atomically write checkpoint `step-N` under directory; returns path.
+    Records per-array SHA-256 digests in meta.json (see module docstring)
+    and sweeps stale `.tmp-*` directories from crashed earlier saves."""
     os.makedirs(directory, exist_ok=True)
+    _sweep_stale_tmp(directory)
     flat = _flatten(tree)
     ext_dtypes = {}
     for key, arr in flat.items():
@@ -69,7 +116,8 @@ def save(directory: str, tree: Any, *, step: int,
     tmp = tempfile.mkdtemp(dir=directory, prefix=".tmp-")
     try:
         np.savez(os.path.join(tmp, "state.npz"), **flat)
-        meta = {"step": int(step), "keys": sorted(flat.keys())}
+        meta = {"step": int(step), "keys": sorted(flat.keys()),
+                "digests": {k: _digest(a) for k, a in flat.items()}}
         if ext_dtypes:
             meta["ext_dtypes"] = ext_dtypes
         if extra:
@@ -86,12 +134,36 @@ def save(directory: str, tree: Any, *, step: int,
     return final
 
 
-def latest_step(directory: str) -> Optional[int]:
+def _list_steps(directory: str) -> List[int]:
+    """All step numbers present as directories (no validity check)."""
     if not os.path.isdir(directory):
+        return []
+    return sorted(int(d.split("-", 1)[1]) for d in os.listdir(directory)
+                  if d.startswith("step-") and d.split("-", 1)[1].isdigit())
+
+
+def _load_meta(path: str) -> Optional[Dict[str, Any]]:
+    """meta.json as a dict, or None when missing/unparseable (a torn copy
+    on a network FS) — the caller treats that as not-a-checkpoint."""
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
         return None
-    steps = [int(d.split("-", 1)[1]) for d in os.listdir(directory)
-             if d.startswith("step-") and d.split("-", 1)[1].isdigit()]
-    return max(steps) if steps else None
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Newest step whose meta.json is readable. A step directory with a
+    missing/unparseable meta.json (torn copy, crashed writer on a non-atomic
+    FS) is skipped with a warning instead of raising an opaque
+    JSONDecodeError/FileNotFoundError later."""
+    for s in reversed(_list_steps(directory)):
+        path = os.path.join(directory, f"step-{s}")
+        if _load_meta(path) is not None:
+            return s
+        warnings.warn(f"checkpoint {path}: meta.json missing/unreadable — "
+                      f"treating as not-a-checkpoint", RuntimeWarning)
+    return None
 
 
 def unflatten_like(template: Any, flat: Dict[str, np.ndarray]) -> Any:
@@ -122,31 +194,147 @@ def restore(directory: str, template: Any, *, step: Optional[int] = None
     return unflatten_like(template, flat), step, extra
 
 
-def restore_flat(directory: str, step: Optional[int] = None
-                 ) -> Tuple[Dict[str, np.ndarray], int, Dict[str, Any]]:
-    """Restore the raw flat {path-key: array} mapping without a template —
-    for ELASTIC resume, where the saved leading device axis differs from
-    the current topology and a structural template cannot match
-    (ParallelTrainer.adapt_state re-tiles from this)."""
-    if step is None:
-        step = latest_step(directory)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {directory!r}")
-    path = os.path.join(directory, f"step-{int(step)}")
-    with open(os.path.join(path, "meta.json")) as f:
-        meta = json.load(f)
-    with np.load(os.path.join(path, "state.npz")) as z:
-        flat = {k: z[k] for k in z.files}
+def _load_checkpoint(path: str) -> Tuple[Dict[str, np.ndarray], int,
+                                         Dict[str, Any]]:
+    """Load + integrity-verify one checkpoint directory. Raises
+    CheckpointCorruptError on unreadable meta/state, missing keys, or a
+    digest mismatch. Digestless (pre-integrity-format) checkpoints load
+    with a vacuous digest check — old checkpoints must still restore."""
+    meta = _load_meta(path)
+    if meta is None:
+        raise CheckpointCorruptError(f"{path}: meta.json missing/unreadable")
+    try:
+        with np.load(os.path.join(path, "state.npz")) as z:
+            flat = {k: z[k] for k in z.files}
+    except Exception as e:
+        raise CheckpointCorruptError(f"{path}: state.npz unreadable: {e}"
+                                     ) from e
+    missing = set(meta.get("keys", ())) - set(flat)
+    if missing:
+        raise CheckpointCorruptError(
+            f"{path}: state.npz missing keys {sorted(missing)[:5]}")
+    for key, want in meta.get("digests", {}).items():
+        if key not in flat:
+            raise CheckpointCorruptError(f"{path}: digest for missing "
+                                         f"key {key!r}")
+        got = _digest(flat[key])
+        if got != want:
+            raise CheckpointCorruptError(
+                f"{path}: digest mismatch on {key!r} (stored "
+                f"{want[:12]}…, recomputed {got[:12]}…) — bytes were "
+                f"corrupted at rest or in transit")
     for key, name in meta.get("ext_dtypes", {}).items():
         flat[key] = flat[key].view(np.dtype(name))
     return flat, int(meta["step"]), meta.get("extra", {})
 
 
+def restore_flat(directory: str, step: Optional[int] = None
+                 ) -> Tuple[Dict[str, np.ndarray], int, Dict[str, Any]]:
+    """Restore the raw flat {path-key: array} mapping without a template —
+    for ELASTIC resume, where the saved leading device axis differs from
+    the current topology and a structural template cannot match
+    (ParallelTrainer.adapt_state re-tiles from this).
+
+    With an explicit `step`, integrity failure raises
+    CheckpointCorruptError. With step=None, falls back: the newest
+    checkpoint that VERIFIES wins; torn/corrupt newer ones are skipped
+    with a warning (a kill -9 mid-rename, a byte flipped at rest — resume
+    proceeds from the previous step instead of dying)."""
+    if step is not None:
+        return _load_checkpoint(os.path.join(directory, f"step-{int(step)}"))
+    steps = _list_steps(directory)
+    if not steps:
+        raise FileNotFoundError(f"no checkpoints under {directory!r}")
+    last_err: Optional[Exception] = None
+    for s in reversed(steps):
+        path = os.path.join(directory, f"step-{s}")
+        try:
+            return _load_checkpoint(path)
+        except CheckpointCorruptError as e:
+            warnings.warn(f"{e} — falling back to the previous checkpoint",
+                          RuntimeWarning)
+            last_err = e
+    raise CheckpointCorruptError(
+        f"no checkpoint under {directory!r} passes verification "
+        f"({len(steps)} candidates)") from last_err
+
+
+def verify(path: str) -> bool:
+    """True when the checkpoint directory `path` is complete and its
+    recorded digests match the stored bytes (vacuously true for
+    pre-digest-format checkpoints that load cleanly)."""
+    try:
+        _load_checkpoint(path)
+        return True
+    except Exception:
+        return False
+
+
+def newest_verified_step(directory: str, skip_anomalous: bool = False
+                         ) -> Optional[int]:
+    """Newest step that passes `verify` — the health supervisor's rollback
+    target. `skip_anomalous=True` additionally skips checkpoints tagged
+    `extra["anomalous"]` (taken during an unhealthy training window: the
+    state may embed the anomaly being rolled away from)."""
+    found = restore_newest_verified(directory, skip_anomalous=skip_anomalous)
+    return found[1] if found is not None else None
+
+
+def restore_newest_verified(directory: str, skip_anomalous: bool = False
+                            ) -> Optional[Tuple[Dict[str, np.ndarray], int,
+                                                Dict[str, Any]]]:
+    """Load the newest checkpoint that verifies (optionally skipping
+    anomalous-tagged ones), as one pass: verification IS the load, so the
+    rollback path pays a single read+digest of the multi-GB state instead
+    of verify-then-restore doing it twice. Returns (flat, step, extra) or
+    None."""
+    for s in reversed(_list_steps(directory)):
+        path = os.path.join(directory, f"step-{s}")
+        meta = _load_meta(path)
+        if meta is None:
+            continue
+        if skip_anomalous and meta.get("extra", {}).get("anomalous"):
+            continue
+        try:
+            return _load_checkpoint(path)
+        except CheckpointCorruptError:
+            continue
+    return None
+
+
 def retain(directory: str, keep: int = 3) -> None:
-    """Delete all but the newest `keep` checkpoints."""
-    if not os.path.isdir(directory):
+    """Delete all but the newest `keep` checkpoints — but NEVER the newest
+    one that verifies, NOR the newest verified NON-anomalous one: when
+    newer checkpoints are corrupt, or a long unhealthy window has tagged
+    every recent save `anomalous`, retention must not destroy the only
+    state a resume/rollback can still use. (The protection re-verifies
+    from disk — one extra read+hash of the newest snapshot per save; the
+    integrity guarantee is worth more than the checkpoint-phase I/O.)"""
+    steps = _list_steps(directory)
+    if not steps:
         return
-    steps = sorted((int(d.split("-", 1)[1]) for d in os.listdir(directory)
-                    if d.startswith("step-") and d.split("-", 1)[1].isdigit()))
-    for s in steps[:-keep] if keep else steps:
-        shutil.rmtree(os.path.join(directory, f"step-{s}"), ignore_errors=True)
+    protect = set(steps[-keep:]) if keep else set()
+    # one newest-first scan finds both targets (in the common case — the
+    # newest checkpoint verifies and is non-anomalous — exactly one
+    # verification runs): the newest verified step, and the newest
+    # verified NON-anomalous one (the rollback selector's candidate)
+    newest_verified = None
+    for s in reversed(steps):
+        path = os.path.join(directory, f"step-{s}")
+        meta = _load_meta(path)
+        if meta is None:
+            continue
+        anomalous = bool(meta.get("extra", {}).get("anomalous"))
+        if newest_verified is not None and anomalous:
+            continue  # only the non-anomalous target is still open
+        if verify(path):
+            if newest_verified is None:
+                newest_verified = s
+                protect.add(s)
+            if not anomalous:
+                protect.add(s)
+                break
+    for s in steps:
+        if s not in protect:
+            shutil.rmtree(os.path.join(directory, f"step-{s}"),
+                          ignore_errors=True)

@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
 
 from ..solver import SolverConfig
+from .health import HealthConfig
 
 
 @dataclass
@@ -48,6 +49,10 @@ class RunConfig:
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 25          # rounds
     resume: bool = True
+    # training health supervisor: anomaly classification (spike/nonfinite),
+    # skip / rollback-to-verified-checkpoint / LR-backoff recovery, and the
+    # deterministic fault-injection hooks (utils/health.py)
+    health: HealthConfig = field(default_factory=HealthConfig)
     # logging. None -> $SPARKNET_TPU_HOME, else "." (the reference logged
     # to $SPARKNET_HOME/training_log_<ms>.txt); tests set the env var to a
     # tmp dir so stray default-config runs never litter the repo root
@@ -75,6 +80,8 @@ class RunConfig:
         d = dict(d)
         if "solver" in d and isinstance(d["solver"], dict):
             d["solver"] = SolverConfig.from_dict(d["solver"])
+        if "health" in d and isinstance(d["health"], dict):
+            d["health"] = HealthConfig.from_dict(d["health"])
         known = {f.name for f in dataclasses.fields(RunConfig)}
         unknown = set(d) - known
         if unknown:

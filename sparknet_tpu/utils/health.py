@@ -1,0 +1,233 @@
+"""Training health supervision: anomaly classification + recovery policy.
+
+The reference's loop was `while(true)` with `task.maxFailures=1` (SURVEY
+§5.3): a diverging or numerically-poisoned run had no answer — a NaN loss
+sailed through the round, silently corrupted every replica via the
+τ-averaging pmean (one bad worker poisons all after one sync), and was
+checkpointed over the last good state until retention had deleted every
+clean snapshot. Large-scale practice (PaLM's restart-and-skip response to
+loss spikes; the local-SGD robustness line descending from the SparkNet
+τ-averaging scheme) treats anomaly detection + rollback as a first-class
+subsystem. This module is the host-side half:
+
+  - `HealthConfig`   — the knobs (rolling window, MAD threshold, rollback
+                       budget, LR backoff, deterministic fault injection).
+  - `HealthMonitor`  — rolling ROBUST loss statistics (median + MAD over a
+                       window of healthy rounds only), classifying each
+                       round as ok / spike / nonfinite and deciding
+                       skip-and-continue vs rollback.
+  - `TrainingHealthError` — the loud hard-fail after `max_rollbacks`.
+
+The device-side half lives in the trainers: `_round_impl` additionally
+returns a global gradient norm and an any-nonfinite count, psum'd over the
+data axis INSIDE the already-compiled round — so the signals cost no extra
+host round-trip and stay on device until the loop's normal `log_every`
+flush fetches them alongside the deferred losses.
+"""
+from __future__ import annotations
+
+import math
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+OK = "ok"
+SPIKE = "spike"
+NONFINITE = "nonfinite"
+
+
+class TrainingHealthError(RuntimeError):
+    """Unrecoverable training-health failure (rollback budget exhausted, or
+    recovery impossible — no verified checkpoint to roll back to)."""
+
+
+@dataclass
+class HealthConfig:
+    """Knobs for the training health supervisor (RunConfig.health).
+
+    Classification: a round is `nonfinite` when the on-device flag tripped
+    (NaN/Inf in the loss, gradients, or post-round params anywhere on the
+    mesh) and `spike` when its loss exceeds the rolling median by
+    `spike_mad` robust sigmas (MAD * 1.4826) over a window of the last
+    `window` HEALTHY rounds (spikes/nonfinites never enter the window, so
+    one outlier cannot inflate the scale estimate and mask the next).
+
+    Recovery (driven by the train loop): an isolated spike is skipped —
+    logged, excluded from the statistics, training continues. `nonfinite`,
+    or `spike_patience` consecutive spikes, triggers a rollback to the
+    newest VERIFIED non-anomalous checkpoint with the learning rate scaled
+    by `lr_backoff` and the retried rounds' data order advanced (round-keyed
+    rngs make the retried window deterministic-but-different). After
+    `max_rollbacks` rollbacks the run hard-fails loudly.
+    """
+
+    enabled: bool = True
+    # rolling robust statistics
+    window: int = 32            # healthy-loss window for median/MAD
+    min_history: int = 8        # rounds of history before spikes classify
+    spike_mad: float = 10.0     # spike threshold, in robust sigmas
+    # recovery policy
+    spike_patience: int = 3     # consecutive spikes that force a rollback
+    max_rollbacks: int = 3      # hard-fail budget
+    lr_backoff: float = 0.5     # lr multiplier applied per rollback (1.0 =
+    #                             off; only trainers with supports_lr_scale)
+    # deterministic fault injection (chaos tests): on the FIRST pass over
+    # these rounds (rounds above the loop's high-water mark of executed
+    # rounds) the prepared batch is poisoned — float inputs forced to NaN
+    # (inject_nan_rounds) or scaled by inject_spike_scale
+    # (inject_spike_rounds). Retried passes after a rollback are clean
+    # while LATER configured rounds still fire, so the detect -> rollback
+    # -> recover path is exercised without flakiness. Inert when
+    # `enabled` is False.
+    inject_nan_rounds: Tuple[int, ...] = ()
+    inject_spike_rounds: Tuple[int, ...] = ()
+    inject_spike_scale: float = 1e3
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "HealthConfig":
+        import dataclasses
+        known = {f.name for f in dataclasses.fields(HealthConfig)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown health config keys: {sorted(unknown)}")
+        kw = dict(d)
+        for k in ("inject_nan_rounds", "inject_spike_rounds"):
+            if k in kw:
+                kw[k] = tuple(kw[k])
+        return HealthConfig(**kw)
+
+
+def _is_finite(x: Optional[float]) -> bool:
+    return x is None or math.isfinite(x)
+
+
+class HealthMonitor:
+    """Classifies flushed round metrics and drives the recovery decision.
+
+    Purely host-side and deterministic: feed it the (round, loss,
+    grad_norm, nonfinite_count) tuples in round order via `observe`; it
+    returns the classification and latches `rollback_needed` when the
+    policy demands one (consumed by the loop via `consume_rollback`).
+    Multi-host safe by construction: the inputs are mesh-reduced scalars
+    (identical on every process), so every process reaches the same
+    decision without extra communication.
+    """
+
+    def __init__(self, cfg: HealthConfig):
+        self.cfg = cfg
+        self._window: deque = deque(maxlen=max(2, cfg.window))
+        self._consecutive_spikes = 0
+        self._rollback_needed: Optional[str] = None  # reason, when latched
+        self.last_anomaly_round: Optional[int] = None
+        self.rollbacks = 0
+        self.counts = {OK: 0, SPIKE: 0, NONFINITE: 0}
+
+    # -- rolling robust statistics -------------------------------------------
+
+    def stats(self) -> Tuple[Optional[float], Optional[float]]:
+        """(median, robust sigma = MAD * 1.4826) of the healthy window, or
+        (None, None) with insufficient history."""
+        n = len(self._window)
+        if n < max(2, self.cfg.min_history):
+            return None, None
+        xs = sorted(self._window)
+        med = _median(xs)
+        mad = _median(sorted(abs(x - med) for x in xs))
+        return med, 1.4826 * mad
+
+    # -- classification + policy ---------------------------------------------
+
+    def observe(self, rnd: int, loss: float,
+                grad_norm: Optional[float] = None,
+                nonfinite_count: float = 0.0) -> str:
+        """Classify round `rnd` and update policy state. Returns
+        'ok' | 'spike' | 'nonfinite'."""
+        cls = OK
+        if (nonfinite_count and nonfinite_count > 0) or not _is_finite(loss):
+            cls = NONFINITE
+        elif not _is_finite(grad_norm):
+            # loss/params finite but the grad-norm scalar is not: either a
+            # f32 overflow in the squared-norm accumulation (violent-but-
+            # finite divergence) or a transient Inf gradient the update
+            # absorbed. Not numerically poisoned state — classify as a
+            # spike so the skip/patience policy applies, not as nonfinite
+            # (the device flag over losses+params is the authority there).
+            cls = SPIKE
+        else:
+            med, sigma = self.stats()
+            # sigma floor at 1e-3 of the loss scale: a plateaued window
+            # (many bit-identical losses -> MAD = 0) must not turn every
+            # ordinary fluctuation above the median into a spike
+            if med is not None and loss > med + self.cfg.spike_mad * max(
+                    sigma, 1e-3 * max(abs(med), 1.0)):
+                cls = SPIKE
+        self.counts[cls] += 1
+        if cls == OK:
+            self._window.append(float(loss))
+            self._consecutive_spikes = 0
+        else:
+            self.last_anomaly_round = rnd
+            if cls == NONFINITE:
+                self._rollback_needed = NONFINITE
+            else:
+                self._consecutive_spikes += 1
+                if self._consecutive_spikes >= max(1, self.cfg.spike_patience):
+                    self._rollback_needed = "repeated spikes"
+        return cls
+
+    @property
+    def rollback_needed(self) -> Optional[str]:
+        """Reason string when the policy wants a rollback, else None."""
+        return self._rollback_needed
+
+    def consume_rollback(self) -> str:
+        """Acknowledge the latched rollback (the loop is about to perform
+        it): counts it against the budget, resets the spike streak, and
+        raises TrainingHealthError once the budget is exhausted."""
+        reason = self._rollback_needed or "unknown"
+        self._rollback_needed = None
+        self._consecutive_spikes = 0
+        # the restored state predates the anomaly: don't tag post-recovery
+        # checkpoints anomalous for an incident that was rolled away
+        self.last_anomaly_round = None
+        self.rollbacks += 1
+        if self.rollbacks > max(0, self.cfg.max_rollbacks):
+            raise TrainingHealthError(
+                f"training health: rollback budget exhausted "
+                f"({self.cfg.max_rollbacks} rollbacks) — last trigger: "
+                f"{reason}; anomalies: {self.counts[SPIKE]} spikes, "
+                f"{self.counts[NONFINITE]} nonfinite rounds. The run is "
+                f"not recovering; inspect the data/lr before relaunching.")
+        return reason
+
+    def recently_anomalous(self, rnd: int) -> bool:
+        """True when an anomaly was classified within the last `window`
+        rounds — checkpoints taken here are tagged `anomalous` so rollback
+        skips them (the state may embed the spike)."""
+        return (self.last_anomaly_round is not None
+                and rnd - self.last_anomaly_round < max(1, self.cfg.window))
+
+
+def _median(xs) -> float:
+    n = len(xs)
+    m = n // 2
+    return float(xs[m]) if n % 2 else 0.5 * (xs[m - 1] + xs[m])
+
+
+def poison_batch(batches: Dict[str, Any], mode: str,
+                 scale: float = 1e3) -> Dict[str, Any]:
+    """Deterministically poison one round's prepared batch (fault-injection
+    hook): float arrays get NaN ('nan') or a *scale blowup ('spike');
+    integer arrays (labels) are left intact. Returns a new dict — the
+    original arrays are not mutated."""
+    import numpy as np
+
+    out = {}
+    for k, v in batches.items():
+        a = np.asarray(v)
+        if np.issubdtype(a.dtype, np.floating):
+            out[k] = (np.full_like(a, np.nan) if mode == "nan"
+                      else a * a.dtype.type(scale))
+        else:
+            out[k] = v
+    return out

@@ -8,10 +8,21 @@ for machine-readable metrics (the reference's gap, SURVEY §5.5).
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
 from typing import Any, Dict, Optional
+
+
+def _json_safe(v: Any) -> Any:
+    """NaN/Inf serialize as null: json.dumps would emit bare NaN/Infinity
+    tokens, which are outside RFC 8259 and break jq / pandas / non-Python
+    consumers of the metrics JSONL (nonfinite rounds are now ROUTINELY
+    logged by the health supervisor instead of crashing the run)."""
+    if isinstance(v, float) and not math.isfinite(v):
+        return None
+    return v
 
 
 class Logger:
@@ -37,9 +48,20 @@ class Logger:
         if self._jsonl:
             rec: Dict[str, Any] = {"step": step,
                                    "t": round(time.time() - self.t0, 3)}
-            rec.update({k: (float(v) if hasattr(v, "__float__") else v)
+            rec.update({k: _json_safe(float(v) if hasattr(v, "__float__")
+                                      else v)
                         for k, v in kv.items()})
             self._jsonl.write(json.dumps(rec) + "\n")
+
+    def event(self, step: int, event: str, **kv: Any) -> None:
+        """A structured lifecycle event in BOTH channels: a human line in
+        the text log and an {"event": ...} record in the metrics JSONL —
+        the health supervisor's audit trail (spike_skip, rollback,
+        anomalous_checkpoint, ...) must be machine-recoverable next to the
+        loss curve it explains."""
+        detail = " ".join(f"{k}={v}" for k, v in kv.items())
+        self.log(f"[{event}] {detail}" if detail else f"[{event}]", step)
+        self.metrics(step, event=event, **kv)
 
     def close(self) -> None:
         for f in (self._f, self._jsonl):

@@ -197,8 +197,13 @@ def test_streaming_source_through_train_loop(tmp_path):
     schema = Schema(Field("data", "float32", (crop, crop, 3)),
                     Field("label", "int32", (1,)))
     pp = ImagePreprocessor(schema, mean_image=None, crop=crop, seed=0)
+    # health off: raw 0-255 pixels (no mean image) blow this throwaway net
+    # up within a few rounds by design — the plumbing, not the dynamics,
+    # is under test, and the supervisor would (correctly) intervene
+    from sparknet_tpu.utils.health import HealthConfig
     cfg = small_cfg(tmp_path, local_batch=local_b, tau=tau, max_rounds=3,
-                    eval_every=0, crop=crop)
+                    eval_every=0, crop=crop,
+                    health=HealthConfig(enabled=False))
     log_path = str(tmp_path / "slog.txt")
     state = train(cfg, cifar10_quick(batch=local_b), src,
                   logger=Logger(log_path, echo=False), batch_transform=pp)

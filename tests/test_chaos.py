@@ -70,10 +70,16 @@ n_local = jax.local_device_count()
 src = HashingSource(make_parallel_source(
     imagenet.list_shards(root), imagenet.load_label_map(root + '/train.txt'),
     n_local, 2, 2, n_sources=2, height=28, width=28), hashlog)
+# health off: the fixture net diverges on purpose (raw 0-255 pixels) and a
+# supervisor rollback would advance the retried rounds' data order —
+# breaking this test's round->hash bit-exactness invariant, which is about
+# PREEMPTION resume, not anomaly recovery (test_health.py covers that)
+from sparknet_tpu.utils.health import HealthConfig
 cfg = RunConfig(model='lenet', tau=2, local_batch=2,
                 max_rounds=int(max_rounds), eval_every=0, seed=0,
                 checkpoint_dir=ckdir, checkpoint_every=1,
-                workdir=os.path.dirname(hashlog))
+                workdir=os.path.dirname(hashlog),
+                health=HealthConfig(enabled=False))
 train(cfg, lenet(batch=2), src, None,
       logger=Logger(os.path.join(os.path.dirname(hashlog), 'train.txt'),
                     echo=False),
@@ -108,6 +114,7 @@ def _hashes(path):
 
 
 @pytest.mark.slow
+@pytest.mark.chaos
 @pytest.mark.parametrize("store", ["local", "gs"])
 def test_kill9_resume_matches_uninterrupted(tmp_path, store):
     """`store='gs'` runs the SAME kill -9 chaos over a fake-GCS bucket —

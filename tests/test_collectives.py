@@ -93,7 +93,10 @@ def _build(tau: int):
 
 def _round_collectives(tau: int):
     trainer, state, sharded, rngs = _build(tau)
-    hlo = trainer._round.lower(state, sharded, rngs).compile().as_text()
+    import jax.numpy as jnp
+    hlo = trainer._round.lower(state, sharded, rngs,
+                               jnp.asarray(1.0, jnp.float32)
+                               ).compile().as_text()
     per_replica_param_bytes = sum(
         int(np.prod(leaf.shape[1:])) * leaf.dtype.itemsize
         for lp in jax.tree.leaves(
@@ -116,12 +119,15 @@ def test_round_moves_one_param_copy(tau2):
     # Anything else on the wire is a regression.
     assert kinds == {"all-reduce"}, f"unexpected collectives: {kinds}"
     total = sum(b for _, b in colls)
-    # one param copy + the f32 loss scalar (combiner padding tolerance 1%)
+    # one param copy + three f32 scalars: the loss and the two health
+    # signals (grad_norm, nonfinite count — reduced over τ BEFORE the
+    # psum, so they stay scalars; combiner padding tolerance 1%)
     assert param_bytes <= total <= int(param_bytes * 1.01) + 256, (
         f"round all-reduces {total} bytes; params are {param_bytes} — "
         f"{'momentum or batch data is on the wire' if total > param_bytes * 1.5 else 'short of one param copy'}")
-    assert len(colls) <= n_leaves + 1, (
-        f"{len(colls)} collective ops for {n_leaves} param leaves")
+    assert len(colls) <= n_leaves + 3, (
+        f"{len(colls)} collective ops for {n_leaves} param leaves "
+        f"(+ loss + 2 health scalars)")
 
 
 def test_round_collective_bytes_tau_invariant(tau2):
@@ -173,9 +179,10 @@ def _tp_round_collectives(tau: int = 2, dp: int = 4, tp: int = 2):
     rngs = place_global_state(
         jax.random.split(jax.random.PRNGKey(1), dp),
         trainer.mesh, P(DATA_AXIS))
+    import jax.numpy as jnp
     hlo = trainer._round.lower(
         trainer.init_state(jax.random.PRNGKey(0)), sharded,
-        rngs).compile().as_text()
+        rngs, jnp.asarray(1.0, jnp.float32)).compile().as_text()
     params = net.init_params(jax.random.PRNGKey(0))
     per_replica_param_bytes = sum(
         l.nbytes for l in jax.tree.leaves(params))
@@ -207,15 +214,17 @@ def test_tp_round_collective_kinds_and_weight_bytes(tp_tau2):
         f"actually sharded? kinds={kinds}")
     ar_bytes = sum(b for k, b in colls if k == "all-reduce")
     # sharded-layer params (here: ALL layers are TP-shardable InnerProducts)
-    # cross the wire as 1/tp each; ONLY the f32 loss scalar rides along
-    # (tight absolute slack: at these ~360-byte shapes a single layer's
-    # shards-summed regression is only ~130 bytes — a big blanket slack
-    # would mask exactly the bug class this pins)
+    # cross the wire as 1/tp each; only f32 SCALARS ride along — the loss
+    # plus the two health signals (grad_norm, nonfinite), each psum'd over
+    # data AND vma-cleared over the model axis: 6 × 4 = 24 bytes. Slack 32
+    # stays tight: at these ~360-byte shapes a single layer's shards-summed
+    # regression is ~130 bytes — a big blanket slack would mask exactly
+    # the bug class this pins.
     logical = full_param_bytes / tp
-    assert logical <= ar_bytes <= logical + 16, (
+    assert logical <= ar_bytes <= logical + 32, (
         f"weight-average all-reduce moved {ar_bytes} bytes; expected "
         f"~{int(logical)} (one LOGICAL copy: full {full_param_bytes} / "
-        f"tp {tp})")
+        f"tp {tp}) + scalar riders")
 
 
 def test_tp_round_allgather_bytes_tau_scale(tp_tau2):

@@ -380,10 +380,15 @@ def test_run_loop_checkpoint_carries_stream_cursor(tmp_path):
         return StreamingRoundSource(loader, n_local, 2, 2)
 
     def make_cfg(rounds):
+        # health off: this trains a throwaway lenet on RAW 0-255 pixels (a
+        # cursor-bookkeeping fixture, not a convergence run) — it diverges
+        # violently by design, and the supervisor would (correctly) step in
+        from sparknet_tpu.utils.health import HealthConfig
         return RunConfig(model="lenet", tau=2, local_batch=2,
                          max_rounds=rounds, workdir=str(tmp_path), seed=0,
                          eval_every=0, checkpoint_dir=str(tmp_path / "ck"),
-                         checkpoint_every=2)
+                         checkpoint_every=2,
+                         health=HealthConfig(enabled=False))
 
     class GrayTo28:
         def convert_batch(self, batch, train=True, rng=None):
@@ -665,10 +670,15 @@ def test_run_loop_checkpoint_carries_parallel_cursors(tmp_path):
             n_local, 2, 2, n, height=28, width=28)
 
     def make_cfg(rounds):
+        # health off: this trains a throwaway lenet on RAW 0-255 pixels (a
+        # cursor-bookkeeping fixture, not a convergence run) — it diverges
+        # violently by design, and the supervisor would (correctly) step in
+        from sparknet_tpu.utils.health import HealthConfig
         return RunConfig(model="lenet", tau=2, local_batch=2,
                          max_rounds=rounds, workdir=str(tmp_path), seed=0,
                          eval_every=0, checkpoint_dir=str(tmp_path / "ck"),
-                         checkpoint_every=2)
+                         checkpoint_every=2,
+                         health=HealthConfig(enabled=False))
 
     class GrayTo28:
         def convert_batch(self, batch, train=True, rng=None):

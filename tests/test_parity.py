@@ -395,8 +395,9 @@ def test_parity_caffenet_round_matches_trainer():
                 data[t, w * b + k] = img[y:y + crop, x:x + crop]
                 lab[t, w * b + k] = labels[idx[w, t, k]]
     rngs = place_global_state(keys, trainer.mesh, P(DATA_AXIS))
-    tr_state, tr_loss = trainer._round(
-        state, trainer._shard_batches({"data": data, "label": lab}), rngs)
+    tr_state, tr_loss, _ = trainer._round(
+        state, trainer._shard_batches({"data": data, "label": lab}), rngs,
+        jnp.asarray(1.0, jnp.float32))
 
     assert float(pc_loss) == pytest.approx(float(tr_loss), rel=1e-5)
     tr_params = trainer.averaged_params(tr_state)
