@@ -21,11 +21,11 @@
 #      scripts/tpu_pod_launch.sh watch mypod us-east5-b v5e-32 \
 #        "python -m sparknet_tpu.apps.imagenet_app \
 #         --data-dir gs://mybucket/imagenet ingest_sources=8 \
-#         checkpoint_dir=/gcs/ckpts/run1"
-#    (--data-dir gs://… or s3://… streams the bucket NATIVELY — ranged
-#    HTTP reads with reconnect-resume, sparknet_tpu/data/{gcs,s3}.py; no
-#    FUSE mount and no cloud SDK in the data path. checkpoint_dir still
-#    wants a mounted/shared filesystem.)
+#         checkpoint_dir=gs://mybucket/ckpts/run1"
+#    (--data-dir AND checkpoint_dir take gs://… or s3://… NATIVELY —
+#    ranged HTTP reads with reconnect-resume and chunked atomic uploads,
+#    sparknet_tpu/data/{gcs,s3.py} + utils/checkpoint.py; no FUSE mount
+#    and no cloud SDK anywhere in the data or checkpoint path.)
 # 2. Capacity is reclaimed mid-run (state PREEMPTED, or the VM disappears).
 #    `watch` notices — either the ssh run dies and the state probe says so,
 #    or the next poll does — deletes the husk, recreates the VM (same TYPE,
@@ -34,7 +34,8 @@
 # 3. The app resumes itself: RunConfig.resume defaults true, so the relaunch
 #    loads the latest checkpoint (params + momentum + round + stream cursor
 #    + mean-image sidecar) from checkpoint_dir and continues — that is why
-#    checkpoint_dir must NOT be on the TPU VM's local disk.
+#    checkpoint_dir must NOT be on the TPU VM's local disk: point it at a
+#    bucket (gs://…/s3://…, written natively) or any shared filesystem.
 # 4. Ctrl-C on `watch` stops supervising (the pod itself is untouched);
 #    `resume` is the manual one-shot of the same recover+rerun step.
 # To drill the path without waiting for a real preemption: delete the VM
@@ -79,8 +80,8 @@
 #      jax.process_index()/process_count(); in-memory datasets are sliced
 #      with ArrayDataset.host_shard(process_index, process_count);
 #   3. checkpoints are allgathered and written by process 0 — point
-#      checkpoint_dir at storage all hosts can read (GCS fuse / NFS) so
-#      resume works.
+#      checkpoint_dir at storage all hosts can read so resume works: a
+#      gs://|s3:// bucket (native writers, no mount) or a shared FS.
 # A failed `run` on any worker propagates a non-zero exit (no silent
 # per-host divergence).
 set -eu

@@ -57,7 +57,8 @@ def train_graph(cfg: RunConfig, graph: GraphDef, train_ds, test_ds=None,
         check_input_shape(net, "data", expect_data_shape)
     mesh = make_mesh(cfg.n_devices)
     trainer = GraphTrainer(net, mesh, tau=cfg.tau,
-                           compute_health=cfg.health.enabled)
+                           compute_health=(cfg.health is not None
+                                           and cfg.health.enabled))
     log.log(f"graph backend: {len(net.variable_names)} variables; "
             f"mesh {trainer.n_devices} devices; tau={cfg.tau} "
             f"local_batch={cfg.local_batch}")

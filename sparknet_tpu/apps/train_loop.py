@@ -9,9 +9,13 @@ Reference shape (`apps/CifarApp.scala:100-149`):
       log conv1[0] divergence probe            -> probe_value()
 
 Additions the reference lacked (SURVEY §5.3-5.5): checkpoint/resume of the
-full TrainState + round counter, metrics JSONL, per-phase timing, a
-termination condition (max_rounds instead of `while(true)`), and the
-training health supervisor: on-device anomaly signals classified per flush,
+full TrainState + round counter — saved through a TWO-STAGE async pipeline
+(stage 1 blocks only for the device->host fetch; a background writer
+serializes, digests, and persists to a local dir or natively to a
+gs://|s3:// bucket, at most one snapshot in flight), metrics JSONL,
+per-phase timing, a termination condition (max_rounds instead of
+`while(true)`), and the training health supervisor: on-device anomaly
+signals classified per flush,
 skip-and-continue for isolated loss spikes, rollback to the newest verified
 checkpoint (with LR backoff and an advanced data order for the retried
 window) for nonfinite rounds or repeated spikes, and a loud hard-fail once
@@ -19,6 +23,7 @@ the rollback budget is spent (utils/health.py).
 """
 from __future__ import annotations
 
+import warnings
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional
 
@@ -114,7 +119,8 @@ def train(cfg: RunConfig, spec: NetSpec, train_ds: ArrayDataset,
     n_dev = int(np.prod(mesh.devices.shape))
     trainer = ParallelTrainer(net, cfg.solver, mesh, tau=cfg.tau,
                               mode=cfg.mode,
-                              compute_health=cfg.health.enabled)
+                              compute_health=(cfg.health is not None
+                                              and cfg.health.enabled))
     log.log(f"mesh: {n_dev} devices; tau={cfg.tau} mode={cfg.mode} "
             f"local_batch={cfg.local_batch} precision={cfg.precision}")
     if batch_transform is None:
@@ -246,8 +252,28 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     # the prefetch thread would otherwise see the default
     compute_dt = precision.compute_dtype()
 
-    health_cfg = cfg.health if cfg.health is not None else HealthConfig()
+    # cfg.health=None means NO supervisor — same reading the trainer
+    # construction sites use (compute_health=False), so the monitor and
+    # the compiled round can't disagree about whether health is on
+    health_cfg = (cfg.health if cfg.health is not None
+                  else HealthConfig(enabled=False))
     monitor = HealthMonitor(health_cfg) if health_cfg.enabled else None
+    # stage-2 background checkpoint writer (serialize+digest+persist off
+    # the round loop's critical path; at most one snapshot in flight).
+    # None = fully synchronous saves (cfg.checkpoint_async=False).
+    ck_writer = (ckpt.AsyncCheckpointWriter()
+                 if cfg.checkpoint_dir and cfg.checkpoint_async else None)
+
+    def ckpt_barrier() -> None:
+        """Settle the store before READING it: drain the in-flight write
+        (re-raising its failure), and on a pod make every process wait for
+        process 0's writer — a rollback target chosen while the newest
+        snapshot is still uploading would diverge across hosts."""
+        if ck_writer is not None:
+            ck_writer.wait()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("ckpt_write_barrier")
     # rollback generation: bumped per recovery; folds into the round rng
     # and the sampler's logical round so the retried window is
     # deterministic-but-different. retry == 0 reproduces the legacy
@@ -341,6 +367,7 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
                 f"training health: {reason} detected but no checkpoint_dir "
                 f"is configured — nothing to roll back to. Enable "
                 f"checkpointing or disable cfg.health.")
+        ckpt_barrier()  # the in-flight write may BE the rollback target
         found = ckpt.restore_newest_verified(cfg.checkpoint_dir,
                                              skip_anomalous=True)
         if found is None:
@@ -375,6 +402,9 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
 
     log_every = max(1, cfg.log_every)
     rnd = start_round
+    loop_completed = False  # set on the normal exit path only: the
+    # finally block must re-raise a failed background checkpoint write on
+    # a clean run, but never mask the exception of an aborted one
     try:
         while rnd < cfg.max_rounds:
             if monitor is not None and monitor.rollback_needed:
@@ -441,16 +471,21 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
                     #           poisoned one; loop top recovers instead
                 anomalous = (monitor is not None
                              and monitor.recently_anomalous(rnd))
-                with timers.phase("checkpoint"):  # save syncs anyway
+                # the timed phase is the loop's BLOCKING stall only: the
+                # device->host fetch (+ waiting out a still-running
+                # previous write); stage 2 persists in the background
+                with timers.phase("checkpoint"):
                     _save_checkpoint(cfg, trainer, state, rnd + 1,
                                      source=source, last_round=rnd,
                                      anomalous=anomalous,
                                      health_state=_health_state(
-                                         retry, lr_scale, monitor))
+                                         retry, lr_scale, monitor),
+                                     writer=ck_writer)
                 if anomalous:
                     log.event(rnd, "anomalous_checkpoint",
                               checkpoint_step=rnd + 1)
-                log.log("checkpoint saved", rnd)
+                log.log("checkpoint saved" if ck_writer is None else
+                        "checkpoint snapshotted (async write)", rnd)
             if round_hook:
                 round_hook(rnd, state)
             rnd += 1
@@ -461,6 +496,7 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
                 flush_deferred()
                 if monitor is not None and monitor.rollback_needed:
                     state, rnd = recover(state)
+        loop_completed = True
     finally:
         if deferred:  # loop aborted: drain the pending fetches
             try:
@@ -472,6 +508,21 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         prefetch.shutdown(wait=False, cancel_futures=True)
         if hasattr(source, "close"):
             source.close()
+        if ck_writer is not None:
+            # loop exit barriers on the in-flight write: a RUNNING stage-2
+            # write always completes (the final checkpoint below, and any
+            # reader of the dir after train() returns, must see a settled
+            # store). On the normal path a failed background write raises
+            # here; when another exception is already propagating
+            # (loop_completed is still False) it must not be masked — log
+            # and let the original win.
+            try:
+                ck_writer.close(wait=True)
+            except Exception as e:
+                if loop_completed:
+                    raise
+                log.log(f"background checkpoint write failed during "
+                        f"abort: {e}")
 
     if cfg.checkpoint_dir and start_round < cfg.max_rounds:
         # start_round >= max_rounds means the loop ran ZERO rounds (a
@@ -602,18 +653,42 @@ def _save_checkpoint(cfg: RunConfig, trainer, state, step: int,
                      retain: bool = True, source=None,
                      last_round: Optional[int] = None,
                      anomalous: bool = False,
-                     health_state: Optional[Dict[str, Any]] = None) -> None:
-    """Allgather (a collective — every host must call this) then write from
-    process 0 only. Momentum is worker-local, so the gather is substantive,
-    not a replica read. The saved topology (device count, tp) lets a
-    differently-sized job resume elastically; streaming sources also
-    record their per-host stream cursor so resume seeks instead of
-    re-streaming from shard 0. `anomalous=True` tags a checkpoint taken
-    during an unhealthy training window (recent spike/nonfinite rounds) so
-    the health supervisor's rollback skips it."""
+                     health_state: Optional[Dict[str, Any]] = None,
+                     writer: Optional[ckpt.AsyncCheckpointWriter] = None
+                     ) -> None:
+    """Two-stage checkpoint save. Stage 1 (here, blocking, collective —
+    every host must call this): allgather the state to host buffers and
+    snapshot the stream cursors. Momentum is worker-local, so the gather
+    is substantive, not a replica read. Stage 2 (serialize + digest +
+    persist, process 0 only): inline when `writer` is None, else handed to
+    the background writer thread so the round loop resumes as soon as the
+    host buffers exist — the snapshot is immutable numpy, so later rounds
+    can't tear it. The saved bytes, digests, and tagging are IDENTICAL in
+    both modes.
+
+    The saved topology (device count, tp) lets a differently-sized job
+    resume elastically; streaming sources also record their per-host
+    stream cursor so resume seeks instead of re-streaming from shard 0.
+    `anomalous=True` tags a checkpoint taken during an unhealthy training
+    window (recent spike/nonfinite rounds) so the health supervisor's
+    rollback skips it."""
     host_state = fetch_global(state)
+    if writer is not None:
+        # the background writer must OWN its bytes: np.asarray on a CPU-
+        # backend jax array can be a zero-copy VIEW of the device buffer,
+        # and the next round's jitted step DONATES that buffer — the sync
+        # path finished serializing before the donation could reuse it,
+        # but stage 2 overlaps later rounds. One defensive memcpy of any
+        # non-owning leaf (~50 ms for a 244 MB state, still ~1000x under
+        # the sync stall); real-device fetches already own their memory
+        # and copy nothing here.
+        host_state = jax.tree.map(
+            lambda a: a if a.flags["OWNDATA"] else np.array(a), host_state)
     stream = _stream_rows(source, last_round) if source is not None else None
-    if jax.process_index() == 0:
+    if jax.process_index() != 0:
+        return
+
+    def persist() -> None:
         extra = {"n_devices": trainer.n_devices,
                  "tp": getattr(trainer, "tp", 1)}
         if stream is not None:
@@ -624,7 +699,23 @@ def _save_checkpoint(cfg: RunConfig, trainer, state, step: int,
             extra["health"] = health_state
         ckpt.save(cfg.checkpoint_dir, host_state, step=step, extra=extra)
         if retain:
-            ckpt.retain(cfg.checkpoint_dir, keep=3)
+            try:
+                ckpt.retain(cfg.checkpoint_dir, keep=3)
+            except Exception as e:
+                # retention is best-effort (its own delete paths already
+                # warn-and-continue): a store blip during the protect
+                # scan's reads must not surface as a FATAL writer error
+                # when the checkpoint itself saved fine — the next save
+                # re-runs retention. The propagation inside retain still
+                # matters: it aborts the scan BEFORE deleting anything.
+                warnings.warn(f"checkpoint retention failed (snapshot "
+                              f"step-{step} saved OK): {e}",
+                              RuntimeWarning)
+
+    if writer is not None:
+        writer.submit(persist)
+    else:
+        persist()
 
 
 def _to_device_layout(ds: ArrayDataset, net: CompiledNet) -> ArrayDataset:

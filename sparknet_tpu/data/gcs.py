@@ -178,11 +178,19 @@ class GcsClient:
     def list_objects(self, bucket: str, prefix: str = ""
                      ) -> List[Tuple[str, int]]:
         """[(name, size), ...] under prefix, paginated, name-sorted."""
-        out: List[Tuple[str, int]] = []
+        return [(n, s) for n, s, _ in self.list_objects_meta(bucket, prefix)]
+
+    def list_objects_meta(self, bucket: str, prefix: str = ""
+                          ) -> List[Tuple[str, int, Optional[str]]]:
+        """[(name, size, generation), ...] under prefix, paginated,
+        name-sorted. Generation rides the same listing request (one extra
+        field) so freshness tokens cost no additional round trips; servers
+        that omit it (older emulators) yield None."""
+        out: List[Tuple[str, int, Optional[str]]] = []
         token = None
         while True:
             q = {"prefix": prefix,
-                 "fields": "items(name,size),nextPageToken"}
+                 "fields": "items(name,size,generation),nextPageToken"}
             if token:
                 q["pageToken"] = token
             url = (f"{self.endpoint}/storage/v1/b/"
@@ -190,7 +198,8 @@ class GcsClient:
                    + urllib.parse.urlencode(q))
             with self._open(url) as r:
                 d = json.loads(r.read())
-            out.extend((it["name"], int(it.get("size", 0)))
+            out.extend((it["name"], int(it.get("size", 0)),
+                        it.get("generation"))
                        for it in d.get("items", []))
             token = d.get("nextPageToken")
             if not token:
@@ -334,6 +343,11 @@ class GcsRangeStream(io.RawIOBase):
 #: (corpus identity, host weight estimates) cost no extra round trips
 _SIZE_CACHE: dict = {}
 
+#: gs:// url -> (size, generation), filled alongside _SIZE_CACHE — the
+#: freshness token pair the member-index staleness check compares (size
+#: alone misses an equal-size replacement; generation cannot)
+_STAT_CACHE: dict = {}
+
 #: endpoint -> shared GcsClient: the token cache lives on the client, and
 #: the ingest hot path opens one stream per tar per epoch — a fresh client
 #: per call would re-fetch credentials (a metadata-server round trip, or
@@ -359,17 +373,35 @@ def gs_list_shards(root: str, prefix: str = "") -> List[str]:
         base += "/"
     client = _shared_client()
     out = []
-    for name, size in client.list_objects(bucket, base):
+    for name, size, gen in client.list_objects_meta(bucket, base):
         rel = name[len(base):]
         if "/" in rel:  # direct children only, like os.listdir
             continue
         if rel.startswith(prefix) and rel.endswith(".tar"):
             url = f"gs://{bucket}/{name}"
             _SIZE_CACHE[url] = size
+            _STAT_CACHE[url] = (size, gen)
             out.append(url)
     if not out:
         raise FileNotFoundError(f"no .tar shards under {root!r} "
                                 f"matching prefix {prefix!r}")
+    return sorted(out)
+
+
+def gs_list_urls(root: str) -> List[str]:
+    """ALL object urls under a gs:// prefix (recursive, sorted; empty list
+    when nothing matches — unlike gs_list_shards this is not tar-specific
+    and a bare prefix is not an error: the checkpoint store lists a
+    possibly-empty directory)."""
+    bucket, base = parse_gs_url(root)
+    if base and not base.endswith("/"):
+        base += "/"
+    out = []
+    for name, size, gen in _shared_client().list_objects_meta(bucket, base):
+        url = f"gs://{bucket}/{name}"
+        _SIZE_CACHE[url] = size
+        _STAT_CACHE[url] = (size, gen)
+        out.append(url)
     return sorted(out)
 
 
@@ -379,15 +411,29 @@ def gs_size(url: str, fresh: bool = False) -> int:
     an object replaced under a warm member index."""
     if not fresh and url in _SIZE_CACHE:
         return _SIZE_CACHE[url]
+    return gs_stat(url, fresh=fresh)[0]
+
+
+def gs_stat(url: str, fresh: bool = False
+            ) -> Tuple[int, Optional[str]]:
+    """(size, generation) from one metadata GET (`?fields=size,generation`
+    — the same request the size-only check used, one extra field). The
+    generation is the freshness token the member-index staleness check
+    needs: an EQUAL-size replacement changes generation even though size
+    alone cannot see it."""
+    if not fresh and url in _STAT_CACHE:
+        return _STAT_CACHE[url]
     bucket, name = parse_gs_url(url)
     client = _shared_client()
     u = (f"{client.endpoint}/storage/v1/b/"
          f"{urllib.parse.quote(bucket, safe='')}/o/"
-         f"{urllib.parse.quote(name, safe='')}?fields=size")
+         f"{urllib.parse.quote(name, safe='')}?fields=size,generation")
     with client._open(u) as r:
-        size = int(json.loads(r.read()).get("size", 0))
-    _SIZE_CACHE[url] = size
-    return size
+        d = json.loads(r.read())
+    stat = (int(d.get("size", 0)), d.get("generation"))
+    _SIZE_CACHE[url] = stat[0]
+    _STAT_CACHE[url] = stat
+    return stat
 
 
 def gs_read(url: str) -> bytes:
@@ -415,3 +461,162 @@ def gs_write(url: str, data: bytes) -> None:
             client.timeout, method="POST", data=data) as r:
         r.read()
     _SIZE_CACHE[url] = len(data)
+    _STAT_CACHE.pop(url, None)
+
+
+def gs_delete(url: str, missing_ok: bool = True) -> None:
+    """DELETE an object; 404 is success when `missing_ok` (retention and
+    part cleanup race nothing — only one writer per checkpoint dir)."""
+    bucket, name = parse_gs_url(url)
+    client = _shared_client()
+    u = (f"{client.endpoint}/storage/v1/b/"
+         f"{urllib.parse.quote(bucket, safe='')}/o/"
+         f"{urllib.parse.quote(name, safe='')}")
+    try:
+        with http_get_with_retry(u, client._auth_header(), client.timeout,
+                                 method="DELETE") as r:
+            r.read()
+    except urllib.error.HTTPError as e:
+        if not (missing_ok and e.code == 404):
+            raise
+    _SIZE_CACHE.pop(url, None)
+    _STAT_CACHE.pop(url, None)
+
+
+# -- resumable / composite upload (the checkpoint writer's push side) --------
+
+#: resumable-upload chunk granularity — the GCS protocol requires every
+#: non-final chunk be a multiple of 256 KiB; 8 MiB balances per-chunk HTTP
+#: overhead against retry re-send cost
+GS_UPLOAD_CHUNK = 8 << 20
+
+#: component count for parallel composite uploads of large blobs (the
+#: ~244 MB checkpoint state.npz): each part is its own resumable session
+#: on its own thread, then one compose call finalizes the object
+GS_UPLOAD_PARALLEL = 4
+
+
+def gs_write_resumable(url: str, data,
+                       chunk_bytes: Optional[int] = None) -> None:
+    """Upload bytes-like `data` (bytes or a zero-copy memoryview) via ONE
+    resumable-upload session: initiate (POST
+    `uploadType=resumable` -> session URL), then sequential chunk PUTs with
+    `Content-Range`. The object becomes visible only when the FINAL chunk
+    lands — a killed writer leaves no partial object, which is the
+    atomicity the checkpoint store's upload-then-finalize protocol needs.
+    Intermediate chunks answer 308 (Resume Incomplete); the final one 200."""
+    if chunk_bytes is None:
+        chunk_bytes = GS_UPLOAD_CHUNK  # read at call time: patchable
+    if chunk_bytes % (256 << 10):
+        raise ValueError(f"chunk_bytes {chunk_bytes} is not a multiple of "
+                         f"256 KiB (GCS resumable-upload granularity)")
+    bucket, name = parse_gs_url(url)
+    client = _shared_client()
+    u = (f"{client.endpoint}/upload/storage/v1/b/"
+         f"{urllib.parse.quote(bucket, safe='')}/o?uploadType=resumable"
+         f"&name={urllib.parse.quote(name, safe='')}")
+    with http_get_with_retry(
+            u, {**client._auth_header(),
+                "x-upload-content-length": str(len(data)),
+                "Content-Type": "application/octet-stream"},
+            client.timeout, method="POST") as r:
+        r.read()
+        session = r.headers.get("Location")
+    if not session:
+        raise IOError(f"gcs: resumable-upload initiate for {url} returned "
+                      f"no session Location")
+    total = len(data)
+    sent = 0
+    while True:
+        # bytes() per chunk: `data` may be a zero-copy memoryview of the
+        # serialized state (checkpoint writer); urllib needs real bytes,
+        # so copy only one chunk at a time, never the whole blob
+        chunk = bytes(data[sent:sent + chunk_bytes])
+        end = sent + len(chunk) - 1
+        rng = (f"bytes {sent}-{end}/{total}" if chunk
+               else f"bytes */{total}")  # zero-byte object: one finalize PUT
+        try:
+            with http_get_with_retry(
+                    session, {"Content-Range": rng}, client.timeout,
+                    method="PUT", data=chunk) as r:
+                r.read()
+        except urllib.error.HTTPError as e:
+            if e.code != 308:  # 308 = chunk accepted, session continues
+                raise
+        sent += len(chunk)
+        if sent >= total:
+            break
+    _SIZE_CACHE[url] = total
+    _STAT_CACHE.pop(url, None)
+
+
+def gs_compose(dest_url: str, part_urls: List[str]) -> None:
+    """Server-side compose of up to 32 source objects into `dest_url` (the
+    finalize step of a parallel composite upload): the destination appears
+    atomically, or not at all."""
+    bucket, name = parse_gs_url(dest_url)
+    parts = []
+    for p in part_urls:
+        b, n = parse_gs_url(p)
+        if b != bucket:
+            raise ValueError(f"compose source {p} not in bucket {bucket}")
+        parts.append(n)
+    client = _shared_client()
+    u = (f"{client.endpoint}/storage/v1/b/"
+         f"{urllib.parse.quote(bucket, safe='')}/o/"
+         f"{urllib.parse.quote(name, safe='')}/compose")
+    body = json.dumps({"sourceObjects": [{"name": n} for n in parts]}
+                      ).encode()
+    with http_get_with_retry(
+            u, {**client._auth_header(),
+                "Content-Type": "application/json"},
+            client.timeout, method="POST", data=body) as r:
+        r.read()
+    _SIZE_CACHE.pop(dest_url, None)
+    _STAT_CACHE.pop(dest_url, None)
+
+
+def gs_write_large(url: str, data, *,
+                   parallel: Optional[int] = None,
+                   chunk_bytes: Optional[int] = None) -> None:
+    """Bulk upload of bytes-like `data` (bytes, or a memoryview that is
+    never copied whole) for multi-hundred-MB blobs (checkpoint state.npz):
+    split into `parallel` component objects uploaded CONCURRENTLY (each its
+    own resumable session — gsutil's parallel composite upload shape), then
+    one compose finalizes the destination and the parts are deleted. Small
+    payloads (one chunk or parallel=1) take a single resumable session.
+    Either way the destination object appears atomically: a writer killed
+    mid-upload leaves at most invisible sessions / stray `.part-` objects,
+    never a torn destination."""
+    if parallel is None:
+        parallel = GS_UPLOAD_PARALLEL
+    if chunk_bytes is None:
+        chunk_bytes = GS_UPLOAD_CHUNK
+    if parallel <= 1 or len(data) <= chunk_bytes:
+        gs_write_resumable(url, data, chunk_bytes)
+        return
+    from concurrent.futures import ThreadPoolExecutor
+    n = min(parallel, -(-len(data) // chunk_bytes))
+    # part boundaries on chunk granularity (non-final resumable chunks
+    # must be 256 KiB-aligned; aligning parts keeps every chunk aligned)
+    per = -(-len(data) // n)
+    per = -(-per // chunk_bytes) * chunk_bytes
+    bounds = [(i, min(i + per, len(data)))
+              for i in range(0, len(data), per)]
+    nonce = os.urandom(6).hex()
+    part_urls = [f"{url}.part-{nonce}-{k:04d}" for k in range(len(bounds))]
+    try:
+        with ThreadPoolExecutor(len(bounds),
+                                thread_name_prefix="gs-part") as ex:
+            list(ex.map(lambda ab: gs_write_resumable(
+                ab[0], data[ab[1][0]:ab[1][1]], chunk_bytes),
+                zip(part_urls, bounds)))
+        gs_compose(url, part_urls)
+    finally:
+        for p in part_urls:  # success or abort: parts must not linger
+            try:
+                gs_delete(p)
+            except Exception:
+                pass
+    _SIZE_CACHE[url] = len(data)
+    _STAT_CACHE.pop(url, None)

@@ -76,6 +76,21 @@ def path_size(path: str, fresh: bool = False) -> int:
     return os.path.getsize(path)
 
 
+def path_stat(path: str, fresh: bool = False) -> Tuple[int, Optional[str]]:
+    """(size, freshness token) — generation for gs://, ETag for s3://,
+    None for local files. Both ride the SAME metadata request the
+    size-only probe already made, and together they catch what size alone
+    cannot: an EQUAL-size replacement of a bucket object (which would
+    otherwise be carved at stale member offsets into garbage)."""
+    from .gcs import gs_stat, is_gs_path
+    from .s3 import is_s3_path, s3_stat
+    if is_gs_path(path):
+        return gs_stat(path, fresh=fresh)
+    if is_s3_path(path):
+        return s3_stat(path, fresh=fresh)
+    return os.path.getsize(path), None
+
+
 def _check_tar_terminator(path: str) -> None:
     """Raise TruncatedTarError when a LOCAL tar lacks its zero
     end-of-archive blocks — a shard truncated exactly at a member boundary
@@ -234,14 +249,13 @@ class ShardedTarLoader:
         if is_bucket:
             cached = self._bucket_indices.get(path)
             if cached is not None:
-                bidx, size_at_capture = cached
+                bidx, stat_at_capture = cached
                 # a replaced object makes the recorded offsets garbage:
-                # one fresh metadata request per shard per epoch catches
-                # any size change and falls back to the tarfile walk
-                # (which re-captures). An EQUAL-size replacement still
-                # slips through — its members then fail JPEG decode and
-                # show in `skipped`, which the apps surface.
-                if path_size(path, fresh=True) != size_at_capture:
+                # one fresh metadata request per shard per epoch compares
+                # (size, generation|ETag) — the token catches even an
+                # EQUAL-size replacement, which size alone cannot — and
+                # falls back to the tarfile walk (which re-captures).
+                if path_stat(path, fresh=True) != stat_at_capture:
                     del self._bucket_indices[path]
                 else:
                     # epoch >= 2 (or post-resume with a warm index):
@@ -261,6 +275,13 @@ class ShardedTarLoader:
             # consistently by the store, so a truncated UPLOAD is the
             # uploader's bug — each ranged read is still length-checked.
             _check_tar_terminator(path)
+        # freshness token captured BEFORE the walk: if the object is
+        # replaced WHILE we stream it, the index holds old-byte offsets —
+        # pairing it with the post-walk stat would make every later
+        # epoch's staleness compare pass and carve garbage forever;
+        # pairing it with the pre-walk stat makes the next epoch's fresh
+        # stat differ and forces a re-walk
+        stat_at_walk = path_stat(path, fresh=True) if is_bucket else None
         index = []  # (offset_data, size, isfile, basename) per member
         with _open_tar(path) as tar:
             entry = 0
@@ -278,12 +299,15 @@ class ShardedTarLoader:
                     self.skipped += 1
                     continue
                 yield tar.extractfile(member).read(), label, (si, entry)
-        if is_bucket and skip == 0:
-            # cache only a COMPLETE walk (a partial index would silently
-            # shorten the shard); skip>0 walks are resume continuations.
-            # The size rides along for the staleness check above.
-            self._bucket_indices[path] = (index, path_size(path,
-                                                           fresh=True))
+        if is_bucket:
+            # cache any walk that REACHED end-of-archive (this code runs
+            # only when the member loop exhausted the tar): even a skip>0
+            # resume continuation iterated the stream from byte 0 and
+            # recorded every member, so its index is complete too — the
+            # old `skip == 0` gate made a resumed shard pay one extra
+            # full header-parsing walk for nothing. The PRE-walk
+            # (size, token) stat rides along for the staleness check.
+            self._bucket_indices[path] = (index, stat_at_walk)
 
     #: forward gaps below this are read-and-discarded on the carve path;
     #: larger ones reopen the ranged stream at the target offset

@@ -36,6 +36,16 @@ from . import gcs as _gcs  # shared retry/range-stream machinery
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 
 
+def _canon_query(q: dict) -> str:
+    """SigV4 canonical query string: sorted keys, %20-quoted values
+    (urlencode's '+' form would sign a different string than AWS
+    canonicalizes)."""
+    return "&".join(
+        f"{urllib.parse.quote(k, safe='')}="
+        f"{urllib.parse.quote(v, safe='')}"
+        for k, v in sorted(q.items()))
+
+
 def parse_s3_url(url: str) -> Tuple[str, str]:
     """'s3://bucket/some/prefix' -> ('bucket', 'some/prefix')."""
     if not url.startswith("s3://"):
@@ -142,24 +152,29 @@ class S3Client:
     def list_objects(self, bucket: str, prefix: str = ""
                      ) -> List[Tuple[str, int]]:
         """[(key, size), ...] under prefix (ListObjectsV2, paginated)."""
-        out: List[Tuple[str, int]] = []
+        return [(k, s) for k, s, _ in self.list_objects_meta(bucket, prefix)]
+
+    def list_objects_meta(self, bucket: str, prefix: str = ""
+                          ) -> List[Tuple[str, int, Optional[str]]]:
+        """[(key, size, etag), ...] under prefix (ListObjectsV2,
+        paginated). The ETag rides the listing XML AWS already returns —
+        the freshness token for warm member indexes, parallel to the GCS
+        generation."""
+        out: List[Tuple[str, int, Optional[str]]] = []
         token = None
         while True:
             q = {"list-type": "2", "prefix": prefix}
             if token:
                 q["continuation-token"] = token
-            # SigV4 canonical query: %20 for spaces (urlencode's '+' form
-            # would sign a different string than AWS canonicalizes)
-            query = "&".join(
-                f"{urllib.parse.quote(k, safe='')}="
-                f"{urllib.parse.quote(v, safe='')}"
-                for k, v in sorted(q.items()))
-            with self._request(bucket, "", query=query) as r:
+            with self._request(bucket, "", query=_canon_query(q)) as r:
                 root = ET.fromstring(r.read())
             ns = root.tag.partition("}")[0] + "}" if "}" in root.tag else ""
             for c in root.findall(f"{ns}Contents"):
+                et = c.find(f"{ns}ETag")
                 out.append((c.find(f"{ns}Key").text,
-                            int(c.find(f"{ns}Size").text or 0)))
+                            int(c.find(f"{ns}Size").text or 0),
+                            et.text.strip('"') if et is not None
+                            and et.text else None))
             trunc = root.find(f"{ns}IsTruncated")
             if trunc is None or trunc.text != "true":
                 break
@@ -212,6 +227,8 @@ class _S3RangeStream(_gcs.GcsRangeStream):
 
 #: s3:// url -> byte size (filled by listings, like gcs._SIZE_CACHE)
 _SIZE_CACHE: dict = {}
+#: s3:// url -> (size, etag) — the freshness token pair (gcs._STAT_CACHE)
+_STAT_CACHE: dict = {}
 _CLIENTS: dict = {}
 
 
@@ -229,17 +246,33 @@ def s3_list_shards(root: str, prefix: str = "") -> List[str]:
     if base and not base.endswith("/"):
         base += "/"
     out = []
-    for key, size in _shared_client().list_objects(bucket, base):
+    for key, size, etag in _shared_client().list_objects_meta(bucket, base):
         rel = key[len(base):]
         if "/" in rel:
             continue
         if rel.startswith(prefix) and rel.endswith(".tar"):
             url = f"s3://{bucket}/{key}"
             _SIZE_CACHE[url] = size
+            _STAT_CACHE[url] = (size, etag)
             out.append(url)
     if not out:
         raise FileNotFoundError(f"no .tar shards under {root!r} "
                                 f"matching prefix {prefix!r}")
+    return sorted(out)
+
+
+def s3_list_urls(root: str) -> List[str]:
+    """ALL object urls under an s3:// prefix (recursive, sorted; empty
+    list when nothing matches) — the checkpoint store's directory listing."""
+    bucket, base = parse_s3_url(root)
+    if base and not base.endswith("/"):
+        base += "/"
+    out = []
+    for key, size, etag in _shared_client().list_objects_meta(bucket, base):
+        url = f"s3://{bucket}/{key}"
+        _SIZE_CACHE[url] = size
+        _STAT_CACHE[url] = (size, etag)
+        out.append(url)
     return sorted(out)
 
 
@@ -265,12 +298,22 @@ def s3_write(url: str, data: bytes) -> None:
             headers={"Content-Type": "application/octet-stream"}) as r:
         r.read()
     _SIZE_CACHE[url] = len(data)
+    _STAT_CACHE.pop(url, None)
 
 
 def s3_size(url: str, fresh: bool = False) -> int:
-    import urllib.error
     if not fresh and url in _SIZE_CACHE:
         return _SIZE_CACHE[url]
+    return s3_stat(url, fresh=fresh)[0]
+
+
+def s3_stat(url: str, fresh: bool = False) -> Tuple[int, Optional[str]]:
+    """(size, etag) from one `bytes=0-0` ranged GET (the same request the
+    size-only probe made — the ETag header rides along for free). The ETag
+    is the freshness token: an equal-size replacement changes it."""
+    import urllib.error
+    if not fresh and url in _STAT_CACHE:
+        return _STAT_CACHE[url]
     bucket, key = parse_s3_url(url)
     client = _shared_client()
     try:
@@ -279,6 +322,7 @@ def s3_size(url: str, fresh: bool = False) -> int:
             cr = r.headers.get("Content-Range", "")
             size = (int(cr.rpartition("/")[2]) if "/" in cr
                     else int(r.headers.get("Content-Length", 0)))
+            etag = (r.headers.get("ETag") or "").strip('"') or None
     except urllib.error.HTTPError as e:
         # a ZERO-byte object cannot satisfy bytes=0-0: AWS answers 416
         # with the total in Content-Range ("bytes */0")
@@ -286,5 +330,106 @@ def s3_size(url: str, fresh: bool = False) -> int:
             raise
         cr = e.headers.get("Content-Range", "")
         size = int(cr.rpartition("/")[2]) if "/" in cr else 0
+        etag = (e.headers.get("ETag") or "").strip('"') or None
     _SIZE_CACHE[url] = size
-    return size
+    _STAT_CACHE[url] = (size, etag)
+    return size, etag
+
+
+def s3_delete(url: str, missing_ok: bool = True) -> None:
+    """Signed DELETE; 404 is success when `missing_ok`."""
+    import urllib.error
+    bucket, key = parse_s3_url(url)
+    try:
+        with _shared_client()._request(bucket, key, method="DELETE") as r:
+            r.read()
+    except urllib.error.HTTPError as e:
+        if not (missing_ok and e.code == 404):
+            raise
+    _SIZE_CACHE.pop(url, None)
+    _STAT_CACHE.pop(url, None)
+
+
+#: multipart part size — AWS requires >= 5 MiB per non-final part; 8 MiB
+#: matches the GCS chunk for comparable retry re-send cost
+S3_UPLOAD_PART = 8 << 20
+S3_UPLOAD_PARALLEL = 4
+
+
+def s3_write_large(url: str, data, *,
+                   parallel: Optional[int] = None,
+                   part_bytes: Optional[int] = None) -> None:
+    """Bulk upload of bytes-like `data` (bytes, or a memoryview that is
+    only copied one part at a time) via S3 multipart: initiate ->
+    parallel signed UploadPart
+    PUTs -> CompleteMultipartUpload. The object appears atomically at
+    complete time — a writer killed mid-upload leaves only an invisible
+    multipart session (aborted on failure when we still can), never a torn
+    object. Payloads of one part or parallel=1 fall back to the plain
+    signed PUT (itself atomic)."""
+    if parallel is None:
+        parallel = S3_UPLOAD_PARALLEL
+    if part_bytes is None:
+        part_bytes = S3_UPLOAD_PART  # read at call time: patchable
+    if parallel <= 1 or len(data) <= part_bytes:
+        s3_write(url, bytes(data) if isinstance(data, memoryview)
+                 else data)
+        return
+    from concurrent.futures import ThreadPoolExecutor
+    bucket, key = parse_s3_url(url)
+    client = _shared_client()
+    with client._request(bucket, key, query="uploads=",
+                         method="POST") as r:
+        root = ET.fromstring(r.read())
+    ns = root.tag.partition("}")[0] + "}" if "}" in root.tag else ""
+    uid_el = root.find(f"{ns}UploadId")
+    if uid_el is None or not uid_el.text:
+        raise IOError(f"s3: CreateMultipartUpload for {url} returned no "
+                      f"UploadId")
+    uid = uid_el.text
+
+    bounds = [(i, min(i + part_bytes, len(data)))
+              for i in range(0, len(data), part_bytes)]
+
+    def put_part(n_ab):
+        n, (a, b) = n_ab
+        q = _canon_query({"partNumber": str(n), "uploadId": uid})
+        # bytes() per part: `data` may be a zero-copy memoryview; urllib
+        # needs real bytes, so copy one bounded part at a time
+        with client._request(bucket, key, query=q, method="PUT",
+                             data=bytes(data[a:b])) as r:
+            r.read()
+            return n, (r.headers.get("ETag") or "").strip('"')
+
+    try:
+        with ThreadPoolExecutor(min(parallel, len(bounds)),
+                                thread_name_prefix="s3-part") as ex:
+            etags = sorted(ex.map(put_part, enumerate(bounds, start=1)))
+        body = ("<CompleteMultipartUpload>" + "".join(
+            f"<Part><PartNumber>{n}</PartNumber>"
+            f"<ETag>\"{e}\"</ETag></Part>" for n, e in etags)
+            + "</CompleteMultipartUpload>").encode()
+        with client._request(bucket, key,
+                             query=_canon_query({"uploadId": uid}),
+                             method="POST", data=body) as r:
+            resp = r.read()
+        # AWS can answer CompleteMultipartUpload with HTTP 200 whose BODY
+        # is an <Error> document (e.g. InternalError) — a 200 status does
+        # not mean the object materialized. Committing meta.json on top
+        # of a failed complete would break the commit-marker invariant.
+        root2 = ET.fromstring(resp) if resp.strip() else None
+        if root2 is None or root2.tag.endswith("Error"):
+            raise IOError(
+                f"s3: CompleteMultipartUpload for {url} failed in-body: "
+                f"{resp[:200]!r}")
+    except BaseException:
+        try:  # abort so the store reclaims the parts
+            with client._request(bucket, key,
+                                 query=_canon_query({"uploadId": uid}),
+                                 method="DELETE") as r:
+                r.read()
+        except Exception:
+            pass
+        raise
+    _SIZE_CACHE[url] = len(data)
+    _STAT_CACHE.pop(url, None)

@@ -220,11 +220,15 @@ class ParallelTrainer:
           zero                    fresh zeros
 
         A/B'd (r5, `scripts/elastic_momentum_ab.py`, ELASTIC_AB_r05.json:
-        3 seeds x {8->4, 8->2} x 8 post-resume rounds): norm_rescale beat
-        averaging on final-loss in all 6 cells and on worst-case deviation
-        (8->4 max 9.9% vs 10.5%; 8->2 30.8% vs 31.2%); zero-reset was
-        uniformly WORST (8->4 max 31%, 8->2 38% — restarting momentum
-        costs more than averaging's blur). Measured band for the default:
+        3 seeds x {8->4, 8->2} x 8 post-resume rounds, TINY_MLP scale):
+        norm_rescale edged out averaging in all 6 cells, but the margins
+        are sub-point (8->4 max 9.9% vs 10.5%; 8->2 30.8% vs 31.2%) and
+        the evidence is small-model-only — treat the two as roughly
+        equivalent until the A/B is rerun at CaffeNet shapes
+        (scripts/parity_caffenet.py infra exists; ADVICE r5 #5).
+        Zero-reset was uniformly WORST (8->4 max 31%, 8->2 38% —
+        restarting momentum costs more than averaging's blur), which is
+        the one solid conclusion. Measured band for the default:
         <=10% loss inflation at 8->4, <=31% at 8->2, asserted at 15%/40%
         by tests/test_apps.py::test_elastic_resume_momentum_trajectory_band.
         A same-topology pass bypasses the policy entirely: every worker's

@@ -12,6 +12,25 @@ Format: a directory with
                    "extra": {...}}
 Atomic via write-to-temp + rename. `step-N` naming with retention.
 
+The "directory" may be a LOCAL path or a BUCKET URI (`gs://` / `s3://`):
+every public function here accepts both, so pod checkpoints go straight to
+the object store over the same native HTTP clients the data plane streams
+from (no FUSE mount, no SDK — `data/gcs.py` / `data/s3.py`). The bucket
+layout mirrors the local one (`<root>/step-N/{state.npz,meta.json}`);
+`state.npz` is pushed through the parallel chunked writers (GCS resumable
+sessions + compose, S3 multipart) so a killed writer never leaves a
+partial object, and `meta.json` is written LAST as the commit marker —
+the same not-a-checkpoint-until-meta-parses rule the local store already
+enforces makes an interrupted bucket save invisible to readers. Reads go
+through the ranged-GET streams with reconnect-resume.
+
+`AsyncCheckpointWriter` is stage 2 of the train loop's two-stage save:
+stage 1 (blocking, short) fetches device state to host buffers; stage 2
+(this writer's single background thread) serializes, digests, and
+persists. At most one snapshot is in flight — submitting the next save
+waits for the previous write (backpressure lands on the SAVE cadence, not
+on every round) and re-raises its failure loudly.
+
 Integrity (the health supervisor's substrate): `save` records a SHA-256
 digest of every array's bytes in meta.json; `verify` recomputes them, and
 `restore_flat` (auto-latest) falls back to the newest checkpoint that
@@ -30,11 +49,15 @@ unhealthy training window carry `extra["anomalous"] = True`;
 from __future__ import annotations
 
 import hashlib
+import io
 import json
 import os
 import shutil
 import tempfile
+import urllib.error
 import warnings
+from concurrent.futures import ThreadPoolExecutor
+from types import SimpleNamespace
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -85,6 +108,74 @@ def _digest(arr: np.ndarray) -> str:
     return hashlib.sha256(arr.tobytes()).hexdigest()
 
 
+# -- store plumbing: local directories vs gs://|s3:// bucket prefixes -------
+
+def is_bucket_path(path: str) -> bool:
+    return isinstance(path, str) and path.startswith(("gs://", "s3://"))
+
+
+def _bucket_ops(path: str) -> SimpleNamespace:
+    """The scheme-matched object operations (read / ranged stream / small
+    atomic write / chunked-parallel large write / delete / list)."""
+    if path.startswith("gs://"):
+        from ..data import gcs as m
+        return SimpleNamespace(
+            read=m.gs_read, open_stream=m.gs_open_stream,
+            write=m.gs_write, write_large=m.gs_write_large,
+            delete=m.gs_delete, list_urls=m.gs_list_urls)
+    from ..data import s3 as m
+    return SimpleNamespace(
+        read=m.s3_read, open_stream=m.s3_open_stream,
+        write=m.s3_write, write_large=m.s3_write_large,
+        delete=m.s3_delete, list_urls=m.s3_list_urls)
+
+
+def _join(directory: str, *names: str) -> str:
+    if is_bucket_path(directory):
+        return "/".join((directory.rstrip("/"),) + names)
+    return os.path.join(directory, *names)
+
+
+def _bucket_step_files(directory: str) -> Dict[int, set]:
+    """{step: {relative file names under step-N/}} from ONE bucket listing
+    (steps, stale-orphan sweep, and retention all key off this)."""
+    base = directory.rstrip("/")
+    out: Dict[int, set] = {}
+    for url in _bucket_ops(directory).list_urls(base):
+        rel = url[len(base) + 1:]
+        head, _, rest = rel.partition("/")
+        if head.startswith("step-") and head[5:].isdigit():
+            out.setdefault(int(head[5:]), set()).add(rest)
+    return out
+
+
+def _delete_step(directory: str, step: int) -> None:
+    """Remove checkpoint `step-N`. Bucket: meta.json FIRST, so a reader
+    racing the delete sees not-a-checkpoint rather than a torn one."""
+    if not is_bucket_path(directory):
+        shutil.rmtree(_join(directory, f"step-{step}"),
+                      ignore_errors=True)
+        return
+    ops = _bucket_ops(directory)
+    prefix = _join(directory, f"step-{step}")
+    try:
+        ops.delete(f"{prefix}/meta.json")
+    except Exception as e:
+        # could not decommit: leave the step WHOLE (a commit marker over
+        # half-deleted state would read as corrupt); retention is
+        # best-effort and the next retain re-sweeps — parity with the
+        # local twin's rmtree(ignore_errors=True)
+        warnings.warn(f"checkpoint retention: could not delete "
+                      f"{prefix}/meta.json ({e}) — step left in place",
+                      RuntimeWarning)
+        return
+    for url in ops.list_urls(prefix):
+        try:
+            ops.delete(url)
+        except Exception:
+            pass  # retention is best-effort; the next retain re-sweeps
+
+
 def _sweep_stale_tmp(directory: str) -> None:
     """Remove `.tmp-*` work directories left behind by a previous process
     killed mid-save (e.g. the chaos test's SIGKILL between mkdtemp and
@@ -100,28 +191,40 @@ def _sweep_stale_tmp(directory: str) -> None:
             shutil.rmtree(os.path.join(directory, d), ignore_errors=True)
 
 
-def save(directory: str, tree: Any, *, step: int,
-         extra: Optional[Dict[str, Any]] = None) -> str:
-    """Atomically write checkpoint `step-N` under directory; returns path.
-    Records per-array SHA-256 digests in meta.json (see module docstring)
-    and sweeps stale `.tmp-*` directories from crashed earlier saves."""
-    os.makedirs(directory, exist_ok=True)
-    _sweep_stale_tmp(directory)
+def _prepare_save(tree: Any, step: int, extra: Optional[Dict[str, Any]]
+                  ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """(flat-uint-viewed arrays, meta dict) — the byte-identical payload
+    both store kinds write (digests over the same C-order bytes)."""
     flat = _flatten(tree)
     ext_dtypes = {}
     for key, arr in flat.items():
         if _is_extension_dtype(arr.dtype):
             ext_dtypes[key] = arr.dtype.name
             flat[key] = arr.view(_UINT_OF_SIZE[arr.dtype.itemsize])
+    meta = {"step": int(step), "keys": sorted(flat.keys()),
+            "digests": {k: _digest(a) for k, a in flat.items()}}
+    if ext_dtypes:
+        meta["ext_dtypes"] = ext_dtypes
+    if extra:
+        meta["extra"] = extra
+    return flat, meta
+
+
+def save(directory: str, tree: Any, *, step: int,
+         extra: Optional[Dict[str, Any]] = None) -> str:
+    """Atomically write checkpoint `step-N` under directory (a local path
+    or a gs://|s3:// prefix); returns its path. Records per-array SHA-256
+    digests in meta.json (see module docstring) and sweeps leftovers of
+    crashed earlier saves (`.tmp-*` work dirs locally; committed-but-
+    orphaned objects in a bucket)."""
+    if is_bucket_path(directory):
+        return _save_bucket(directory, tree, step=step, extra=extra)
+    os.makedirs(directory, exist_ok=True)
+    _sweep_stale_tmp(directory)
+    flat, meta = _prepare_save(tree, step, extra)
     tmp = tempfile.mkdtemp(dir=directory, prefix=".tmp-")
     try:
         np.savez(os.path.join(tmp, "state.npz"), **flat)
-        meta = {"step": int(step), "keys": sorted(flat.keys()),
-                "digests": {k: _digest(a) for k, a in flat.items()}}
-        if ext_dtypes:
-            meta["ext_dtypes"] = ext_dtypes
-        if extra:
-            meta["extra"] = extra
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(meta, f)
         final = os.path.join(directory, f"step-{int(step)}")
@@ -134,8 +237,48 @@ def save(directory: str, tree: Any, *, step: int,
     return final
 
 
+def _save_bucket(directory: str, tree: Any, *, step: int,
+                 extra: Optional[Dict[str, Any]] = None) -> str:
+    """Bucket save with upload-then-finalize atomicity: state.npz goes up
+    through the parallel chunked writer (never visible partially — GCS
+    resumable/compose and S3 multipart both materialize the object only at
+    finalize), then meta.json lands LAST as the commit marker. A writer
+    killed anywhere in between leaves a step directory without a readable
+    meta.json, which every reader already treats as not-a-checkpoint.
+    Overwriting an existing step decommits it first (meta.json deleted) so
+    a crash mid-overwrite can't pair old meta with new state."""
+    ops = _bucket_ops(directory)
+    final = _join(directory, f"step-{int(step)}")
+    # sweep orphans of crashed earlier saves: any step with state but no
+    # meta never committed, and stray .part- components never composed.
+    # Best-effort — a transient delete failure must not abort a save
+    # whose own uploads would succeed; the next save re-sweeps
+    for s, files in _bucket_step_files(directory).items():
+        for f in files:
+            if ".part-" in f or ("meta.json" not in files):
+                try:
+                    ops.delete(_join(directory, f"step-{s}", f))
+                except Exception as e:
+                    warnings.warn(f"checkpoint orphan sweep: could not "
+                                  f"delete step-{s}/{f}: {e}",
+                                  RuntimeWarning)
+    flat, meta = _prepare_save(tree, step, extra)
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    # decommit stays UNGUARDED: proceeding past a failed meta delete
+    # could pair the OLD commit marker with half-new state after a crash
+    ops.delete(f"{final}/meta.json")  # decommit before overwrite
+    # getbuffer(): zero-copy view — getvalue() would duplicate the whole
+    # serialized archive next to the flat arrays on the writer thread
+    ops.write_large(f"{final}/state.npz", buf.getbuffer())
+    ops.write(f"{final}/meta.json", json.dumps(meta).encode())
+    return final
+
+
 def _list_steps(directory: str) -> List[int]:
     """All step numbers present as directories (no validity check)."""
+    if is_bucket_path(directory):
+        return sorted(_bucket_step_files(directory))
     if not os.path.isdir(directory):
         return []
     return sorted(int(d.split("-", 1)[1]) for d in os.listdir(directory)
@@ -144,7 +287,25 @@ def _list_steps(directory: str) -> List[int]:
 
 def _load_meta(path: str) -> Optional[Dict[str, Any]]:
     """meta.json as a dict, or None when missing/unparseable (a torn copy
-    on a network FS) — the caller treats that as not-a-checkpoint."""
+    on a network FS, or an uncommitted bucket save killed before its
+    meta.json landed) — the caller treats that as not-a-checkpoint.
+
+    On a bucket, only a definitive 404 means ABSENT; a network outage
+    (ConnectionError after the retry budget) or an auth/5xx failure
+    PROPAGATES — a transient store outage must not be misread as "no
+    checkpoints exist", which would make a health rollback hard-fail or
+    a resume silently pick an older step."""
+    if is_bucket_path(path):
+        try:
+            raw = _bucket_ops(path).read(f"{path}/meta.json")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None  # no commit marker: not-a-checkpoint
+            raise
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None  # unparseable marker: not-a-checkpoint
     try:
         with open(os.path.join(path, "meta.json")) as f:
             return json.load(f)
@@ -158,7 +319,7 @@ def latest_step(directory: str) -> Optional[int]:
     FS) is skipped with a warning instead of raising an opaque
     JSONDecodeError/FileNotFoundError later."""
     for s in reversed(_list_steps(directory)):
-        path = os.path.join(directory, f"step-{s}")
+        path = _join(directory, f"step-{s}")
         if _load_meta(path) is not None:
             return s
         warnings.warn(f"checkpoint {path}: meta.json missing/unreadable — "
@@ -204,8 +365,38 @@ def _load_checkpoint(path: str) -> Tuple[Dict[str, np.ndarray], int,
     if meta is None:
         raise CheckpointCorruptError(f"{path}: meta.json missing/unreadable")
     try:
-        with np.load(os.path.join(path, "state.npz")) as z:
+        if is_bucket_path(path):
+            # one ranged-GET stream with reconnect-resume (the data
+            # plane's transport): a dropped connection mid-multi-GB read
+            # resumes at the break instead of failing the restore.
+            # copyfileobj into ONE buffer — BytesIO(stream.read()) would
+            # transiently hold TWO full copies of a multi-GB state
+            stream = _bucket_ops(path).open_stream(f"{path}/state.npz")
+            try:
+                src = io.BytesIO()
+                shutil.copyfileobj(stream, src, 1 << 20)
+                src.seek(0)
+            finally:
+                stream.close()
+        else:
+            src = os.path.join(path, "state.npz")
+        with np.load(src) as z:
             flat = {k: z[k] for k in z.files}
+    except ConnectionError:
+        # a bucket outage outlasting the retry budget is NOT corruption:
+        # propagating keeps the fallback scan from silently restoring an
+        # older step during a transient store failure
+        raise
+    except urllib.error.HTTPError as e:
+        # meta committed but state unreadable: only a definitive 404
+        # (upload never finalized / object deleted) is corruption — an
+        # auth failure (401/403 expired token) or a 5xx that outlasted
+        # the retries is store trouble and must stay loud, mirroring
+        # _load_meta's non-404 rule
+        if e.code == 404:
+            raise CheckpointCorruptError(
+                f"{path}: state.npz missing: {e}") from e
+        raise
     except Exception as e:
         raise CheckpointCorruptError(f"{path}: state.npz unreadable: {e}"
                                      ) from e
@@ -241,13 +432,13 @@ def restore_flat(directory: str, step: Optional[int] = None
     with a warning (a kill -9 mid-rename, a byte flipped at rest — resume
     proceeds from the previous step instead of dying)."""
     if step is not None:
-        return _load_checkpoint(os.path.join(directory, f"step-{int(step)}"))
+        return _load_checkpoint(_join(directory, f"step-{int(step)}"))
     steps = _list_steps(directory)
     if not steps:
         raise FileNotFoundError(f"no checkpoints under {directory!r}")
     last_err: Optional[Exception] = None
     for s in reversed(steps):
-        path = os.path.join(directory, f"step-{s}")
+        path = _join(directory, f"step-{s}")
         try:
             return _load_checkpoint(path)
         except CheckpointCorruptError as e:
@@ -262,10 +453,20 @@ def restore_flat(directory: str, step: Optional[int] = None
 def verify(path: str) -> bool:
     """True when the checkpoint directory `path` is complete and its
     recorded digests match the stored bytes (vacuously true for
-    pre-digest-format checkpoints that load cleanly)."""
+    pre-digest-format checkpoints that load cleanly). Store trouble
+    PROPAGATES rather than reading as False — a bucket outage
+    (ConnectionError after the retry budget) or an auth/5xx HTTPError
+    (anything but a definitive 404, which _load_checkpoint already maps
+    to corruption) — otherwise retain()'s protect scan would misread a
+    transient store failure as "nothing verifies" and could delete the
+    only restorable checkpoint."""
     try:
         _load_checkpoint(path)
         return True
+    except ConnectionError:
+        raise
+    except urllib.error.HTTPError:
+        raise
     except Exception:
         return False
 
@@ -289,7 +490,7 @@ def restore_newest_verified(directory: str, skip_anomalous: bool = False
     of verify-then-restore doing it twice. Returns (flat, step, extra) or
     None."""
     for s in reversed(_list_steps(directory)):
-        path = os.path.join(directory, f"step-{s}")
+        path = _join(directory, f"step-{s}")
         meta = _load_meta(path)
         if meta is None:
             continue
@@ -308,8 +509,10 @@ def retain(directory: str, keep: int = 3) -> None:
     newer checkpoints are corrupt, or a long unhealthy window has tagged
     every recent save `anomalous`, retention must not destroy the only
     state a resume/rollback can still use. (The protection re-verifies
-    from disk — one extra read+hash of the newest snapshot per save; the
-    integrity guarantee is worth more than the checkpoint-phase I/O.)"""
+    from the store — one extra read+hash of the newest snapshot per save;
+    on a bucket that read is a full ranged-GET of state.npz, which is one
+    more reason the train loop runs retention on the stage-2 BACKGROUND
+    thread. The integrity guarantee is worth the checkpoint-phase I/O.)"""
     steps = _list_steps(directory)
     if not steps:
         return
@@ -320,7 +523,7 @@ def retain(directory: str, keep: int = 3) -> None:
     # verified NON-anomalous one (the rollback selector's candidate)
     newest_verified = None
     for s in reversed(steps):
-        path = os.path.join(directory, f"step-{s}")
+        path = _join(directory, f"step-{s}")
         meta = _load_meta(path)
         if meta is None:
             continue
@@ -336,5 +539,48 @@ def retain(directory: str, keep: int = 3) -> None:
                 break
     for s in steps:
         if s not in protect:
-            shutil.rmtree(os.path.join(directory, f"step-{s}"),
-                          ignore_errors=True)
+            _delete_step(directory, s)
+
+
+class AsyncCheckpointWriter:
+    """Stage-2 writer of the two-stage async checkpoint pipeline: ONE
+    background thread runs the serialize + digest + persist closure while
+    the round loop keeps training. At most one snapshot is ever in flight:
+    `submit` first waits out the previous write (backpressure lands on the
+    next SAVE, not on every round) and re-raises its failure — a dead
+    checkpoint store must be loud, not silently skipped. `wait` is the
+    barrier the rollback path and the loop exit take before READING the
+    store (the in-flight write may be the newest verified checkpoint, and
+    reading mid-write would race the commit marker)."""
+
+    def __init__(self):
+        self._ex = ThreadPoolExecutor(1, thread_name_prefix="ckpt-write")
+        self._pending = None
+
+    @property
+    def in_flight(self) -> bool:
+        return self._pending is not None and not self._pending.done()
+
+    def submit(self, fn, *args, **kwargs) -> None:
+        """Queue one write; blocks until the PREVIOUS one finished (and
+        re-raises its exception, if any)."""
+        self.wait()
+        self._pending = self._ex.submit(fn, *args, **kwargs)
+
+    def wait(self) -> None:
+        """Block until the in-flight write (if any) completes; re-raise
+        its failure."""
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            pending.result()
+
+    def close(self, wait: bool = True) -> None:
+        """Drain (re-raising a failed write when `wait`) and stop the
+        thread. With wait=False a queued-but-unstarted write is cancelled;
+        a RUNNING write always completes (never tear a half-written
+        snapshot on purpose)."""
+        try:
+            if wait:
+                self.wait()
+        finally:
+            self._ex.shutdown(wait=wait, cancel_futures=not wait)

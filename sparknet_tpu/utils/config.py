@@ -45,9 +45,16 @@ class RunConfig:
     eval_batch: int = 1000
     # precision
     precision: str = "float32"          # or "bfloat16"
-    # checkpoint
+    # checkpoint. checkpoint_dir accepts a local path OR a gs://|s3://
+    # prefix (native bucket checkpoints — no FUSE mount; utils/checkpoint
+    # uploads through the data plane's HTTP clients). checkpoint_async
+    # moves serialize+digest+persist to a background writer thread: the
+    # round loop blocks only for the device->host state fetch, with at
+    # most one snapshot in flight (the next save waits out the previous
+    # write). False restores the fully synchronous save.
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 25          # rounds
+    checkpoint_async: bool = True
     resume: bool = True
     # training health supervisor: anomaly classification (spike/nonfinite),
     # skip / rollback-to-verified-checkpoint / LR-backoff recovery, and the

@@ -1,34 +1,69 @@
-"""Local fake object-store servers shared by the gs:// tests, the chaos
-test's bucket variant, and `bench.py --e2e --store gs` (the bucket-path
-ingest measurement). Moved out of test_gcs.py in r5 so non-pytest callers
-(bench, chaos subprocesses) can serve a bucket without importing a test
-module's fixtures.
+"""Local fake object-store servers shared by the gs://|s3:// tests, the
+chaos tests' bucket variants, `bench.py --e2e --store gs` and
+`bench.py --checkpoint-stall` (the bucket checkpoint measurements). Moved
+out of test_gcs.py in r5 so non-pytest callers (bench, chaos subprocesses)
+can serve a bucket without importing a test module's fixtures.
+
+Handler STATE IS PER SERVER (r6, ADVICE r5 #2): `make_gcs_handler()` /
+`make_s3_handler()` mint a fresh subclass holding its own `objects` /
+`fail_once` / `range_log` / session dicts, so two fake servers coexist in
+one process and `stop_serving` can drop a served corpus from RSS. The
+module-level `FakeGcsHandler` base keeps its (empty) class attrs so legacy
+imports still resolve; servers returned by the helpers expose the live
+class as `srv.handler`.
+
+The GCS fake speaks the write-side subset the checkpoint store needs:
+simple media upload, RESUMABLE upload sessions (initiate -> chunk PUTs
+with Content-Range -> 308/200, object visible only on finalize), compose,
+object DELETE, and per-object `generation` metadata (bumped on every
+write — the member-index freshness token). The S3 fake verifies AWS
+SigV4 on every request and additionally speaks multipart upload
+(initiate/part/complete/abort), ETag metadata, and DELETE.
 """
 from __future__ import annotations
 
+import contextlib
+import hashlib
+import hmac
 import http.server
 import json
 import os
 import threading
+import time
 import urllib.parse
+
+#: range_log entries are capped so a long in-process soak (which measures
+#: its OWN RSS) doesn't accumulate instrumentation forever; tests clear
+#: the log before asserting and never approach the cap
+RANGE_LOG_CAP = 10_000
 
 
 class FakeGcsHandler(http.server.BaseHTTPRequestHandler):
-    """JSON-API subset: paginated listing, alt=media with Range, ?fields=size.
-    Knobs (class attrs set by the caller):
+    """JSON-API subset: paginated listing, alt=media with Range,
+    ?fields= metadata, media + resumable uploads, compose, delete.
+    Knobs (class attrs on the per-server subclass):
       fail_once    — object names whose next media GET truncates mid-body
                      (Content-Length lies), exercising reconnect-resume
       ignore_range — serve 200-from-zero despite a Range header (a broken
                      middlebox); the client must fail loudly, not corrupt
+      upload_delay_s — sleep per resumable-chunk PUT (widens the
+                     mid-upload window the kill -9 chaos test aims at)
     """
     objects = {}
+    generations = {}
+    sessions = {}       # resumable sid -> {name, data, total}
     fail_once = set()
     ignore_range = False
     page_size = 2
     range_log = []
+    upload_delay_s = 0.0
 
     def log_message(self, *a):
         pass
+
+    def _bump(self, name):
+        cls = type(self)
+        cls.generations[name] = cls.generations.get(name, 0) + 1
 
     def do_GET(self):
         parsed = urllib.parse.urlparse(self.path)
@@ -44,7 +79,8 @@ class FakeGcsHandler(http.server.BaseHTTPRequestHandler):
             names = sorted(n for n in self.objects if n.startswith(prefix))
             start = int(qs.get("pageToken", ["0"])[0])
             page = names[start:start + self.page_size]
-            d = {"items": [{"name": n, "size": str(len(self.objects[n]))}
+            d = {"items": [{"name": n, "size": str(len(self.objects[n])),
+                            "generation": str(self.generations.get(n, 1))}
                            for n in page]}
             if start + self.page_size < len(names):
                 d["nextPageToken"] = str(start + self.page_size)
@@ -76,34 +112,469 @@ class FakeGcsHandler(http.server.BaseHTTPRequestHandler):
                 return
             self.wfile.write(body)
             return
-        self._json({"size": str(len(data))})  # metadata
+        self._json({"size": str(len(data)),  # metadata
+                    "generation": str(self.generations.get(name, 1))})
 
-    def _json(self, d):
+    def _json(self, d, code=200, extra_headers=()):
         body = json.dumps(d).encode()
-        self.send_response(200)
+        self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        for k, v in extra_headers:
+            self.send_header(k, v)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
-    def do_POST(self):  # simple media upload
+    def do_POST(self):
         parsed = urllib.parse.urlparse(self.path)
         qs = urllib.parse.parse_qs(parsed.query)
         parts = parsed.path.split("/")
-        # /upload/storage/v1/b/<bucket>/o?uploadType=media&name=...
-        if len(parts) < 7 or parts[1] != "upload" or \
-                qs.get("uploadType") != ["media"] or "name" not in qs:
+        # compose: /storage/v1/b/<bucket>/o/<name>/compose
+        if len(parts) == 8 and parts[1:4] == ["storage", "v1", "b"] and \
+                parts[5] == "o" and parts[7] == "compose":
+            name = urllib.parse.unquote(parts[6])
+            body = self.rfile.read(int(self.headers.get("Content-Length",
+                                                        0)))
+            srcs = [s["name"] for s in
+                    json.loads(body).get("sourceObjects", [])]
+            if any(s not in self.objects for s in srcs):
+                self.send_error(404, "compose source missing")
+                return
+            type(self).objects[name] = b"".join(self.objects[s]
+                                                for s in srcs)
+            self._bump(name)
+            self._json({"name": name,
+                        "size": str(len(self.objects[name]))})
+            return
+        # uploads: /upload/storage/v1/b/<bucket>/o
+        if len(parts) < 7 or parts[1] != "upload":
             self.send_error(400)
             return
+        if qs.get("uploadType") == ["media"] and "name" in qs:
+            body = self.rfile.read(int(self.headers.get("Content-Length",
+                                                        0)))
+            name = qs["name"][0]
+            type(self).objects[name] = body
+            self._bump(name)
+            self._json({"name": name, "size": str(len(body))})
+            return
+        if qs.get("uploadType") == ["resumable"] and "name" in qs:
+            sid = os.urandom(8).hex()
+            total = self.headers.get("x-upload-content-length")
+            type(self).sessions[sid] = {
+                "name": qs["name"][0], "data": bytearray(),
+                "total": int(total) if total is not None else None}
+            host = self.headers.get("Host", "127.0.0.1")
+            self._json({}, extra_headers=(
+                ("Location", f"http://{host}/upload/session/{sid}"),))
+            return
+        self.send_error(400)
+
+    def do_PUT(self):
+        # resumable chunk: /upload/session/<sid>
+        parts = urllib.parse.urlparse(self.path).path.split("/")
+        if len(parts) != 4 or parts[1:3] != ["upload", "session"]:
+            self.send_error(404)
+            return
+        sess = self.sessions.get(parts[3])
+        if sess is None:
+            self.send_error(404, "no such upload session")
+            return
         body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
-        self.objects[qs["name"][0]] = body
-        self._json({"name": qs["name"][0], "size": str(len(body))})
+        if self.upload_delay_s:
+            time.sleep(self.upload_delay_s)
+        cr = self.headers.get("Content-Range", "")
+        # "bytes a-b/total" or "bytes */total" (zero-byte finalize)
+        rng, _, total_s = cr.partition("bytes ")[2].partition("/")
+        total = int(total_s)
+        if rng != "*":
+            start = int(rng.split("-")[0])
+            sess["data"][start:start + len(body)] = body
+        if len(sess["data"]) >= total:
+            name = sess["name"]
+            type(self).objects[name] = bytes(sess["data"])
+            self._bump(name)
+            del type(self).sessions[parts[3]]
+            self._json({"name": name, "size": str(total)})
+            return
+        self.send_response(308)
+        self.send_header("Range", f"bytes=0-{len(sess['data']) - 1}")
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_DELETE(self):
+        parts = urllib.parse.urlparse(self.path).path.split("/")
+        if len(parts) != 7 or parts[1:4] != ["storage", "v1", "b"] or \
+                parts[5] != "o":
+            self.send_error(404)
+            return
+        name = urllib.parse.unquote(parts[6])
+        if name not in self.objects:
+            self.send_error(404)
+            return
+        del type(self).objects[name]
+        self.send_response(204)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
 
 
-#: range_log entries are capped so a long in-process soak (which measures
-#: its OWN RSS) doesn't accumulate instrumentation forever; tests clear
-#: the log before asserting and never approach the cap
-RANGE_LOG_CAP = 10_000
+def make_gcs_handler():
+    """A fresh FakeGcsHandler subclass with its OWN state dicts — one per
+    server, so servers coexist and shutdown releases the corpus."""
+    return type("FakeGcsHandlerInstance", (FakeGcsHandler,), dict(
+        objects={}, generations={}, sessions={}, fail_once=set(),
+        ignore_range=False, range_log=[], upload_delay_s=0.0))
+
+
+# -- fake S3 (SigV4-verifying; moved from test_s3.py so bench/chaos can
+#    serve s3:// buckets outside pytest) ------------------------------------
+
+def expected_sigv4(method, path, query, headers_lower, signed, region,
+                   secret, payload_hash=None):
+    """Server-side SigV4 recomputation (mirrors the spec, written against
+    the AWS docs independently of the client). `headers_lower` is the
+    received header map lowercased; `signed` the SignedHeaders list."""
+    amz_date = headers_lower["x-amz-date"]
+    datestamp = amz_date[:8]
+    canon_headers = "".join(
+        f"{k}:{headers_lower[k].strip()}\n" for k in signed.split(";"))
+    canonical = "\n".join([
+        method, urllib.parse.quote(path, safe="/-_.~"), query,
+        canon_headers, signed,
+        payload_hash or hashlib.sha256(b"").hexdigest()])
+    scope = f"{datestamp}/{region}/s3/aws4_request"
+    sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                     hashlib.sha256(canonical.encode()).hexdigest()])
+
+    def h(key, msg):
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+    key = h(h(h(h(("AWS4" + secret).encode(), datestamp),
+              region), "s3"), "aws4_request")
+    return hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+
+
+class FakeS3Handler(http.server.BaseHTTPRequestHandler):
+    """Path-style S3 subset: ListObjectsV2, ranged GET, signed PUT,
+    multipart upload (initiate/part/complete/abort), DELETE. Verifies the
+    AWS Signature Version 4 on every request (recomputing it server-side
+    from the shared secret) unless `verify_auth` is off."""
+    objects = {}       # "bucket/key" -> bytes
+    uploads = {}       # uploadId -> {"key": "bucket/key", "parts": {n: b}}
+    fail_once = set()
+    region = "us-east-1"
+    secret = "testsecret"
+    verify_auth = True
+    page_size = 2
+
+    def log_message(self, *a):
+        pass
+
+    def _check_sig(self, path, query, method="GET", payload_hash=None):
+        if not self.verify_auth:
+            return True
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith("AWS4-HMAC-SHA256"):
+            self.send_error(403, "missing SigV4")
+            return False
+        hdrs = {k.lower(): v for k, v in self.headers.items()}
+        signed = auth.split("SignedHeaders=")[1].split(",")[0].strip()
+        want = auth.split("Signature=")[1].strip()
+        got = expected_sigv4(method, path, query, hdrs, signed,
+                             self.region, self.secret, payload_hash)
+        if want != got:
+            self.send_error(403, "bad signature")
+            return False
+        return True
+
+    def _bucket_key(self, path):
+        parts = path.lstrip("/").split("/", 1)
+        return (parts[0], parts[1]) if len(parts) == 2 else (parts[0], "")
+
+    def _etag(self, data):
+        return hashlib.md5(data).hexdigest()
+
+    def do_PUT(self):
+        parsed = urllib.parse.urlparse(self.path)
+        qs = urllib.parse.parse_qs(parsed.query)
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        # the signed payload hash must MATCH the body (tamper detection)
+        claimed = self.headers.get("x-amz-content-sha256", "")
+        if self.verify_auth and \
+                claimed != hashlib.sha256(body).hexdigest():
+            self.send_error(400, "payload hash mismatch")
+            return
+        if not self._check_sig(parsed.path, parsed.query, method="PUT",
+                               payload_hash=claimed or None):
+            return
+        bucket, key = self._bucket_key(parsed.path)
+        if not key:
+            self.send_error(400)
+            return
+        if "partNumber" in qs and "uploadId" in qs:  # UploadPart
+            up = self.uploads.get(qs["uploadId"][0])
+            if up is None or up["key"] != f"{bucket}/{key}":
+                self.send_error(404, "no such upload")
+                return
+            up["parts"][int(qs["partNumber"][0])] = body
+        else:
+            type(self).objects[f"{bucket}/{key}"] = body
+        self.send_response(200)
+        self.send_header("ETag", f'"{self._etag(body)}"')
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_POST(self):
+        parsed = urllib.parse.urlparse(self.path)
+        # keep_blank_values: "?uploads=" (CreateMultipartUpload) must
+        # survive parsing
+        qs = urllib.parse.parse_qs(parsed.query, keep_blank_values=True)
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        claimed = self.headers.get("x-amz-content-sha256", "")
+        if self.verify_auth and body and \
+                claimed != hashlib.sha256(body).hexdigest():
+            self.send_error(400, "payload hash mismatch")
+            return
+        if not self._check_sig(parsed.path, parsed.query, method="POST",
+                               payload_hash=claimed or None):
+            return
+        bucket, key = self._bucket_key(parsed.path)
+        if "uploads" in qs:  # CreateMultipartUpload
+            uid = os.urandom(8).hex()
+            type(self).uploads[uid] = {"key": f"{bucket}/{key}",
+                                       "parts": {}}
+            xml = (f'<?xml version="1.0"?><InitiateMultipartUploadResult>'
+                   f"<Bucket>{bucket}</Bucket><Key>{key}</Key>"
+                   f"<UploadId>{uid}</UploadId>"
+                   f"</InitiateMultipartUploadResult>").encode()
+            self._xml(xml)
+            return
+        if "uploadId" in qs:  # CompleteMultipartUpload
+            up = self.uploads.get(qs["uploadId"][0])
+            if up is None or up["key"] != f"{bucket}/{key}":
+                self.send_error(404, "no such upload")
+                return
+            data = b"".join(up["parts"][n] for n in sorted(up["parts"]))
+            type(self).objects[f"{bucket}/{key}"] = data
+            del type(self).uploads[qs["uploadId"][0]]
+            xml = (f'<?xml version="1.0"?><CompleteMultipartUploadResult>'
+                   f'<ETag>"{self._etag(data)}"</ETag>'
+                   f"</CompleteMultipartUploadResult>").encode()
+            self._xml(xml)
+            return
+        self.send_error(400)
+
+    def do_DELETE(self):
+        parsed = urllib.parse.urlparse(self.path)
+        qs = urllib.parse.parse_qs(parsed.query)
+        if not self._check_sig(parsed.path, parsed.query,
+                               method="DELETE"):
+            return
+        bucket, key = self._bucket_key(parsed.path)
+        if "uploadId" in qs:  # AbortMultipartUpload
+            self.uploads.pop(qs["uploadId"][0], None)
+        elif f"{bucket}/{key}" in self.objects:
+            del type(self).objects[f"{bucket}/{key}"]
+        else:
+            self.send_error(404)
+            return
+        self.send_response(204)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def _xml(self, body):
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        parsed = urllib.parse.urlparse(self.path)
+        qs = urllib.parse.parse_qs(parsed.query)
+        if not self._check_sig(parsed.path, parsed.query):
+            return
+        bucket, key = self._bucket_key(parsed.path)
+        if not key:  # ListObjectsV2
+            prefix = qs.get("prefix", [""])[0]
+            names = sorted(k.split("/", 1)[1] for k in self.objects
+                           if k.startswith(bucket + "/"))
+            names = [n for n in names if n.startswith(prefix)]
+            start = int(qs.get("continuation-token", ["0"])[0])
+            page = names[start:start + self.page_size]
+            trunc = start + self.page_size < len(names)
+            items = "".join(
+                f"<Contents><Key>{n}</Key><Size>"
+                f"{len(self.objects[f'{bucket}/{n}'])}</Size>"
+                f'<ETag>"{self._etag(self.objects[f"{bucket}/{n}"])}"'
+                f"</ETag></Contents>"
+                for n in page)
+            nxt = (f"<NextContinuationToken>{start + self.page_size}"
+                   f"</NextContinuationToken>" if trunc else "")
+            self._xml((f'<?xml version="1.0"?><ListBucketResult>'
+                       f"<IsTruncated>{'true' if trunc else 'false'}"
+                       f"</IsTruncated>{items}{nxt}</ListBucketResult>"
+                       ).encode())
+            return
+        obj = self.objects.get(f"{bucket}/{key}")
+        if obj is None:
+            self.send_error(404)
+            return
+        start = 0
+        rng = self.headers.get("Range")
+        if rng:
+            lo, _, hi = rng.split("=")[1].partition("-")
+            start = int(lo)
+            if start >= len(obj) and len(obj) == 0:
+                self.send_response(416)
+                self.send_header("Content-Range", f"bytes */{len(obj)}")
+                self.send_header("ETag", f'"{self._etag(obj)}"')
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            self.send_response(206)
+            end = int(hi) if hi else len(obj) - 1
+            body = obj[start:end + 1]
+            self.send_header("Content-Range",
+                             f"bytes {start}-{end}/{len(obj)}")
+        else:
+            self.send_response(200)
+            body = obj
+        self.send_header("ETag", f'"{self._etag(obj)}"')
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if key in self.fail_once:
+            self.fail_once.discard(key)
+            self.wfile.write(body[: max(1, len(body) // 2)])
+            self.wfile.flush()
+            self.connection.close()
+            return
+        self.wfile.write(body)
+
+
+def make_s3_handler(secret="testsecret", region="us-east-1",
+                    verify_auth=True):
+    """A fresh FakeS3Handler subclass with its OWN state (one per server)."""
+    return type("FakeS3HandlerInstance", (FakeS3Handler,), dict(
+        objects={}, uploads={}, fail_once=set(), secret=secret,
+        region=region, verify_auth=verify_auth))
+
+
+# -- servers ----------------------------------------------------------------
+
+def _serve(handler):
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    srv.handler = handler  # the per-server state lives on this class
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def serve_gcs(objects=None):
+    """Fresh fake-GCS server (empty bucket unless `objects` given: a
+    {name: bytes} map). Returns (server, endpoint_url); caller points
+    STORAGE_EMULATOR_HOST at endpoint_url and calls stop_serving(server)."""
+    handler = make_gcs_handler()
+    if objects:
+        handler.objects.update(objects)
+        handler.generations.update({n: 1 for n in objects})
+    return _serve(handler)
+
+
+def serve_s3(objects=None, secret="testsecret", region="us-east-1",
+             verify_auth=True):
+    """Fresh fake-S3 server ({'bucket/key': bytes} corpus). Returns
+    (server, endpoint_url) for AWS_ENDPOINT_URL."""
+    handler = make_s3_handler(secret=secret, region=region,
+                              verify_auth=verify_auth)
+    if objects:
+        handler.objects.update(objects)
+    return _serve(handler)
+
+
+def corrupt_npz_bytes(raw: bytes) -> bytes:
+    """Flip one value inside an npz archive but rewrite a VALID archive
+    (zip CRCs match): the silent at-rest corruption only the checkpoint
+    store's recorded sha256 digests can catch. Bytes in, bytes out — the
+    one canonical implementation for both the local-path and
+    bucket-object corruption tests (a byte flip in the raw zip would tear
+    the archive and exercise the WRONG failure path)."""
+    import io
+
+    import numpy as np
+    with np.load(io.BytesIO(raw)) as z:
+        arrs = {k: z[k].copy() for k in z.files}
+    k = sorted(arrs)[0]
+    flat = arrs[k].reshape(-1).view(np.uint8)
+    flat[0] ^= 0x01
+    buf = io.BytesIO()
+    np.savez(buf, **arrs)
+    return buf.getvalue()
+
+
+@contextlib.contextmanager
+def bucket_store(kind: str, objects=None, secret: str = "testsecret"):
+    """Serve a fake bucket AND wire THIS process to it: sets the
+    endpoint/credential env vars (prior values restored on exit), clears
+    the gcs/s3 client + size/stat caches on entry AND exit (so a bench or
+    script leaves no warm cache entries behind for later callers of the
+    same bucket/prefix), and shortens the retry backoff so one flaky
+    response can't sleep 0.5*2^n seconds inside a timed section. Yields
+    (bucket_root_url, server). The non-pytest twin of the store fixtures
+    in test_checkpoint_stores.py — bench `--checkpoint-stall` and scripts
+    go through here so the three bootstraps can't drift."""
+    from sparknet_tpu.data import gcs as gcs_mod, s3 as s3_mod
+    keys = ("STORAGE_EMULATOR_HOST", "no_proxy", "AWS_ENDPOINT_URL",
+            "AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY", "AWS_REGION")
+    saved = {k: os.environ.get(k) for k in keys}
+    saved_backoff = gcs_mod.BACKOFF_S
+
+    def clear_caches():
+        for m in (gcs_mod, s3_mod):
+            m._CLIENTS.clear()
+            m._SIZE_CACHE.clear()
+            m._STAT_CACHE.clear()
+
+    if kind == "gs":
+        srv, endpoint = serve_gcs(objects)
+        os.environ["STORAGE_EMULATOR_HOST"] = endpoint
+    elif kind == "s3":
+        srv, endpoint = serve_s3(objects, secret=secret)
+        os.environ.update(AWS_ENDPOINT_URL=endpoint,
+                          AWS_ACCESS_KEY_ID="AKFAKE",
+                          AWS_SECRET_ACCESS_KEY=secret,
+                          AWS_REGION="us-east-1")
+    else:
+        raise ValueError(f"bucket_store kind {kind!r}: gs or s3")
+    os.environ["no_proxy"] = "*"
+    gcs_mod.BACKOFF_S = 0.01
+    clear_caches()
+    try:
+        yield f"{kind}://bkt", srv
+    finally:
+        stop_serving(srv)
+        gcs_mod.BACKOFF_S = saved_backoff
+        clear_caches()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _dir_objects(root: str, prefix: str):
+    out = {}
+    for f in sorted(os.listdir(root)):
+        p = os.path.join(root, f)
+        if os.path.isfile(p):
+            with open(p, "rb") as fh:
+                out[f"{prefix}/{f}"] = fh.read()
+    return out
+
+
+def serve_dir_as_gcs(root: str, prefix: str = "imagenet"):
+    """Load every file under `root` into a fresh fake bucket as
+    `<prefix>/<name>` and start a threaded server on 127.0.0.1:<free
+    port>. Returns (server, endpoint_url); caller sets
+    STORAGE_EMULATOR_HOST=endpoint_url and shuts the server down."""
+    return serve_gcs(_dir_objects(root, prefix))
 
 
 def serve_dir_for_ingest(root: str, prefix: str = "imagenet"):
@@ -111,34 +582,28 @@ def serve_dir_for_ingest(root: str, prefix: str = "imagenet"):
     (STORAGE_EMULATOR_HOST, no_proxy). Returns (server, gs_url_root);
     call `stop_serving(server)` when done — shared by `bench.py --store
     gs` and `scripts/soak_stream.py --store gs` so the setup/cleanup
-    can't drift between them."""
+    can't drift between them. The PRIOR env values are remembered on the
+    server and restored by stop_serving (the mutation must not outlive
+    the fake server, ADVICE r5 #1)."""
     srv, endpoint = serve_dir_as_gcs(root, prefix)
+    srv.saved_env = {k: os.environ.get(k)
+                     for k in ("STORAGE_EMULATOR_HOST", "no_proxy")}
     os.environ["STORAGE_EMULATOR_HOST"] = endpoint
     os.environ["no_proxy"] = "*"
     return srv, f"gs://bkt/{prefix}"
 
 
 def stop_serving(server) -> None:
+    """Shut the server down, restore any env vars serve_dir_for_ingest
+    saved, and drop the served corpus so it doesn't stay pinned in RSS."""
     server.shutdown()
-    os.environ.pop("STORAGE_EMULATOR_HOST", None)
-
-
-def serve_dir_as_gcs(root: str, prefix: str = "imagenet"):
-    """Load every file under `root` into the fake bucket as
-    `<prefix>/<name>` and start a threaded server on 127.0.0.1:<free
-    port>. Returns (server, endpoint_url); caller sets
-    STORAGE_EMULATOR_HOST=endpoint_url and shuts the server down."""
-    objects = {}
-    for f in sorted(os.listdir(root)):
-        p = os.path.join(root, f)
-        if os.path.isfile(p):
-            with open(p, "rb") as fh:
-                objects[f"{prefix}/{f}"] = fh.read()
-    FakeGcsHandler.objects = objects
-    FakeGcsHandler.fail_once = set()
-    FakeGcsHandler.ignore_range = False
-    FakeGcsHandler.range_log = []
-    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), FakeGcsHandler)
-    t = threading.Thread(target=srv.serve_forever, daemon=True)
-    t.start()
-    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+    for k, v in getattr(server, "saved_env", {}).items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    handler = getattr(server, "handler", None)
+    if handler is not None:
+        handler.objects.clear()
+        for attr in ("sessions", "uploads", "generations"):
+            getattr(handler, attr, {}).clear()

@@ -102,6 +102,45 @@ def test_featurizer(tmp_path):
     assert feats.shape == (50, 64)
 
 
+def test_featurizer_cross_backend_agreement():
+    """The SAME weights through both NetInterface impls must produce the
+    SAME hidden-blob features (the FeaturizerApp contract: a featurizer
+    run can't care which backend served it). zoo.lenet and the reference
+    mnist graph share one architecture; copy the graph's variables into
+    the layer-IR params (fc1 rows permuted: the layer IR flattens
+    Caffe-style C,H,W while the graph flattens H,W,C) and compare the
+    post-relu fc features."""
+    from sparknet_tpu.backend.builder import build_mnist_graph
+    from sparknet_tpu.backend.graph_net import GraphNet
+
+    B = 8
+    gnet = GraphNet(build_mnist_graph(batch=B))
+    jnet = JaxNet(lenet(batch=B))
+    v = {k: np.asarray(a) for k, a in gnet.variables.items()}
+    jnet.params["conv1"]["w"] = v["conv1_w"]
+    jnet.params["conv1"]["b"] = v["conv1_b"]
+    jnet.params["conv2"]["w"] = v["conv2_w"]
+    jnet.params["conv2"]["b"] = v["conv2_b"]
+    jnet.params["fc1"]["w"] = (
+        v["fc1_w"].reshape(7, 7, 64, 512)
+        .transpose(2, 0, 1, 3).reshape(7 * 7 * 64, 512))
+    jnet.params["fc1"]["b"] = v["fc1_b"]
+    jnet.params["fc2"]["w"] = v["fc2_w"]
+    jnet.params["fc2"]["b"] = v["fc2_b"]
+
+    r = np.random.default_rng(0)
+    batch = {"data": r.standard_normal((B, 28, 28, 1)).astype(np.float32),
+             "label": r.integers(0, 10, (B, 1)).astype(np.int32)}
+    jf = jnet.forward(batch, blob_names=["fc1"])["fc1"]
+    gf = gnet.forward(batch, blob_names=["relu3"])["relu3"]
+    assert jf.shape == gf.shape == (B, 512)
+    np.testing.assert_allclose(jf, gf, rtol=1e-5, atol=1e-5)
+    # and the logits head agrees too (full-net equivalence, not just fc1)
+    jl = jnet.forward(batch, blob_names=["fc2"])["fc2"]
+    gl = gnet.forward(batch, blob_names=["logits"])["logits"]
+    np.testing.assert_allclose(jl, gl, rtol=1e-5, atol=1e-5)
+
+
 def test_checkpoint_shape_mismatch_fails_loudly(tmp_path):
     from sparknet_tpu.utils import checkpoint
     tree = {"a": {"w": np.zeros((2, 3))}}

@@ -60,6 +60,23 @@ def test_bench_round_timing_core():
     assert t > 0
 
 
+def test_checkpoint_stall_bench_core(tmp_path):
+    """bench.checkpoint_stall runs the real two-stage pipeline against all
+    three stores at a tiny state size and reports a sane shape: async
+    blocking must come in UNDER sync for every store (the whole point),
+    and the artifact rows cover the full store x mode matrix."""
+    import bench
+    rows = bench.checkpoint_stall(
+        mb=2, saves=2, out_path=str(tmp_path / "BENCH_CKPT.json"))
+    assert {(r["store"], r["mode"]) for r in rows} == {
+        (s, m) for s in ("local", "gs", "s3") for m in ("sync", "async")}
+    by = {(r["store"], r["mode"]): r["blocking_ms_per_save"] for r in rows}
+    for store in ("local", "gs", "s3"):
+        assert by[(store, "async")] < by[(store, "sync")], (store, by)
+    assert json.load(open(tmp_path / "BENCH_CKPT.json"))["headline"][
+        "metric"] == "checkpoint_blocking_stall_async_over_sync"
+
+
 def test_profiler_trace_capture(tmp_path):
     """maybe_trace writes a TensorBoard-loadable capture; None is a no-op."""
     import jax

@@ -113,6 +113,126 @@ def _hashes(path):
     return out
 
 
+CHILD_BUCKET_CKPT = r"""
+import hashlib, json, os, sys
+os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=4'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+from sparknet_tpu.apps.train_loop import train
+from sparknet_tpu.data import mnist
+from sparknet_tpu.data.dataset import ArrayDataset
+from sparknet_tpu.utils.config import RunConfig
+from sparknet_tpu.utils.health import HealthConfig
+from sparknet_tpu.utils.logger import Logger
+from sparknet_tpu.zoo import lenet
+
+root, ckdir, proglog, max_rounds = sys.argv[1:5]
+
+tr = mnist.MnistLoader(root).train_batch_dict()
+
+
+def hook(rnd, state):
+    with open(proglog, 'a') as f:
+        f.write(json.dumps({'round': rnd}) + '\n')
+        f.flush()
+
+
+cfg = RunConfig(model='lenet', tau=2, local_batch=2,
+                max_rounds=int(max_rounds), eval_every=0, seed=0,
+                checkpoint_dir=ckdir, checkpoint_every=1,
+                workdir=os.path.dirname(proglog),
+                health=HealthConfig(enabled=False))
+train(cfg, lenet(batch=2), ArrayDataset(tr), None,
+      logger=Logger(os.path.join(os.path.dirname(proglog), 'train.txt'),
+                    echo=False), round_hook=hook)
+print('CHILD DONE')
+"""
+
+BUCKET_ROUNDS = 5
+
+
+@pytest.mark.chaos
+def test_kill9_mid_upload_resumes_bitexact_from_bucket(tmp_path,
+                                                       monkeypatch):
+    """The r6 bucket-checkpoint chaos story (NOT slow-marked: runs in the
+    tier-1 workflow): a training child writes per-round checkpoints
+    natively to gs:// through the ASYNC two-stage pipeline; the parent —
+    which hosts the fake bucket and can SEE the store's live resumable
+    sessions — SIGKILLs the child exactly while a state.npz upload is in
+    flight. The torn save must be invisible (meta.json never landed), the
+    relaunch must resume from the newest committed bucket checkpoint, and
+    the finished run's final state must be bit-identical to an
+    uninterrupted local-checkpoint run."""
+    from sparknet_tpu.data import mnist
+    from sparknet_tpu.utils import checkpoint as ckpt
+    from fake_stores import serve_gcs, stop_serving
+
+    root = str(tmp_path / "mnist")
+    mnist.write_synthetic(root, n_train=64, n_test=8)
+
+    srv, endpoint = serve_gcs()
+    handler = srv.handler
+    handler.upload_delay_s = 0.05  # widen the mid-upload kill window
+    # parent env too: the final restore_flat("gs://...") below runs here
+    monkeypatch.setenv("STORAGE_EMULATOR_HOST", endpoint)
+    monkeypatch.setenv("no_proxy", "*")
+
+    def launch(ckdir, workdir):
+        os.makedirs(workdir, exist_ok=True)
+        return subprocess.Popen(
+            [sys.executable, "-c", CHILD_BUCKET_CKPT, root, ckdir,
+             os.path.join(workdir, "prog.jsonl"), str(BUCKET_ROUNDS)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=os.path.join(os.path.dirname(__file__), ".."),
+            env=dict(os.environ))
+
+    try:
+        # uninterrupted reference run, local checkpoint dir
+        ck_a = str(tmp_path / "ck_a")
+        p = launch(ck_a, str(tmp_path / "run_a"))
+        out, _ = p.communicate(timeout=420)
+        assert p.returncode == 0 and "CHILD DONE" in out, out
+
+        # chaos run against the bucket: kill WHILE an upload session for
+        # the checkpoint prefix is live AND at least one step committed
+        ck_b = "gs://bkt/ck_b"
+        p = launch(ck_b, str(tmp_path / "run_b"))
+        deadline = time.time() + 300
+        killed = False
+        while time.time() < deadline and p.poll() is None:
+            committed = any(k.startswith("ck_b/") and
+                            k.endswith("meta.json")
+                            for k in list(handler.objects))  # server
+            # threads mutate the dict concurrently; list() snapshots it
+            live = [s for s in list(handler.sessions.values())
+                    if s["name"].startswith("ck_b/")]
+            if committed and live:
+                os.kill(p.pid, signal.SIGKILL)
+                p.wait(timeout=60)
+                killed = True
+                break
+            time.sleep(0.002)
+        assert killed, "never observed a live mid-upload window to kill"
+
+        # relaunch: must resume from the newest COMMITTED bucket step and
+        # finish; the torn upload is swept/ignored
+        p = launch(ck_b, str(tmp_path / "run_b2"))
+        out, _ = p.communicate(timeout=420)
+        assert p.returncode == 0 and "CHILD DONE" in out, out
+        text = open(str(tmp_path / "run_b2" / "train.txt")).read()
+        assert "resumed from checkpoint round" in text
+
+        fa, sa, _ = ckpt.restore_flat(ck_a)
+        fb, sb, _ = ckpt.restore_flat(ck_b)
+        assert sa == sb == BUCKET_ROUNDS
+        assert sorted(fa) == sorted(fb)
+        for k in fa:
+            np.testing.assert_array_equal(fa[k], fb[k], err_msg=k)
+    finally:
+        stop_serving(srv)
+
+
 @pytest.mark.slow
 @pytest.mark.chaos
 @pytest.mark.parametrize("store", ["local", "gs"])

@@ -8,25 +8,33 @@ import numpy as np
 import pytest
 
 from sparknet_tpu.data import imagenet
-from fake_stores import FakeGcsHandler as _FakeGcs
+
+#: the LIVE handler class of the current fixture's server (state is
+#: per-server since r6 — the fixture rebinds this module global so tests
+#: keep their `_FakeGcs.objects`-style spelling)
+_FakeGcs = None
 
 
 @pytest.fixture
 def gcs(tmp_path, monkeypatch):
     """Fake bucket 'bkt' holding synthetic shards under imagenet/, with the
     client pointed at it via STORAGE_EMULATOR_HOST."""
-    from fake_stores import serve_dir_as_gcs
+    global _FakeGcs
+    from fake_stores import serve_dir_as_gcs, stop_serving
     root = str(tmp_path / "local")
     imagenet.write_synthetic_shards(root, n_shards=3, per_shard=6, size=48)
     srv, endpoint = serve_dir_as_gcs(root)
+    _FakeGcs = srv.handler
     monkeypatch.setenv("STORAGE_EMULATOR_HOST", endpoint)
     monkeypatch.setenv("no_proxy", "*")
     # retries back off 0.5*2^n seconds; keep the flaky-path test fast
     from sparknet_tpu.data import gcs as gcs_mod
     monkeypatch.setattr(gcs_mod, "BACKOFF_S", 0.01)
     gcs_mod._SIZE_CACHE.clear()
+    gcs_mod._STAT_CACHE.clear()
     yield "gs://bkt/imagenet", root
-    srv.shutdown()
+    stop_serving(srv)
+    _FakeGcs = None
 
 
 def test_list_and_labels_match_local(gcs):
@@ -214,6 +222,52 @@ def test_gs_carve_resume_skips_prefix(gcs):
     assert starts and min(starts) >= 512  # never re-read the tar prefix
 
 
+def test_gs_mid_walk_replace_forces_rewalk_next_epoch(gcs):
+    """The freshness token is captured BEFORE the walk: an object
+    replaced WHILE epoch 1 streams it leaves an index paired with the
+    PRE-replacement stat, so epoch 2's fresh stat differs and the shard
+    is re-walked — a post-walk capture would pair old offsets with the
+    new token and carve garbage forever."""
+    from sparknet_tpu.data.gcs import gs_write
+    url, root = gcs
+    labels = imagenet.load_label_map(os.path.join(root, "train.txt"))
+    g = imagenet.ShardedTarLoader(imagenet.list_shards(url), labels,
+                                  height=32, width=32)
+    g.DECODE_CHUNK = 1  # yield per entry so the walk is genuinely
+    # mid-flight at the replacement (the default buffers a whole chunk)
+    shard0 = imagenet.list_shards(url)[0]
+    it = g.iter_with_pos()
+    next(it)  # shard 0's walk has started: its stat is already captured
+    name = sorted(n for n in _FakeGcs.objects if n.endswith(".tar"))[0]
+    gs_write(f"gs://bkt/{name}", _FakeGcs.objects[name])  # gen bump
+    for _ in it:  # drain: index cached with the PRE-replacement stat
+        pass
+    cached_stat = g._bucket_indices[shard0][1]
+    assert cached_stat != imagenet.path_stat(shard0, fresh=True)
+    g.load_all()  # epoch 2 must re-walk shard 0 and refresh its stat
+    assert g._bucket_indices[shard0][1] == \
+        imagenet.path_stat(shard0, fresh=True)
+
+
+def test_gs_resume_walk_captures_index(gcs):
+    """A COLD resume (skip>0, no warm index) still iterates the tar stream
+    from byte 0 and records every member — so reaching end-of-archive must
+    cache the index (ADVICE r5 #4: the old `skip == 0` gate threw it away
+    and the resumed shard paid one extra full header-parsing walk)."""
+    url, root = gcs
+    labels = imagenet.load_label_map(os.path.join(root, "train.txt"))
+    g = imagenet.ShardedTarLoader(imagenet.list_shards(url), labels,
+                                  height=32, width=32)
+    mid_shard_entry = 2  # resume mid-shard-0: skip>0 on its walk
+    drained = list(g.iter_with_pos((0, mid_shard_entry)))
+    assert drained
+    assert len(g._bucket_indices) == 3  # resumed shard's index kept too
+    # and the captured index carves the next epoch bit-identically
+    full = imagenet.ShardedTarLoader(imagenet.list_shards(root), labels,
+                                     height=32, width=32)
+    np.testing.assert_array_equal(g.load_all()[0], full.load_all()[0])
+
+
 def test_gs_carve_disconnect_resumes(gcs):
     """The carve path rides the same reconnect-resume transport: a body
     truncated mid-member on epoch 2 is retried from the break, bytes
@@ -248,6 +302,33 @@ def test_gs_carve_short_object_fails_loudly(gcs):
     assert not any(k.endswith("train.0002.tar")
                    for k in g._bucket_indices), \
         "stale index survived the size change"
+
+
+def test_gs_equal_size_replace_invalidated_by_generation(gcs):
+    """An EQUAL-size replacement is invisible to the size check — the
+    generation token (bumped by every write, returned by the same
+    metadata GET) must drop the warm index so the walk re-reads instead
+    of carving at possibly-stale offsets (ADVICE r5 #3)."""
+    from sparknet_tpu.data.gcs import gs_write
+    url, root = gcs
+    labels = imagenet.load_label_map(os.path.join(root, "train.txt"))
+    g = imagenet.ShardedTarLoader(imagenet.list_shards(url), labels,
+                                  height=32, width=32)
+    e1 = g.load_all()
+    assert len(g._bucket_indices) == 3
+    # re-upload identical bytes: same size, NEW generation
+    name = sorted(n for n in _FakeGcs.objects if n.endswith(".tar"))[0]
+    gs_write(f"gs://bkt/{name}", _FakeGcs.objects[name])
+    _FakeGcs.range_log.clear()
+    e2 = g.load_all()
+    np.testing.assert_array_equal(e1[0], e2[0])
+    # the replaced shard was re-WALKED (a from-byte-0 stream), not carved
+    # at warm offsets; un-replaced shards still carve (opens > 0)
+    starts = [int(rng.split("=")[1].split("-")[0])
+              for n, rng in _FakeGcs.range_log if n == name]
+    assert (not starts) or min(starts) == 0, starts
+    # ... and the walk re-captured a fresh index for it
+    assert any(k.endswith(name.split("/")[-1]) for k in g._bucket_indices)
 
 
 def test_gs_carve_index_invalidated_on_object_replace(gcs):

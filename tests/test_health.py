@@ -183,14 +183,15 @@ def _save_steps(d, n=3, seed=0):
 
 
 def _silently_corrupt(npz_path):
-    """Flip one value but rewrite a VALID archive (zip CRCs match): the
-    silent at-rest corruption only the recorded sha256 digests can catch."""
-    with np.load(npz_path) as z:
-        arrs = {k: z[k].copy() for k in z.files}
-    k = sorted(arrs)[0]
-    flat = arrs[k].reshape(-1)
-    flat[0] = flat[0] + 1 if flat[0] != flat[0] + 1 else flat[0] - 1
-    np.savez(npz_path, **arrs)
+    """Path wrapper over the one canonical digest-evading corruption
+    helper (fake_stores.corrupt_npz_bytes): flip one value but rewrite a
+    VALID archive, the silent at-rest corruption only the recorded
+    sha256 digests can catch."""
+    from fake_stores import corrupt_npz_bytes
+    with open(npz_path, "rb") as f:
+        raw = f.read()
+    with open(npz_path, "wb") as f:
+        f.write(corrupt_npz_bytes(raw))
 
 
 def test_digest_verification_rejects_flipped_byte(tmp_path):

@@ -4,13 +4,7 @@ the reference's actual data plane (it streamed ImageNet from S3 per task,
 Signature Version 4 on every request (recomputing it server-side from the
 shared secret), so the stdlib SigV4 implementation is tested end to end,
 not just exercised."""
-import datetime
-import hashlib
-import hmac
-import http.server
 import os
-import threading
-import urllib.parse
 
 import numpy as np
 import pytest
@@ -19,152 +13,26 @@ from sparknet_tpu.data import imagenet
 
 ACCESS, SECRET = "AKTEST", "testsecret"
 
-
-def _expected_sig(method, path, query, headers_lower, signed, region,
-                  payload_hash=None):
-    """Server-side SigV4 recomputation (mirrors the spec, written against
-    the AWS docs independently of the client). `headers_lower` is the
-    received header map lowercased; `signed` the SignedHeaders list."""
-    amz_date = headers_lower["x-amz-date"]
-    datestamp = amz_date[:8]
-    canon_headers = "".join(
-        f"{k}:{headers_lower[k].strip()}\n" for k in signed.split(";"))
-    canonical = "\n".join([
-        method, urllib.parse.quote(path, safe="/-_.~"), query,
-        canon_headers, signed,
-        payload_hash or hashlib.sha256(b"").hexdigest()])
-    scope = f"{datestamp}/{region}/s3/aws4_request"
-    sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
-                     hashlib.sha256(canonical.encode()).hexdigest()])
-
-    def h(key, msg):
-        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
-    key = h(h(h(h(("AWS4" + SECRET).encode(), datestamp),
-              region), "s3"), "aws4_request")
-    return hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
-
-
-class _FakeS3(http.server.BaseHTTPRequestHandler):
-    objects = {}       # "bucket/key" -> bytes
-    fail_once = set()
-    region = "us-east-1"
-    verify_auth = True
-    page_size = 2
-
-    def log_message(self, *a):
-        pass
-
-    def _check_sig(self, path, query, method="GET", payload_hash=None):
-        if not self.verify_auth:
-            return True
-        auth = self.headers.get("Authorization", "")
-        if not auth.startswith("AWS4-HMAC-SHA256"):
-            self.send_error(403, "missing SigV4")
-            return False
-        hdrs = {k.lower(): v for k, v in self.headers.items()}
-        signed = auth.split("SignedHeaders=")[1].split(",")[0].strip()
-        want = auth.split("Signature=")[1].strip()
-        got = _expected_sig(method, path, query, hdrs, signed, self.region,
-                            payload_hash)
-        if want != got:
-            self.send_error(403, "bad signature")
-            return False
-        return True
-
-    def do_PUT(self):
-        parsed = urllib.parse.urlparse(self.path)
-        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
-        # the signed payload hash must MATCH the body (tamper detection)
-        claimed = self.headers.get("x-amz-content-sha256", "")
-        if claimed != hashlib.sha256(body).hexdigest():
-            self.send_error(400, "payload hash mismatch")
-            return
-        if not self._check_sig(parsed.path, parsed.query, method="PUT",
-                               payload_hash=claimed):
-            return
-        parts = parsed.path.lstrip("/").split("/", 1)
-        if len(parts) != 2:
-            self.send_error(400)
-            return
-        self.objects[f"{parts[0]}/{parts[1]}"] = body
-        self.send_response(200)
-        self.send_header("Content-Length", "0")
-        self.end_headers()
-
-    def do_GET(self):
-        parsed = urllib.parse.urlparse(self.path)
-        qs = urllib.parse.parse_qs(parsed.query)
-        if not self._check_sig(parsed.path, parsed.query):
-            return
-        parts = parsed.path.lstrip("/").split("/", 1)
-        bucket = parts[0]
-        key = parts[1] if len(parts) > 1 else ""
-        if not key:  # ListObjectsV2
-            prefix = qs.get("prefix", [""])[0]
-            names = sorted(k.split("/", 1)[1] for k in self.objects
-                           if k.startswith(bucket + "/"))
-            names = [n for n in names if n.startswith(prefix)]
-            start = int(qs.get("continuation-token", ["0"])[0])
-            page = names[start:start + self.page_size]
-            trunc = start + self.page_size < len(names)
-            items = "".join(
-                f"<Contents><Key>{n}</Key><Size>"
-                f"{len(self.objects[f'{bucket}/{n}'])}</Size></Contents>"
-                for n in page)
-            nxt = (f"<NextContinuationToken>{start + self.page_size}"
-                   f"</NextContinuationToken>" if trunc else "")
-            body = (f'<?xml version="1.0"?><ListBucketResult>'
-                    f"<IsTruncated>{'true' if trunc else 'false'}"
-                    f"</IsTruncated>{items}{nxt}</ListBucketResult>"
-                    ).encode()
-            self.send_response(200)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-            return
-        obj = self.objects.get(f"{bucket}/{key}")
-        if obj is None:
-            self.send_error(404)
-            return
-        start = 0
-        rng = self.headers.get("Range")
-        if rng:
-            lo, _, hi = rng.split("=")[1].partition("-")
-            start = int(lo)
-            self.send_response(206)
-            end = int(hi) if hi else len(obj) - 1
-            body = obj[start:end + 1]
-            self.send_header("Content-Range",
-                             f"bytes {start}-{end}/{len(obj)}")
-        else:
-            self.send_response(200)
-            body = obj
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        if key in self.fail_once:
-            self.fail_once.discard(key)
-            self.wfile.write(body[: max(1, len(body) // 2)])
-            self.wfile.flush()
-            self.connection.close()
-            return
-        self.wfile.write(body)
+#: the LIVE handler class of the current fixture's server (the SigV4-
+#: verifying FakeS3Handler now lives in fake_stores so bench/chaos can
+#: serve s3:// outside pytest; state is per-server, the fixture rebinds
+#: this module global)
+_FakeS3 = None
 
 
 @pytest.fixture
 def s3(tmp_path, monkeypatch):
+    global _FakeS3
+    from fake_stores import serve_s3, stop_serving
     root = str(tmp_path / "local")
     imagenet.write_synthetic_shards(root, n_shards=3, per_shard=6, size=48)
     objects = {}
     for f in sorted(os.listdir(root)):
         with open(os.path.join(root, f), "rb") as fh:
             objects[f"bkt/imagenet/{f}"] = fh.read()
-    _FakeS3.objects = objects
-    _FakeS3.fail_once = set()
-    _FakeS3.verify_auth = True
-    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _FakeS3)
-    threading.Thread(target=srv.serve_forever, daemon=True).start()
-    monkeypatch.setenv("AWS_ENDPOINT_URL",
-                       f"http://127.0.0.1:{srv.server_address[1]}")
+    srv, endpoint = serve_s3(objects, secret=SECRET)
+    _FakeS3 = srv.handler
+    monkeypatch.setenv("AWS_ENDPOINT_URL", endpoint)
     monkeypatch.setenv("AWS_ACCESS_KEY_ID", ACCESS)
     monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", SECRET)
     monkeypatch.setenv("AWS_REGION", "us-east-1")
@@ -173,8 +41,10 @@ def s3(tmp_path, monkeypatch):
     monkeypatch.setattr(gcs_mod, "BACKOFF_S", 0.01)
     s3_mod._CLIENTS.clear()
     s3_mod._SIZE_CACHE.clear()
+    s3_mod._STAT_CACHE.clear()
     yield "s3://bkt/imagenet", root
-    srv.shutdown()
+    stop_serving(srv)
+    _FakeS3 = None
 
 
 def test_s3_list_and_labels_signed(s3):
@@ -268,6 +138,37 @@ def test_s3_upload_roundtrip_and_sharder_push(s3, tmp_path):
     np.testing.assert_array_equal(up.load_all()[0], local.load_all()[0])
     with pytest.raises(SystemExit, match="gs:// or s3://"):
         shard_imagenet.upload_dir(root, "/local/path")
+
+
+def test_s3_equal_size_replace_invalidated_by_etag(s3):
+    """The s3 twin of the gs generation test: an EQUAL-size replacement
+    changes the ETag (it rides the same `bytes=0-0` probe the size check
+    already made), so the warm member index is dropped and the shard is
+    re-walked instead of carved at stale offsets (ADVICE r5 #3)."""
+    url, root = s3
+    labels = imagenet.load_label_map(os.path.join(root, "train.txt"))
+    s = imagenet.ShardedTarLoader(imagenet.list_shards(url), labels,
+                                  height=32, width=32)
+    s.load_all()
+    assert len(s._bucket_indices) == 3
+    name = sorted(k for k in _FakeS3.objects if k.endswith(".tar"))[0]
+    obj_url = f"s3://{name}"
+    stat_before = imagenet.path_stat(obj_url, fresh=True)
+    # equal-size replacement: flip one byte INSIDE the first member's
+    # data (offset 600: past the 512-byte tar header, inside the JPEG) —
+    # size unchanged, ETag (md5 of the object) changes
+    raw = bytearray(_FakeS3.objects[name])
+    raw[600] ^= 0x01
+    _FakeS3.objects[name] = bytes(raw)
+    stat_after = imagenet.path_stat(obj_url, fresh=True)
+    assert stat_after[0] == stat_before[0]  # equal size
+    assert stat_after[1] != stat_before[1]  # different ETag
+    # next epoch must NOT carve at the stale index: the freshness check
+    # drops it and the tarfile walk re-captures with the NEW stat (the
+    # flipped member may fail decode — counted in `skipped`, never
+    # silently mis-carved)
+    s.load_all()
+    assert s._bucket_indices[obj_url][1] == stat_after
 
 
 def test_s3_second_epoch_carve_bit_identical(s3):
