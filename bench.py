@@ -676,6 +676,132 @@ def featurize_bench(batch: int = 64, trials: int = 5,
     return out
 
 
+def serve_bench(out_path: str | None = "BENCH_SERVE.json",
+                duration_s: float = 2.0, max_batch: int = 8,
+                max_wait_ms: float = 5.0, model: str = "lenet") -> dict:
+    """Offered-load vs latency/throughput/batch-fill for the dynamic-
+    batching inference server (`sparknet_tpu.serve`), on the CPU backend
+    at lenet shapes (the batching policy under test is host-side; the
+    forward is just a stand-in for a chip's).
+
+    Three load regimes, one row each in BENCH_SERVE.json:
+      - trickle: ONE closed-loop client (a new request only after the
+        previous answered) — every batch is size 1, and p99 latency must
+        stay bounded by the max-wait deadline + ~one batch forward (the
+        latency-mode contract: an idle server must not hold a lone
+        request to the deadline... it still waits max_wait for company,
+        so the bound INCLUDES the deadline).
+      - offered-rate sweep: open-loop Poisson-ish arrivals at a few
+        requests/sec levels between trickle and saturation.
+      - saturate: many closed-loop clients keep the queue full — the
+        batcher must run full buckets (fill >= 0.8 is the acceptance
+        target; in practice it pins at ~1.0 because a deep queue always
+        fills max_batch).
+    """
+    import threading
+
+    import numpy as np
+
+    from sparknet_tpu.net_api import JaxNet
+    from sparknet_tpu.serve import InferenceServer, ServeConfig
+    from sparknet_tpu.zoo import lenet
+
+    net = JaxNet(lenet(batch=max_batch))
+    cfg = ServeConfig(max_batch=max_batch, max_wait_ms=max_wait_ms,
+                      outputs=("prob",), metrics_every_batches=0)
+    rng = np.random.default_rng(0)
+    req = {"data": rng.standard_normal((28, 28, 1)).astype(np.float32)}
+
+    def run_closed(srv, n_clients: int, secs: float) -> dict:
+        stop = time.perf_counter() + secs
+        done = [0] * n_clients
+
+        def client(j):
+            while time.perf_counter() < stop:
+                srv.infer(req, timeout=30.0)
+                done[j] += 1
+        ts = [threading.Thread(target=client, args=(j,))
+              for j in range(n_clients)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        s = srv.status()
+        s["clients"] = n_clients
+        s["achieved_rps"] = round(sum(done) / secs, 1)
+        return s
+
+    def run_open(srv, rps: float, secs: float) -> dict:
+        period = 1.0 / rps
+        futures = []
+        t_next, stop = time.perf_counter(), time.perf_counter() + secs
+        while time.perf_counter() < stop:
+            now = time.perf_counter()
+            if now < t_next:
+                time.sleep(t_next - now)
+            futures.append(srv.submit(req))
+            t_next += period
+        for f in futures:
+            f.result(timeout=30.0)
+        s = srv.status()
+        s["offered_rps"] = rps
+        s["achieved_rps"] = round(len(futures) / secs, 1)
+        return s
+
+    rows = []
+    with InferenceServer(net, cfg) as srv:
+        srv.infer(req)  # compile the size-1 bucket before the clock
+        # one full-bucket warm compile too (saturate would pay it inside
+        # its timed window otherwise)
+        fs = [srv.submit(req) for _ in range(max_batch * 2)]
+        for f in fs:
+            f.result(timeout=30.0)
+
+        srv.reset_counters()
+        s = run_closed(srv, 1, duration_s)
+        # the low-load latency contract: one trickle request waits out the
+        # max-wait deadline (hoping for company) plus one forward. p50 ~=
+        # deadline + forward, so the forward estimate is p50 - deadline;
+        # p99 must stay within deadline + a few forwards (tail scheduling
+        # jitter), NOT drift toward queueing territory
+        fwd_ms = max((s["p50_ms"] or 0.0) - max_wait_ms, 0.5)
+        p99_bound_ms = max_wait_ms + 4.0 * fwd_ms + 2.0
+        rows.append({"load": "trickle", **s,
+                     "est_forward_ms": round(fwd_ms, 3),
+                     "p99_bound_ms": round(p99_bound_ms, 2),
+                     "p99_ok": (s["p99_ms"] or 1e9) <= p99_bound_ms})
+        for rps in (50.0, 200.0):
+            srv.reset_counters()
+            rows.append({"load": f"open_{int(rps)}rps",
+                         **run_open(srv, rps, duration_s)})
+        srv.reset_counters()
+        s = run_closed(srv, 4 * max_batch, duration_s)
+        rows.append({"load": "saturate", **s,
+                     "fill_target": 0.8,
+                     "fill_ok": s["batch_fill_ratio"] >= 0.8})
+
+    for r in rows:  # drop non-scalar noise from the artifact rows
+        r.pop("buckets", None)
+        r.pop("last_error", None)
+    sat = rows[-1]
+    out = {
+        "metric": "serve_saturated_batch_fill_ratio",
+        "value": sat["batch_fill_ratio"],
+        "unit": f"real rows / padded bucket slots at saturating load "
+                f"(max_batch={max_batch}, target >= 0.8)",
+        "vs_baseline": round(sat["batch_fill_ratio"] / 0.8, 3),
+        "saturated_images_per_sec": sat["images_per_sec"],
+        "trickle_p99_ms": rows[0]["p99_ms"],
+        "trickle_p99_bound_ms": rows[0]["p99_bound_ms"],
+        "max_wait_ms": max_wait_ms,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"headline": out, "rows": rows}, f, indent=1)
+    print(json.dumps(out))
+    return {"headline": out, "rows": rows}
+
+
 def e2e_smoke() -> None:
     """Integrated proof on the REAL chip at tunnel-feasible scale: tar
     shards -> streaming source -> preprocessor -> ParallelTrainer rounds
@@ -740,6 +866,11 @@ def main() -> None:
                    "local vs gs:// vs s3:// fake stores; writes BENCH_CKPT")
     p.add_argument("--ckpt-mb", type=int, default=64,
                    help="state size in MB for --checkpoint-stall")
+    p.add_argument("--serve", action="store_true",
+                   help="dynamic-batching inference server: offered-load "
+                   "vs latency/throughput/batch-fill; writes BENCH_SERVE")
+    p.add_argument("--serve-secs", type=float, default=2.0,
+                   help="seconds per load level for --serve")
     p.add_argument("--featurize", action="store_true",
                    help="batched forward(blob_names=['fc7']) img/s on both "
                    "backends (the FeaturizerApp inference path)")
@@ -763,6 +894,9 @@ def main() -> None:
         e2e_smoke()
     elif args.checkpoint_stall:
         checkpoint_stall(mb=args.ckpt_mb)
+    elif args.serve:
+        serve_bench(duration_s=args.serve_secs,
+                    max_batch=args.batch or 8)
     elif args.featurize:
         featurize_bench(batch=args.batch or 64)
     elif args.graph:

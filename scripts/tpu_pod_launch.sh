@@ -69,6 +69,13 @@
 #                    (default 900) counts as having made progress: its
 #                    failure resets watch's consecutive-failure count
 #                    instead of accumulating across a multi-day run
+#   TPU_HEARTBEAT_FILE  remote path of the app's heartbeat JSON (set
+#                    RunConfig.heartbeat_path, or sparknet-serve
+#                    --heartbeat, to the same path): when a run fails on
+#                    a READY pod, watch fetches it from worker 0 and
+#                    reports step/status/staleness — "slow" (fresh beat,
+#                    status ok) vs "sick" (stale beat, or spike/
+#                    nonfinite/rollback status) without log parsing
 #   ALLOW_NO_NATIVE=1  continue setup if the C++ data plane fails to build
 #
 # Multi-host run path: `run` executes the SAME command on every worker
@@ -165,6 +172,22 @@ do_run() {
     "cd ~/sparknet_tpu_repo && $1"
 }
 
+report_heartbeat() {
+  # Best-effort "slow vs sick" probe: cat the app's heartbeat JSON from
+  # worker 0 (see TPU_HEARTBEAT_FILE above). Never fails the caller — a
+  # dead VM or a missing file just means no heartbeat to report.
+  [ -n "${TPU_HEARTBEAT_FILE:-}" ] || return 0
+  hb=$($TPU ssh "$NAME" --worker=0 --zone "$ZONE" --command \
+       "cat $TPU_HEARTBEAT_FILE 2>/dev/null" 2>/dev/null) || true
+  if [ -n "${hb:-}" ]; then
+    echo "watch: last heartbeat from worker 0: $hb" >&2
+    echo "watch: (stale t, or status spike/nonfinite/rollback => sick;" \
+         "fresh t + status ok => just slow)" >&2
+  else
+    echo "watch: no heartbeat readable at $TPU_HEARTBEAT_FILE" >&2
+  fi
+}
+
 del_tolerating_absence() { # $@ = delete command; NOT_FOUND is fine, any
   if out=$("$@" 2>&1); then return 0; fi     # other failure propagates —
   case "$out" in                             # "delete exited 0 but the
@@ -237,6 +260,7 @@ case "$CMD" in
       run_secs=$(( $(date +%s) - run_began ))
       s=$(vm_state)
       if [ "$s" = "READY" ]; then
+        report_heartbeat
         # a run that survived >= TPU_PROGRESS_SECS before dying made real
         # progress (checkpoint resume turns its re-run into a
         # continuation), so its failure doesn't count as a strike AT ALL

@@ -40,9 +40,16 @@ from ..utils import profiling
 from ..utils.config import RunConfig
 from ..utils.health import (HealthConfig, HealthMonitor, TrainingHealthError,
                             poison_batch)
+from ..utils.heartbeat import HeartbeatWriter
 from ..utils.logger import Logger, default_logger
 from ..utils.metrics import PhaseTimers, ThroughputMeter
 from .. import precision
+
+def _hb_float(v: float):
+    """Heartbeat-safe float: NaN/Inf -> None (RFC 8259, like the JSONL)."""
+    import math
+    return float(v) if math.isfinite(v) else None
+
 
 #: retried rounds sample a disjoint deterministic data window: round R on
 #: rollback generation g draws as logical round R + g * _RETRY_DATA_OFFSET
@@ -263,6 +270,24 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     # None = fully synchronous saves (cfg.checkpoint_async=False).
     ck_writer = (ckpt.AsyncCheckpointWriter()
                  if cfg.checkpoint_dir and cfg.checkpoint_async else None)
+    # liveness heartbeat (process 0 writes; the launcher's watch probes
+    # worker 0): one atomic JSON at the flush cadence — "slow vs sick"
+    # without log parsing. Every beat is best-effort: a full disk must
+    # degrade observability, not kill the run.
+    heartbeat = (HeartbeatWriter(cfg.heartbeat_path, role="train",
+                                 interval_s=cfg.heartbeat_every_s)
+                 if cfg.heartbeat_path and jax.process_index() == 0
+                 else None)
+
+    def beat(step: int, status: str, force: bool = False, **kv) -> None:
+        if heartbeat is None:
+            return
+        try:
+            heartbeat.beat(step, status=status, force=force,
+                           rollbacks=(monitor.rollbacks
+                                      if monitor is not None else 0), **kv)
+        except OSError as e:
+            warnings.warn(f"heartbeat write failed: {e}", RuntimeWarning)
 
     def ckpt_barrier() -> None:
         """Settle the store before READING it: drain the in-flight write
@@ -316,10 +341,28 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         loss_ = float(loss_)
         kv: Dict[str, Any] = {}
         gnorm = nonf = None
+        worker_txt = ""
         if health_ is not None:
             gnorm = float(health_["grad_norm"])
             nonf = float(health_["nonfinite"])
             kv["grad_norm"] = gnorm
+            by_worker = health_.get("nonfinite_by_worker")
+            if nonf and by_worker is not None:
+                # attribution: which data-parallel worker's shard tripped
+                # the flag — a consistently bad host/feed shows up as the
+                # same index round after round (the [n_data] vector rides
+                # the existing psum; see ParallelTrainer.last_health).
+                # An all-zero vector means the anomaly has no owner (only
+                # the post-average state is poisoned): flag, don't blame.
+                vec = np.asarray(by_worker)
+                if vec.max() > 0:
+                    worst = int(np.argmax(vec))
+                    kv["worst_worker"] = worst
+                    kv["nonfinite_workers"] = int((vec > 0).sum())
+                    worker_txt = (f"  worst worker: {worst} "
+                                  f"({int(vec[worst])} flag(s), "
+                                  f"{int((vec > 0).sum())}/{vec.size} "
+                                  f"workers)")
         cls = None
         if monitor is not None:
             cls = monitor.observe(rnd_, loss_, grad_norm=gnorm,
@@ -329,9 +372,12 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         probe_txt = (f"  probe: {float(probe_):.6f}"
                      if probe_ is not None else "")
         health_txt = f"  HEALTH: {cls}" if cls not in (None, "ok") else ""
-        log.log(f"round loss: {loss_:.4f}{probe_txt}{health_txt}", rnd_)
+        log.log(f"round loss: {loss_:.4f}{probe_txt}{health_txt}"
+                f"{worker_txt}", rnd_)
         log.metrics(rnd_, loss=loss_, images_per_sec_per_chip=round(
             meter.images_per_sec_per_chip(), 2), **kv)
+        beat(rnd_, status=cls or "ok", force=(cls not in (None, "ok")),
+             last_loss=_hb_float(loss_))
         if cls == "spike" and not monitor.rollback_needed:
             # every supervisor DECISION is an event record: this spike was
             # skipped (excluded from the stats window, training continues)
@@ -398,6 +444,7 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         log.event(ck_round, "rollback", reason=reason, target_step=target,
                   rollbacks=monitor.rollbacks, retry=retry,
                   lr_scale=round(lr_scale, 6))
+        beat(ck_round, status="rollback", force=True, reason=reason)
         return state, ck_round
 
     log_every = max(1, cfg.log_every)
@@ -542,6 +589,7 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         log.log(f"health summary: {monitor.counts['spike']} spikes, "
                 f"{monitor.counts['nonfinite']} nonfinite rounds, "
                 f"{monitor.rollbacks} rollbacks")
+    beat(rnd, status="done", force=True)
     log.log(f"done; phase means: {timers.summary()}")
     return state
 

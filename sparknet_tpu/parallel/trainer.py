@@ -125,7 +125,8 @@ class ParallelTrainer:
         # scalar collectives (for runs that disable the supervisor, e.g.
         # deliberate-divergence fixtures or wire-byte-pinned benchmarks)
         self.compute_health = bool(compute_health)
-        health_specs = ({"grad_norm": P(), "nonfinite": P()}
+        health_specs = ({"grad_norm": P(), "nonfinite": P(),
+                         "nonfinite_by_worker": P()}
                         if self.compute_health else {})
         self._round = jax.jit(
             shard_map(self._round_impl, mesh=mesh,
@@ -137,8 +138,15 @@ class ParallelTrainer:
         #: WORST-step squared grad norm (max-over-τ runs before the psum,
         #: so the wire cost is one scalar; can exceed the true per-step
         #: global norm by up to sqrt(n_data) when workers peak on
-        #: different steps), "nonfinite": count of data groups whose round
-        #: produced a NaN/Inf loss, param, or momentum}. None when
+        #: different steps), "nonfinite": count of data groups whose
+        #: PRE-AVERAGE local round state (τ losses, pre-pmean params,
+        #: momentum) went NaN/Inf — floored at 1.0 when only the
+        #: post-average params are poisoned (unattributable), "nonfinite_
+        #: by_worker": the [n_data] per-worker breakdown (the same psum
+        #: carries a one-hot vector instead of a scalar, so the wire cost
+        #: is n_data f32 — attribution of a consistently bad host/feed is
+        #: argmax of this vector, logged by the train loop on nonfinite
+        #: rounds; all-zero when the anomaly has no owner)}. None when
         #: compute_health=False. Kept OFF the train_round return so
         #: existing (state, loss) callers are untouched; the train loop
         #: reads them at its log_every flush — no extra per-round host
@@ -387,6 +395,10 @@ class ParallelTrainer:
             local_step, (params, SolverState(momentum=momentum, it=it)),
             (batches, step_rngs), unroll=scan_unroll(self.tau))
 
+        # pre-average view: after the pmean one poisoned worker's NaN is
+        # every worker's NaN, so ATTRIBUTION must read the worker-local
+        # state (τ-step losses, pre-average params, momentum) first
+        local_params = params
         if self.mode == "local_sgd":
             # THE sync: weight averaging as an in-pod allreduce OVER THE
             # DATA AXIS ONLY — under TP each model rank averages its own
@@ -406,17 +418,49 @@ class ParallelTrainer:
         health = {}
         if self.compute_health:
             grad_norm = jnp.sqrt(lax.psum(jnp.max(grad_sqs), DATA_AXIS))
-            finite = jnp.all(jnp.isfinite(losses))
-            for leaf in (jax.tree.leaves(params)
+            # per-worker attribution rides the SAME psum: each data group
+            # contributes a one-hot [n_data] row instead of a scalar, so
+            # one all-reduce yields both the breakdown (which worker's
+            # shard went nonfinite — a consistently bad host/feed shows
+            # up as a hot index) and, by summing, the scalar count. The
+            # flag is computed over the PRE-average local state (losses,
+            # pre-pmean params, worker-local momentum): post-average
+            # params are replica-identical, so they can flag a round but
+            # never localize it. Wire cost grows 4 B -> 4*n_data B,
+            # still noise next to the param all-reduce.
+            finite_local = jnp.all(jnp.isfinite(losses))
+            for leaf in (jax.tree.leaves(local_params)
                          + jax.tree.leaves(sstate.momentum)):
-                finite &= jnp.all(jnp.isfinite(leaf.astype(jnp.float32)))
-            nonfinite = lax.psum((~finite).astype(jnp.float32), DATA_AXIS)
+                finite_local &= jnp.all(
+                    jnp.isfinite(leaf.astype(jnp.float32)))
+            my_row = (jnp.arange(self.n_data)
+                      == lax.axis_index(DATA_AXIS)).astype(jnp.float32)
+            # post-average params stay the AUTHORITY for the scalar: a
+            # poisoned average over clean local state (an overflow born
+            # in the pmean itself) must still trip the supervisor, just
+            # without a worker index to blame. The flag rides the SAME
+            # psum as slot [n_data] (a separate scalar collective would
+            # both add an op to the pinned wire profile and — in
+            # sync_sgd, where no pmean touches the params — leave
+            # shard_map unable to infer its replication).
+            finite_avg = jnp.asarray(True)
+            for leaf in jax.tree.leaves(params):
+                finite_avg &= jnp.all(
+                    jnp.isfinite(leaf.astype(jnp.float32)))
+            summed = lax.psum(jnp.concatenate([
+                my_row * (~finite_local).astype(jnp.float32),
+                (~finite_avg).astype(jnp.float32)[None]]), DATA_AXIS)
+            by_worker = summed[:-1]
+            nonfinite = jnp.maximum(jnp.sum(by_worker),
+                                    jnp.minimum(summed[-1], 1.0))
             if self._tp_axis is not None:
                 # numerically (near-)no-ops — TP replicas compute identical
                 # flags; clears the model-axis vma so P() typechecks
                 grad_norm = lax.pmean(grad_norm, self._tp_axis)
                 nonfinite = lax.pmean(nonfinite, self._tp_axis)
-            health = {"grad_norm": grad_norm, "nonfinite": nonfinite}
+                by_worker = lax.pmean(by_worker, self._tp_axis)
+            health = {"grad_norm": grad_norm, "nonfinite": nonfinite,
+                      "nonfinite_by_worker": by_worker}
         if self._tp_axis is not None:
             # numerically a no-op (TP replicas compute identical losses);
             # clears the model-axis vma so the P() out_spec typechecks

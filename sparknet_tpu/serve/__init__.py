@@ -1,0 +1,27 @@
+"""`sparknet_tpu.serve` — online inference over trained checkpoints.
+
+The training side of this framework ends where SparkNet's did: a
+checkpoint. This package is the serving side — the Clipper-style
+(Crankshaw et al., NSDI 2017) adaptive-batching layer that turns those
+checkpoints into a servable artifact:
+
+  - `DynamicBatcher` (batcher.py): thread-safe request queue + the
+    max-batch / max-wait-deadline batching policy, futures per request.
+  - `ModelManager` (model_manager.py): NetInterface lifecycle — initial
+    load from zoo / prototxt / imported graph, checkpoint_dir watching
+    (local, gs://, s3://), digest-verified hot swap between batches with
+    canary + rollback.
+  - `InferenceServer` (server.py): the serving loop — bucket-padded jit
+    forwards, de-padding, metrics (queue depth, batch fill, latency
+    quantiles, img/s), /healthz-style HTTP status, heartbeat.
+  - `sparknet-serve` (app.py): the console entry point.
+"""
+from .batcher import DynamicBatcher, QueueFullError, ServeRequest
+from .model_manager import ModelManager, ServeModelError
+from .server import InferenceServer, ServeConfig, zeros_batch
+
+__all__ = [
+    "DynamicBatcher", "QueueFullError", "ServeRequest",
+    "ModelManager", "ServeModelError",
+    "InferenceServer", "ServeConfig", "zeros_batch",
+]

@@ -1,0 +1,137 @@
+"""`sparknet-serve` — the console entry point for the inference server.
+
+Builds a net (zoo name, .prototxt path, or an imported serialized graph —
+the same three model sources the training apps accept), optionally loads a
+weights file, starts the dynamic-batching server with checkpoint
+hot-reload, and serves until interrupted. `--demo N` instead self-drives N
+synthetic requests through the full submit->batch->forward->depad path and
+prints the status JSON — the zero-infrastructure smoke ("does this model
+serve?") and what the tests exercise.
+
+Examples:
+    sparknet-serve --model lenet --checkpoint-dir gs://bkt/run1/ck \
+        --outputs prob --max-batch 32 --max-wait-ms 5 --status-port 8080
+    sparknet-serve --model net.prototxt --weights w.caffemodel \
+        --crop 227 --demo 64
+    sparknet-serve --graph model.pb --weights w.npz --outputs fc7 --demo 8
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..net_api import JaxNet
+from ..utils.config import RunConfig
+from ..utils.logger import Logger, default_logger
+from .server import InferenceServer, ServeConfig, net_input_specs
+
+
+def build_net(model: Optional[str], graph: Optional[str],
+              weights: Optional[str], max_batch: int, n_classes: int,
+              crop: Optional[int]):
+    """The three model sources behind one NetInterface (mirrors
+    featurizer_app's split; zoo/prototxt resolution reuses the training
+    loop's resolver so the two cannot drift)."""
+    if graph:
+        from ..backend import GraphNet
+        from ..apps.graph_common import load_graph
+        net = GraphNet(load_graph(graph, None))
+        if weights:
+            from ..model.weights import WeightCollection
+            net.set_weights(WeightCollection.load(weights))
+        return net
+    from ..apps.train_loop import resolve_spec
+    cfg = RunConfig(model=model or "lenet", local_batch=max_batch,
+                    n_classes=n_classes, crop=crop)
+    net = JaxNet(resolve_spec(cfg))
+    if weights:
+        net.load_weights(weights)
+    return net
+
+
+def run_demo(server: InferenceServer, n: int, seed: int = 0) -> dict:
+    """Drive n synthetic requests (random pixels in the net's own input
+    schema) through the live server and return its status dict."""
+    r = np.random.default_rng(seed)
+    specs = net_input_specs(server.net)
+    name, (shape, dtype) = next(
+        (k, v) for k, v in specs.items()
+        if np.issubdtype(np.dtype(v[1]), np.floating))
+    futures = [server.submit(
+        {name: r.standard_normal(shape).astype(dtype)})
+        for _ in range(n)]
+    for f in futures:
+        f.result(timeout=60.0)
+    return server.status()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="lenet",
+                   help="zoo builder name or .prototxt path")
+    p.add_argument("--graph", help="serialized graph (.pb/.json) instead "
+                   "of --model")
+    p.add_argument("--weights", help="initial weights (.npz/.caffemodel)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="watch this train-checkpoint dir (local or "
+                   "gs://|s3://) and hot-swap verified new steps")
+    p.add_argument("--poll-interval", type=float, default=2.0,
+                   help="seconds between checkpoint-dir polls")
+    p.add_argument("--n-classes", type=int, default=10)
+    p.add_argument("--crop", type=int, default=None)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-wait-ms", type=float, default=5.0)
+    p.add_argument("--buckets", default=None,
+                   help="comma-separated batch buckets (default: powers "
+                   "of 2 up to max-batch)")
+    p.add_argument("--outputs", default=None,
+                   help="comma-separated blob names to return "
+                   "(default: the net's output schema)")
+    p.add_argument("--no-canary", action="store_true",
+                   help="skip the nonfinite canary forward on hot swaps")
+    p.add_argument("--status-port", type=int, default=None,
+                   help="serve /healthz and /metrics on this port "
+                   "(0 = ephemeral)")
+    p.add_argument("--heartbeat", default=None,
+                   help="write the utils/heartbeat.py liveness file here")
+    p.add_argument("--workdir", default=None,
+                   help="log/JSONL directory (default $SPARKNET_TPU_HOME)")
+    p.add_argument("--demo", type=int, default=None, metavar="N",
+                   help="self-drive N synthetic requests, print status "
+                   "JSON, exit (smoke mode)")
+    args = p.parse_args(argv)
+
+    log = default_logger(args.workdir, name="serving")
+    net = build_net(args.model, args.graph, args.weights, args.max_batch,
+                    args.n_classes, args.crop)
+    cfg = ServeConfig(
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        buckets=(tuple(int(b) for b in args.buckets.split(","))
+                 if args.buckets else None),
+        outputs=(tuple(args.outputs.split(",")) if args.outputs else None),
+        checkpoint_dir=args.checkpoint_dir,
+        poll_interval_s=args.poll_interval,
+        canary=not args.no_canary, status_port=args.status_port,
+        heartbeat_path=args.heartbeat)
+    server = InferenceServer(net, cfg, logger=log)
+    with server:
+        if args.demo is not None:
+            status = run_demo(server, args.demo)
+            print(json.dumps(status))
+            return
+        log.log("serving; Ctrl-C to stop")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            log.log("interrupted; draining")
+            print(json.dumps(server.status()), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""Dynamic batching: a thread-safe request queue + the batch-forming policy.
+
+The policy is the adaptive-batching core of Clipper (Crankshaw et al.,
+NSDI 2017): a batch closes when EITHER it reaches `max_batch` examples OR
+the OLDEST queued request has waited `max_wait_s` — so under saturating
+load batches run full (throughput mode: the jit forward amortizes over
+max_batch rows) and under trickle load no request waits longer than the
+deadline plus one forward (latency mode). The deadline is keyed on the
+oldest request, not the newest: a steady trickle cannot starve the head
+of the queue by perpetually resetting the timer.
+
+One consumer (the server's worker thread) calls `next_batch`; any number
+of producer threads call `submit` and block on the returned
+`concurrent.futures.Future`. Padding to shape buckets is the SERVER's
+concern — the batcher only promises len(batch) <= max_batch, so a batch
+never spans buckets.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class QueueFullError(RuntimeError):
+    """Backpressure signal: the request queue is at capacity. Callers
+    (an RPC frontend, a bench client) should shed or retry — unbounded
+    queueing would just convert overload into unbounded latency."""
+
+
+@dataclass
+class ServeRequest:
+    """One queued inference request: per-example input arrays (no batch
+    dim), the future its response lands on, and its enqueue time (the
+    latency clock starts at submit, not at batch formation)."""
+
+    payload: Dict[str, np.ndarray]
+    future: Future = field(default_factory=Future)
+    t_enqueue: float = field(default_factory=time.perf_counter)
+    id: int = 0
+
+
+class DynamicBatcher:
+    """Thread-safe queue + max-batch/max-wait batch former (one consumer)."""
+
+    def __init__(self, max_batch: int = 8, max_wait_s: float = 0.005,
+                 max_queue: int = 1024):
+        assert max_batch >= 1 and max_queue >= max_batch
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_s)
+        self.max_queue = int(max_queue)
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._ids = itertools.count()
+        self._closed = False
+
+    def depth(self) -> int:
+        return len(self._q)  # len(deque) is atomic; hot path, no lock
+
+    def submit(self, payload: Dict[str, Any]) -> Future:
+        """Enqueue one request; returns its response future. Raises
+        QueueFullError at capacity and RuntimeError after close()."""
+        req = ServeRequest(payload={k: np.asarray(v)
+                                    for k, v in payload.items()})
+        with self._nonempty:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if len(self._q) >= self.max_queue:
+                raise QueueFullError(
+                    f"request queue at capacity ({self.max_queue})")
+            req.id = next(self._ids)
+            self._q.append(req)
+            self._nonempty.notify()
+        return req.future
+
+    def next_batch(self, poll_s: float = 0.05
+                   ) -> Optional[List[ServeRequest]]:
+        """Form the next batch. Blocks up to `poll_s` for the FIRST
+        request (returning None on an idle tick — the server uses these
+        ticks for hot-reload polls and heartbeats), then holds the batch
+        open until max_batch is reached or the oldest request's deadline
+        (t_enqueue + max_wait_s) expires. Returns None after close()."""
+        with self._nonempty:
+            if not self._q:
+                self._nonempty.wait(timeout=poll_s)
+                if not self._q:
+                    return None
+            deadline = self._q[0].t_enqueue + self.max_wait_s
+            while len(self._q) < self.max_batch and not self._closed:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._nonempty.wait(timeout=remaining)
+            n = min(len(self._q), self.max_batch)
+            return [self._q.popleft() for _ in range(n)]
+
+    def close(self) -> None:
+        """Stop accepting requests and fail everything still queued (the
+        server drains in-flight batches separately; queued-but-unformed
+        requests must not hang their clients forever)."""
+        with self._nonempty:
+            self._closed = True
+            leftovers = list(self._q)
+            self._q.clear()
+            self._nonempty.notify_all()
+        for req in leftovers:
+            if not req.future.done():
+                req.future.set_exception(
+                    RuntimeError("server shut down before this request "
+                                 "was served"))

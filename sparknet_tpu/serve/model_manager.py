@@ -1,0 +1,243 @@
+"""Serving-side weight lifecycle: load, watch, verify, hot-swap, rollback.
+
+The manager owns the NetInterface's weights while the server owns its
+traffic. It watches a `checkpoint_dir` — the SAME store layer training
+writes through (`utils/checkpoint.py`: a local path or a gs://|s3://
+prefix), so a pod training into a bucket and a serving fleet reading from
+it need no extra copy step — and hot-swaps weights between batches:
+
+  - a new step is loaded through `restore_flat(step=...)`, which
+    re-verifies every per-array SHA-256 digest: a torn upload or a byte
+    flipped at rest is REJECTED (`CheckpointCorruptError`) and the server
+    keeps answering from the current weights; the bad step goes on a
+    cooldown so the poll loop doesn't re-download a corrupt 244 MB
+    snapshot every 2 seconds.
+  - the swap itself happens on the server's worker thread between
+    batches, so queued requests never race a half-installed weight set.
+  - after installing, an optional CANARY forward runs (zeros batch at
+    the smallest bucket): nonfinite outputs roll the swap back to the
+    previous weights — digests prove the bytes, the canary proves the
+    bytes still run (e.g. a checkpoint from a diverged run that saved
+    legal-but-poisoned values).
+  - transient store trouble (an outage mid-poll) is logged and retried;
+    it must degrade freshness, never availability.
+
+Weight-swap events reuse the training heartbeat schema
+(`utils/heartbeat.py`, role="serve"): step = served checkpoint step,
+rollbacks = rejected/rolled-back swaps, so the same probe that watches a
+training pod watches a serving process.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import checkpoint as ckpt
+from ..utils.heartbeat import HeartbeatWriter
+from ..utils.logger import Logger
+
+
+class ServeModelError(RuntimeError):
+    """A checkpoint cannot be served (missing/mis-shaped leaves, or a
+    tensor-parallel checkpoint whose column shards this single-net server
+    cannot reassemble)."""
+
+
+def params_from_checkpoint_flat(flat: Dict[str, np.ndarray],
+                                template: Dict[str, Dict[str, Any]]
+                                ) -> Dict[str, Dict[str, jnp.ndarray]]:
+    """Training-checkpoint flat keys -> a JaxNet params pytree.
+
+    Accepts both layouts the store holds: a full TrainState
+    (`params/<layer>/<param>` with the trainer's leading [n_devices]
+    replica axis — post-round replicas are identical, shard 0 is THE
+    value) and a bare params tree (`<layer>/<param>`, e.g. a checkpoint
+    of JaxNet.params). Momentum/it keys are ignored: serving wants
+    weights, not optimizer state. Missing or shape-mismatched leaves fail
+    loudly with the leaf path."""
+    out: Dict[str, Dict[str, jnp.ndarray]] = {}
+    for lname, lp in template.items():
+        out[lname] = {}
+        for pname, leaf in lp.items():
+            arr = None
+            for key in (f"params/{lname}/{pname}", f"{lname}/{pname}"):
+                if key in flat:
+                    arr = np.asarray(flat[key])
+                    break
+            if arr is None:
+                raise ServeModelError(
+                    f"checkpoint has no weights for {lname}/{pname}")
+            want = tuple(np.shape(leaf))
+            if tuple(arr.shape) != want:
+                if arr.ndim == len(want) + 1 and \
+                        tuple(arr.shape[1:]) == want:
+                    arr = arr[0]  # leading replica axis
+                else:
+                    raise ServeModelError(
+                        f"{lname}/{pname}: checkpoint shape {arr.shape} "
+                        f"!= net {want}")
+            # device-put ONCE here: leaving numpy in net.params would
+            # re-transfer the full weight set host->device on every
+            # forward (the jit argument path)
+            out[lname][pname] = jnp.asarray(arr)
+    return out
+
+
+class ModelManager:
+    """Owns weight load / watch / swap for one net (see module doc)."""
+
+    def __init__(self, net, checkpoint_dir: Optional[str] = None,
+                 poll_interval_s: float = 2.0,
+                 canary_batch: Optional[Dict[str, np.ndarray]] = None,
+                 canary_outputs: Optional[tuple] = None,
+                 logger: Optional[Logger] = None,
+                 heartbeat: Optional[HeartbeatWriter] = None,
+                 bad_step_retry_s: float = 30.0):
+        if checkpoint_dir and not hasattr(net, "params"):
+            raise ServeModelError(
+                "checkpoint hot-reload needs a layer-IR JaxNet (exposes "
+                ".params); serve a graph net from a weights file instead")
+        self.net = net
+        self.checkpoint_dir = checkpoint_dir
+        self.poll_interval_s = float(poll_interval_s)
+        self.canary_batch = canary_batch
+        self.canary_outputs = canary_outputs
+        self.log = logger
+        self.heartbeat = heartbeat
+        self.bad_step_retry_s = float(bad_step_retry_s)
+        self.step: Optional[int] = None   # served checkpoint step
+        self.swaps = 0                    # successful hot swaps
+        self.swap_failures = 0            # rejected or rolled-back swaps
+        self.last_error: Optional[str] = None
+        self._next_poll = 0.0
+        self._bad: Dict[int, float] = {}  # step -> retry-not-before time
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def load_initial(self) -> Optional[int]:
+        """Serve the newest VERIFIED checkpoint if the watched dir has one
+        (fresh-init weights otherwise — a server may come up before its
+        trainer's first save). Returns the loaded step or None."""
+        if not self.checkpoint_dir:
+            return None
+        found = ckpt.restore_newest_verified(self.checkpoint_dir)
+        if found is None:
+            self._log("serve: no verified checkpoint under "
+                      f"{self.checkpoint_dir!r} yet — serving initial "
+                      f"weights")
+            return None
+        flat, step, extra = found
+        self._install(flat, step, extra, initial=True)
+        return self.step
+
+    def poll(self, now: Optional[float] = None) -> bool:
+        """Time-gated reload check (the server calls this every idle tick
+        and between batches; actual store traffic happens at most once per
+        poll_interval_s). Returns True when a swap was installed."""
+        if not self.checkpoint_dir:
+            return False
+        now = time.monotonic() if now is None else now
+        if now < self._next_poll:
+            return False
+        self._next_poll = now + self.poll_interval_s
+        try:
+            latest = ckpt.latest_step(self.checkpoint_dir)
+        except Exception as e:
+            # store outage: freshness degrades, serving does not
+            self.last_error = f"poll: {e}"
+            self._log(f"serve: checkpoint poll failed ({e}); retrying")
+            return False
+        if latest is None or latest == self.step:
+            return False
+        if now < self._bad.get(latest, 0.0):
+            return False  # known-bad step, still cooling down
+        return self._try_swap(latest)
+
+    # -- swap machinery ------------------------------------------------------
+
+    def _try_swap(self, step: int) -> bool:
+        try:
+            # full integrity path: every digest is recomputed over the
+            # fetched bytes (restore IS the verification — one read)
+            flat, got, extra = ckpt.restore_flat(self.checkpoint_dir,
+                                                 step=step)
+        except ckpt.CheckpointCorruptError as e:
+            self._reject(step, f"corrupt: {e}")
+            return False
+        except Exception as e:
+            self.last_error = f"load step {step}: {e}"
+            self._log(f"serve: could not fetch step {step} ({e}); "
+                      f"will retry")
+            return False
+        return self._install(flat, got, extra)
+
+    def _install(self, flat: Dict[str, np.ndarray], step: int,
+                 extra: Dict[str, Any], initial: bool = False) -> bool:
+        if int(extra.get("tp", 1)) != 1:
+            self._reject(step, f"tensor-parallel checkpoint (tp="
+                               f"{extra.get('tp')}) — column shards "
+                               f"cannot be served by a single net")
+            return False
+        old_params = self.net.params
+        try:
+            self.net.params = params_from_checkpoint_flat(
+                flat, self.net.params)
+        except ServeModelError as e:
+            self._reject(step, str(e))
+            return False
+        try:
+            canary_ok = self._canary_ok()
+        except Exception as e:
+            # a canary that CRASHES (not just goes nonfinite) must also
+            # roll back — leaving unvetted weights installed because the
+            # vet itself failed would be strictly worse than a clean no
+            canary_ok = False
+            self._log(f"serve: canary forward raised: {e}")
+        if not canary_ok:
+            # digests matched but the forward is poisoned (a checkpoint
+            # saved mid-divergence): roll back to the weights that were
+            # answering traffic a moment ago
+            self.net.params = old_params
+            self._reject(step, "canary forward failed (nonfinite "
+                               "outputs or crash) — swap rolled back")
+            return False
+        self.step = step
+        if not initial:
+            self.swaps += 1
+        self.last_error = None
+        self._log(f"serve: weights {'loaded' if initial else 'hot-swapped'}"
+                  f" from checkpoint step {step}")
+        self._beat(step, "ok")
+        return True
+
+    def _canary_ok(self) -> bool:
+        if self.canary_batch is None:
+            return True
+        out = self.net.forward(self.canary_batch,
+                               blob_names=list(self.canary_outputs or ()))
+        return all(np.isfinite(np.asarray(v)).all() for v in out.values())
+
+    def _reject(self, step: int, why: str) -> None:
+        self.swap_failures += 1
+        self.last_error = f"step {step}: {why}"
+        self._bad[step] = time.monotonic() + self.bad_step_retry_s
+        self._log(f"serve: REJECTED checkpoint step {step}: {why} — "
+                  f"continuing on step {self.step}")
+        self._beat(self.step or 0, "degraded")
+
+    def _beat(self, step: int, status: str) -> None:
+        if self.heartbeat is None:
+            return
+        try:
+            self.heartbeat.beat(step, status=status,
+                                rollbacks=self.swap_failures, force=True,
+                                swaps=self.swaps)
+        except OSError as e:  # observability must not take serving down
+            self._log(f"serve: heartbeat write failed: {e}")
+
+    def _log(self, msg: str) -> None:
+        if self.log is not None:
+            self.log.log(msg)

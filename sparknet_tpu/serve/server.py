@@ -1,0 +1,387 @@
+"""The serving loop: bucket-padded jit forwards over dynamically formed
+batches, hot-reload between batches, metrics + /healthz.
+
+Shape buckets: requests are padded to the smallest configured bucket size
+>= the formed batch (default: powers of two up to max_batch), so the jit
+cache holds exactly len(buckets) compiled forwards — an arbitrary batch
+size would compile a fresh XLA program per distinct size and the server
+would spend its first hour tracing. Padding rows are zeros; de-padding
+slices each request's own row back out. Within one compiled bucket the
+padding is bitwise-lossless for these nets (every layer is row-independent
+across the batch — conv/fc/relu/pool/lrn/softmax; tests pin this).
+Across DIFFERENT buckets XLA may re-associate reductions, so outputs are
+allclose-but-not-bitwise between e.g. the 1-bucket and 8-bucket of the
+same example — same contract training accepts for different batch shapes.
+
+One worker thread owns the net: batch forwards, weight swaps (between
+batches, via ModelManager), and the canary all run on it, so no lock
+guards the params. Request futures are resolved from the same thread;
+client threads only enqueue and wait.
+
+Requests are dicts of PER-EXAMPLE arrays (no batch dim). Missing net
+inputs are zero-filled (nets from the zoo carry label-consuming loss/
+accuracy heads; an inference client has no labels). An optional
+`ImagePreprocessor` decodes raw request pixels batch-at-a-time with
+`train=False` (deterministic center crop + mean subtract — the same
+`data/preprocess.py` path eval uses, so served pixels match eval pixels).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.heartbeat import HeartbeatWriter
+from ..utils.logger import Logger
+from ..utils.metrics import FillMeter, LatencyStats
+from .batcher import DynamicBatcher, ServeRequest
+from .model_manager import ModelManager
+
+
+def net_input_specs(net) -> Dict[str, Tuple[Tuple[int, ...], str]]:
+    """{input name: (per-example device-layout shape, dtype)} for either
+    backend (JaxNet wraps a CompiledNet; GraphNet exposes introspection
+    methods — the NetInterface split featurizer_app already bridges)."""
+    if hasattr(net, "net"):  # JaxNet
+        dtypes = {i.name: i.dtype for i in net.net.spec.inputs}
+        return {name: (tuple(shape[1:]), dtypes.get(name, "float32"))
+                for name, shape in net.net.input_shapes.items()}
+    shapes, dtypes = net.input_shapes(), net.input_dtypes()
+    return {name: (tuple(shapes[name][1:]),
+                   dtypes.get(name, "float32")) for name in shapes}
+
+
+def zeros_batch(net, n: int) -> Dict[str, np.ndarray]:
+    """An all-zeros batch of n examples in the net's input schema — the
+    canary forward's food, and the source of padding for absent inputs."""
+    return {name: np.zeros((n,) + shape, dtype=np.dtype(dtype))
+            for name, (shape, dtype) in net_input_specs(net).items()}
+
+
+def default_buckets(max_batch: int) -> Tuple[int, ...]:
+    """Powers of two up to max_batch (max_batch itself always included)."""
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(int(max_batch))
+    return tuple(out)
+
+
+@dataclass
+class ServeConfig:
+    """Knobs for the inference server (the `sparknet-serve` CLI mirrors
+    these 1:1)."""
+
+    # batching policy
+    max_batch: int = 8
+    max_wait_ms: float = 5.0            # oldest-request deadline
+    buckets: Optional[Tuple[int, ...]] = None  # None -> powers of 2
+    max_queue: int = 1024               # backpressure threshold
+    # response content: blob names to return (None -> the net's output
+    # schema, e.g. prob/accuracy/loss for zoo nets — pass ("prob",) to
+    # skip the label-dependent heads)
+    outputs: Optional[Tuple[str, ...]] = None
+    # checkpoint hot-reload
+    checkpoint_dir: Optional[str] = None
+    poll_interval_s: float = 2.0
+    canary: bool = True                 # nonfinite-canary gate on swaps
+    # observability
+    status_port: Optional[int] = None   # None = no HTTP; 0 = ephemeral
+    heartbeat_path: Optional[str] = None
+    heartbeat_every_s: float = 10.0
+    metrics_every_batches: int = 50     # JSONL cadence (0 = off)
+    idle_poll_s: float = 0.05           # worker tick when the queue is idle
+
+
+class InferenceServer:
+    """Dynamic-batching inference over one NetInterface net (module doc)."""
+
+    def __init__(self, net, cfg: Optional[ServeConfig] = None,
+                 preprocessor=None, logger: Optional[Logger] = None):
+        self.net = net
+        self.cfg = cfg = cfg if cfg is not None else ServeConfig()
+        self.preprocessor = preprocessor
+        self.log = logger
+        self.buckets = tuple(sorted(cfg.buckets or
+                                    default_buckets(cfg.max_batch)))
+        assert self.buckets[-1] >= cfg.max_batch, (
+            f"largest bucket {self.buckets[-1]} < max_batch "
+            f"{cfg.max_batch}: a full batch would have no bucket")
+        self.batcher = DynamicBatcher(cfg.max_batch,
+                                      max_wait_s=cfg.max_wait_ms / 1e3,
+                                      max_queue=cfg.max_queue)
+        hb = (HeartbeatWriter(cfg.heartbeat_path, role="serve",
+                              interval_s=cfg.heartbeat_every_s)
+              if cfg.heartbeat_path else None)
+        self.heartbeat = hb
+        self.manager = ModelManager(
+            net, checkpoint_dir=cfg.checkpoint_dir,
+            poll_interval_s=cfg.poll_interval_s,
+            canary_batch=(zeros_batch(net, self.buckets[0])
+                          if cfg.canary else None),
+            canary_outputs=cfg.outputs, logger=logger, heartbeat=hb)
+        # metrics (worker-thread-written; readers accept slight skew)
+        self.latency = LatencyStats()
+        self.fill = FillMeter()
+        self.requests_ok = 0
+        self.requests_failed = 0
+        self.batch_log: List[Tuple[int, int]] = []  # (n_real, bucket)
+        self._t0 = time.time()
+        self._images = 0
+        self._worker: Optional[threading.Thread] = None
+        self._http = None
+        self._running = False
+        self._last_tick = 0.0
+
+    # -- client API ----------------------------------------------------------
+
+    def submit(self, payload: Dict[str, Any]):
+        """Enqueue one example (dict of per-example arrays); returns a
+        Future resolving to {blob name: per-example array}."""
+        return self.batcher.submit(payload)
+
+    def infer(self, payload: Dict[str, Any], timeout: float = 30.0
+              ) -> Dict[str, np.ndarray]:
+        """Synchronous convenience wrapper over submit()."""
+        return self.submit(payload).result(timeout=timeout)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "InferenceServer":
+        assert self._worker is None, "already started"
+        self.manager.load_initial()
+        self._running = True
+        self._worker = threading.Thread(target=self._run,
+                                        name="serve-worker", daemon=True)
+        self._worker.start()
+        if self.cfg.status_port is not None:
+            self._start_http(self.cfg.status_port)
+        return self
+
+    def stop(self, drain_s: float = 5.0) -> None:
+        """Stop accepting work, serve what's already queued (bounded by
+        drain_s), then stop the worker."""
+        deadline = time.monotonic() + drain_s
+        while self.batcher.depth() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        self._running = False
+        self.batcher.close()
+        if self._worker is not None:
+            self._worker.join(timeout=max(drain_s, 1.0))
+            self._worker = None
+        if self._http is not None:
+            self._http.shutdown()
+            self._http = None
+        if self.heartbeat is not None:
+            try:
+                self.heartbeat.beat(self.manager.step or 0, status="done",
+                                    rollbacks=self.manager.swap_failures,
+                                    force=True)
+            except OSError:
+                pass
+
+    def __enter__(self) -> "InferenceServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- status --------------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        """The /metrics JSON: serving vitals in one flat dict."""
+        dt = max(time.time() - self._t0, 1e-9)
+        m = self.manager
+        out = {
+            "role": "serve",
+            "uptime_s": round(dt, 1),
+            "queue_depth": self.batcher.depth(),
+            "requests_ok": self.requests_ok,
+            "requests_failed": self.requests_failed,
+            "images_per_sec": round(self._images / dt, 2),
+            "batches": self.fill.batches,
+            "batch_fill_ratio": round(self.fill.ratio(), 4),
+            "buckets": list(self.buckets),
+            "model_step": m.step,
+            "swaps": m.swaps,
+            "swap_failures": m.swap_failures,
+            "last_error": m.last_error,
+        }
+        out.update(self.latency.summary())
+        return out
+
+    def reset_counters(self) -> None:
+        """Zero the windowed serving metrics (latency, fill, throughput
+        clock) — between load levels in a bench, or after warmup. Model/
+        request totals (swaps, requests_ok) are lifetime counters and
+        keep counting."""
+        self.latency.reset()
+        self.fill.reset()
+        self._images = 0
+        self._t0 = time.time()
+
+    def healthy(self) -> bool:
+        """Liveness: the worker thread exists and ticked recently (a wedged
+        forward or a dead thread must flip /healthz to 503, not hang it)."""
+        alive = self._worker is not None and self._worker.is_alive()
+        fresh = (time.monotonic() - self._last_tick) < max(
+            10 * self.cfg.idle_poll_s, 2.0)
+        return alive and fresh
+
+    # -- worker loop ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while self._running:
+            self._last_tick = time.monotonic()
+            reqs = self.batcher.next_batch(poll_s=self.cfg.idle_poll_s)
+            if reqs:
+                # a formed batch has already waited out its deadline:
+                # serve it FIRST — a multi-second checkpoint download
+                # must never sit between batch formation and its forward
+                self._serve_batch(reqs)
+            # hot-reload + heartbeat ride the gaps AFTER serving / on
+            # idle ticks: a swap never interleaves with a forward
+            # (single worker thread), and NOTHING the poll raises may
+            # kill this thread — a dead worker strands every queued
+            # future while submit() keeps accepting work
+            try:
+                self.manager.poll()
+            except Exception as e:
+                self.manager.last_error = f"poll: {e}"
+                self._log(f"serve: reload poll crashed ({e}); serving "
+                          f"continues on step {self.manager.step}")
+            self._beat()
+
+    def _beat(self) -> None:
+        if self.heartbeat is None:
+            return
+        try:
+            self.heartbeat.beat(
+                self.manager.step or 0,
+                status="degraded" if self.manager.last_error else "ok",
+                rollbacks=self.manager.swap_failures,
+                queue_depth=self.batcher.depth(),
+                batch_fill=round(self.fill.ratio(), 4))
+        except OSError:
+            pass  # observability must not take serving down
+
+    def _serve_batch(self, reqs: List[ServeRequest]) -> None:
+        # heterogeneous traffic: group by input signature so one
+        # mis-shaped request fails ITS group, not the whole batch (and
+        # stacked arrays are always rectangular)
+        groups: Dict[tuple, List[ServeRequest]] = {}
+        for r in reqs:
+            sig = tuple(sorted((k, v.shape, str(v.dtype))
+                               for k, v in r.payload.items()))
+            groups.setdefault(sig, []).append(r)
+        for group in groups.values():
+            self._forward_group(group)
+
+    def _forward_group(self, reqs: List[ServeRequest]) -> None:
+        n = len(reqs)
+        bucket = next(b for b in self.buckets if b >= n)
+        try:
+            batch = {k: np.stack([r.payload[k] for r in reqs])
+                     for k in reqs[0].payload}
+            if self.preprocessor is not None:
+                # batch-level decode, eval semantics: center crop + mean
+                # subtract are deterministic, so per-request and batched
+                # decode agree (the parity test's precondition)
+                batch = self.preprocessor.convert_batch(batch, train=False)
+            full = zeros_batch(self.net, bucket)
+            for k, v in batch.items():
+                if k not in full:
+                    raise ValueError(
+                        f"request field {k!r} is not a net input "
+                        f"(net has {sorted(full)})")
+                pad = np.zeros((bucket - n,) + v.shape[1:], v.dtype)
+                full[k] = np.concatenate([v, pad]) if bucket > n else v
+            out = self.net.forward(
+                full, blob_names=list(self.cfg.outputs or ()))
+            # de-pad: slice each request's own row out of per-row blobs;
+            # batch-AGGREGATE blobs (the zoo heads' scalar loss/accuracy
+            # — averaged over padding, meaningless per request) are
+            # dropped unless cfg.outputs names them explicitly
+            want = set(self.cfg.outputs) if self.cfg.outputs else None
+            fields = [(k, v, getattr(v, "ndim", 0) >= 1
+                       and v.shape[0] == bucket)
+                      for k, v in out.items()
+                      if want is None or k in want]
+            if want is None:
+                fields = [f for f in fields if f[2]]
+            now = time.perf_counter()
+            for i, r in enumerate(reqs):
+                r.future.set_result({k: (v[i] if per_row else v)
+                                     for k, v, per_row in fields})
+                self.latency.add(now - r.t_enqueue)
+            self.requests_ok += n
+        except Exception as e:
+            for r in reqs:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            self.requests_failed += n
+            self._log(f"serve: batch of {n} failed: {e}")
+        self._images += n
+        self.fill.add(n, bucket)
+        self.batch_log.append((n, bucket))
+        if len(self.batch_log) > 10000:
+            del self.batch_log[:5000]
+        if self.cfg.metrics_every_batches and self.log is not None and \
+                self.fill.batches % self.cfg.metrics_every_batches == 0:
+            self.log.metrics(self.fill.batches, **{
+                k: v for k, v in self.status().items()
+                if isinstance(v, (int, float)) and v is not None})
+
+    def _log(self, msg: str) -> None:
+        if self.log is not None:
+            self.log.log(msg)
+
+    # -- /healthz HTTP -------------------------------------------------------
+
+    @property
+    def status_address(self) -> Optional[Tuple[str, int]]:
+        """(host, port) of the status HTTP server, once started."""
+        return None if self._http is None else self._http.server_address
+
+    def _start_http(self, port: int) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib casing)
+                if self.path.startswith("/healthz"):
+                    ok = server.healthy()
+                    body = json.dumps(
+                        {"status": "ok" if ok else "unhealthy",
+                         "model_step": server.manager.step,
+                         "queue_depth": server.batcher.depth()})
+                    self._reply(200 if ok else 503, body)
+                elif self.path.startswith("/metrics"):
+                    self._reply(200, json.dumps(server.status()))
+                else:
+                    self._reply(404, '{"error": "not found"}')
+
+            def _reply(self, code: int, body: str) -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):  # quiet: the JSONL is the record
+                pass
+
+        self._http = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._http.daemon_threads = True
+        threading.Thread(target=self._http.serve_forever,
+                         name="serve-status", daemon=True).start()
+        self._log(f"serve: status at http://127.0.0.1:"
+                  f"{self._http.server_address[1]}/healthz")

@@ -122,18 +122,91 @@ def _bucket_ops(path: str) -> SimpleNamespace:
         return SimpleNamespace(
             read=m.gs_read, open_stream=m.gs_open_stream,
             write=m.gs_write, write_large=m.gs_write_large,
-            delete=m.gs_delete, list_urls=m.gs_list_urls)
+            delete=m.gs_delete, list_urls=m.gs_list_urls,
+            stat=m.gs_stat)
     from ..data import s3 as m
     return SimpleNamespace(
         read=m.s3_read, open_stream=m.s3_open_stream,
         write=m.s3_write, write_large=m.s3_write_large,
-        delete=m.s3_delete, list_urls=m.s3_list_urls)
+        delete=m.s3_delete, list_urls=m.s3_list_urls,
+        stat=m.s3_stat)
 
 
 def _join(directory: str, *names: str) -> str:
     if is_bucket_path(directory):
         return "/".join((directory.rstrip("/"),) + names)
     return os.path.join(directory, *names)
+
+
+# -- process-local "last step I wrote and verified" cache --------------------
+#
+# retain()'s protect scan re-verifies the newest checkpoint from the store
+# on EVERY save — on a bucket that is a full ranged-GET + re-hash of
+# state.npz (~244 MB for CaffeNet+momentum) per save. But in the common
+# case the step under scan is the one THIS process just wrote: its digests
+# were computed from the exact bytes handed to the store, and both store
+# kinds commit those bytes all-or-nothing (local tmp-dir rename; bucket
+# resumable/multipart finalize). The cache records that step together with
+# a store FINGERPRINT of state.npz captured right after the write — local
+# (size, mtime_ns), bucket (size, generation|ETag) — and retain() accepts
+# the cached step as verified only while the fingerprint still matches, so
+# anything that REWRITES the object (another process, a test mutating
+# bytes, an overwrite-save) changes the fingerprint and falls back to the
+# full read-back verify. What the cache deliberately trades away is
+# detection of in-place at-rest corruption of our OWN last write during
+# its keep-window (a flipped byte that updates neither mtime_ns nor
+# generation); steps written by other processes keep the full at-rest
+# guarantee, and every restore/rollback path still verifies for real.
+_written_verified: Dict[str, Tuple[int, Tuple]] = {}
+
+
+def _cache_key(directory: str) -> str:
+    return (directory.rstrip("/") if is_bucket_path(directory)
+            else os.path.abspath(directory))
+
+
+def _state_fingerprint(directory: str, step: int) -> Optional[Tuple]:
+    """Freshness token of step-N/state.npz: ("local", size, mtime_ns) or
+    ("bucket", size, generation|ETag). None when unreadable — the caller
+    treats that as a cache miss, never as verified."""
+    url = _join(directory, f"step-{int(step)}", "state.npz")
+    try:
+        if is_bucket_path(directory):
+            size, gen = _bucket_ops(directory).stat(url, fresh=True)
+            return ("bucket", int(size), gen)
+        st = os.stat(url)
+        return ("local", st.st_size, st.st_mtime_ns)
+    except Exception:
+        return None
+
+
+def _record_written(directory: str, step: int) -> None:
+    fp = _state_fingerprint(directory, step)
+    key = _cache_key(directory)
+    if fp is None:
+        _written_verified.pop(key, None)
+    else:
+        _written_verified[key] = (int(step), fp)
+
+
+def _written_verified_hit(directory: str, step: int) -> bool:
+    """True when `step` is the one this process last wrote here AND its
+    stored state.npz still carries the fingerprint captured at write time
+    (nobody rewrote it since)."""
+    cached = _written_verified.get(_cache_key(directory))
+    if cached is None or cached[0] != int(step):
+        return False
+    return _state_fingerprint(directory, step) == cached[1]
+
+
+def invalidate_written_cache(directory: Optional[str] = None) -> None:
+    """Drop the process-local written-and-verified record (all directories,
+    or one) — forces retain() back to full store read-back verification.
+    For tests and for callers that hand the directory to another writer."""
+    if directory is None:
+        _written_verified.clear()
+    else:
+        _written_verified.pop(_cache_key(directory), None)
 
 
 def _bucket_step_files(directory: str) -> Dict[int, set]:
@@ -234,6 +307,7 @@ def save(directory: str, tree: Any, *, step: int,
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
+    _record_written(directory, step)
     return final
 
 
@@ -272,6 +346,7 @@ def _save_bucket(directory: str, tree: Any, *, step: int,
     # serialized archive next to the flat arrays on the writer thread
     ops.write_large(f"{final}/state.npz", buf.getbuffer())
     ops.write(f"{final}/meta.json", json.dumps(meta).encode())
+    _record_written(directory, step)
     return final
 
 
@@ -508,19 +583,23 @@ def retain(directory: str, keep: int = 3) -> None:
     one that verifies, NOR the newest verified NON-anomalous one: when
     newer checkpoints are corrupt, or a long unhealthy window has tagged
     every recent save `anomalous`, retention must not destroy the only
-    state a resume/rollback can still use. (The protection re-verifies
-    from the store — one extra read+hash of the newest snapshot per save;
-    on a bucket that read is a full ranged-GET of state.npz, which is one
-    more reason the train loop runs retention on the stage-2 BACKGROUND
-    thread. The integrity guarantee is worth the checkpoint-phase I/O.)"""
+    state a resume/rollback can still use. The protection re-verifies from
+    the store — one extra read+hash of the newest snapshot per save, a
+    full ranged-GET of state.npz on a bucket — EXCEPT in the common case
+    where the step under scan is the one this process just wrote and its
+    store fingerprint is unchanged (`_written_verified` above): then the
+    write-time digests stand in for the read-back and the scan costs one
+    stat. Steps written by other processes always get the full read-back
+    verification."""
     steps = _list_steps(directory)
     if not steps:
         return
     protect = set(steps[-keep:]) if keep else set()
     # one newest-first scan finds both targets (in the common case — the
     # newest checkpoint verifies and is non-anomalous — exactly one
-    # verification runs): the newest verified step, and the newest
-    # verified NON-anomalous one (the rollback selector's candidate)
+    # verification runs, and the written-cache reduces even that to a
+    # stat): the newest verified step, and the newest verified
+    # NON-anomalous one (the rollback selector's candidate)
     newest_verified = None
     for s in reversed(steps):
         path = _join(directory, f"step-{s}")
@@ -530,7 +609,7 @@ def retain(directory: str, keep: int = 3) -> None:
         anomalous = bool(meta.get("extra", {}).get("anomalous"))
         if newest_verified is not None and anomalous:
             continue  # only the non-anomalous target is still open
-        if verify(path):
+        if _written_verified_hit(directory, s) or verify(path):
             if newest_verified is None:
                 newest_verified = s
                 protect.add(s)

@@ -60,6 +60,15 @@ class RunConfig:
     # skip / rollback-to-verified-checkpoint / LR-backoff recovery, and the
     # deterministic fault-injection hooks (utils/health.py)
     health: HealthConfig = field(default_factory=HealthConfig)
+    # liveness heartbeat: when set, the loop atomically rewrites this JSON
+    # file (utils/heartbeat.py schema: t/step/status/rollbacks) at the
+    # log_every flush cadence — `tpu_pod_launch.sh watch` (with
+    # TPU_HEARTBEAT_FILE pointed here) distinguishes "slow" (fresh beat,
+    # status ok) from "sick" (stale beat, or spike/nonfinite/rollback
+    # status) without parsing logs. The serve subsystem writes the same
+    # schema with role="serve".
+    heartbeat_path: Optional[str] = None
+    heartbeat_every_s: float = 10.0
     # logging. None -> $SPARKNET_TPU_HOME, else "." (the reference logged
     # to $SPARKNET_HOME/training_log_<ms>.txt); tests set the env var to a
     # tmp dir so stray default-config runs never litter the repo root

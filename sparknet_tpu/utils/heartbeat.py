@@ -1,0 +1,98 @@
+"""Liveness heartbeat: one small JSON file, atomically replaced in place.
+
+`tpu_pod_launch.sh watch` can only see process exit codes and VM states, so
+a run that is alive-but-sick (every round classified anomalous, rollback
+budget draining) and a run that is merely slow (long rounds, healthy
+classifications) look identical until the log is parsed. The heartbeat file
+is the machine-readable middle ground: the training loop rewrites it at the
+log_every cadence with the HealthMonitor's latest view, and the serving
+model manager rewrites it with the hot-reload state, BOTH in the same
+schema, so one probe (`read_heartbeat` here, or `TPU_HEARTBEAT_FILE` in the
+launcher's watch loop) answers "is it making healthy progress" for either
+role without touching the logs.
+
+Schema (one flat JSON object):
+  t               epoch seconds of the beat (staleness = now - t)
+  pid, role       writer identity; role is "train" or "serve"
+  step            round index (train) / served checkpoint step (serve)
+  status          "ok", or the latest anomaly classification ("spike",
+                  "nonfinite", "rollback"), or a serve state ("degraded"
+                  when the last swap attempt failed, "done" on exit)
+  rollbacks       health rollbacks so far (train) / rejected or rolled-back
+                  weight swaps (serve)
+  ...             writer-specific extras (e.g. last_loss, queue_depth)
+
+Writes are atomic (tmp file + os.replace in the same directory) so a
+reader never sees a torn JSON, and throttled to `interval_s` except when
+`force=True` (status CHANGES always deserve a beat — the whole point is
+that "sick" shows up promptly).
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+
+class HeartbeatWriter:
+    """Throttled atomic writer of the heartbeat schema above."""
+
+    def __init__(self, path: str, role: str = "train",
+                 interval_s: float = 10.0):
+        self.path = path
+        self.role = role
+        self.interval_s = float(interval_s)
+        self._last_t = 0.0
+        self._last_status: Optional[str] = None
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+
+    def beat(self, step: int, status: str = "ok", rollbacks: int = 0,
+             force: bool = False, **extra: Any) -> bool:
+        """Write one heartbeat; returns True when a write happened.
+        Throttled to `interval_s` unless `force` or the status changed
+        since the last write."""
+        now = time.time()
+        if (not force and status == self._last_status
+                and now - self._last_t < self.interval_s):
+            return False
+        rec: Dict[str, Any] = {"t": round(now, 3), "pid": os.getpid(),
+                               "role": self.role, "step": int(step),
+                               "status": str(status),
+                               "rollbacks": int(rollbacks)}
+        rec.update(extra)
+        d = os.path.dirname(os.path.abspath(self.path))
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".hb-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(rec, f)
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self._last_t = now
+        self._last_status = status
+        return True
+
+
+def read_heartbeat(path: str) -> Optional[Dict[str, Any]]:
+    """The current heartbeat dict, or None when the file is missing or
+    torn (a torn read is impossible from HeartbeatWriter's atomic replace,
+    but a foreign/partial file must not crash the prober)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def staleness_s(hb: Optional[Dict[str, Any]]) -> Optional[float]:
+    """Seconds since the beat was written, or None without a valid beat."""
+    if not hb or "t" not in hb:
+        return None
+    return max(0.0, time.time() - float(hb["t"]))

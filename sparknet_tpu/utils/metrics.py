@@ -3,11 +3,15 @@
 Replaces the reference's ad-hoc stdout spans (`transformInto took ...`,
 `ForwardBackward took ...` at `libs/CaffeNet.scala:113-120`; `stuff took /
 iters took` in the apps) with named accumulating timers and a throughput
-meter (images/sec/chip — the BASELINE.md headline unit).
+meter (images/sec/chip — the BASELINE.md headline unit). `LatencyStats` and
+`FillMeter` are the serving side's additions: request-latency quantiles and
+the dynamic batcher's fill ratio (sparknet_tpu/serve surfaces both through
+its /metrics status and the metrics JSONL).
 """
 from __future__ import annotations
 
 import time
+from collections import deque
 from contextlib import contextmanager
 from typing import Dict, Optional
 
@@ -61,3 +65,63 @@ class ThroughputMeter:
     def reset(self) -> None:
         self.images = 0
         self.seconds = 0.0
+
+
+class LatencyStats:
+    """Sliding-window latency quantiles (p50/p99) over the last `window`
+    observations. A bounded deque, not a histogram: serving windows are a
+    few thousand requests, where exact order statistics are cheaper than
+    tuning bucket boundaries, and the window naturally ages out a warmup
+    or a transient stall instead of averaging it into eternity."""
+
+    def __init__(self, window: int = 4096):
+        self._obs: deque = deque(maxlen=max(2, window))
+        self.count = 0
+
+    def add(self, seconds: float) -> None:
+        self._obs.append(float(seconds))
+        self.count += 1
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Exact order statistic over the window (nearest-rank), or None
+        with no observations."""
+        if not self._obs:
+            return None
+        xs = sorted(self._obs)
+        i = min(len(xs) - 1, max(0, int(q * len(xs))))
+        return xs[i]
+
+    def summary(self) -> Dict[str, Optional[float]]:
+        out: Dict[str, Optional[float]] = {"n": self.count}
+        for name, q in (("p50_ms", 0.50), ("p90_ms", 0.90),
+                        ("p99_ms", 0.99)):
+            v = self.quantile(q)
+            out[name] = None if v is None else round(v * 1e3, 3)
+        return out
+
+    def reset(self) -> None:
+        self._obs.clear()
+        self.count = 0
+
+
+class FillMeter:
+    """Batch-fill accounting for the dynamic batcher: real examples over
+    padded bucket slots. fill == 1.0 means every compiled forward ran at
+    its bucket's full width; low fill at high offered load means the
+    batcher is flushing early (deadline too tight or buckets too big)."""
+
+    def __init__(self):
+        self.real = 0
+        self.padded = 0
+        self.batches = 0
+
+    def add(self, n_real: int, bucket: int) -> None:
+        self.real += int(n_real)
+        self.padded += int(bucket)
+        self.batches += 1
+
+    def ratio(self) -> float:
+        return self.real / self.padded if self.padded else 0.0
+
+    def reset(self) -> None:
+        self.real = self.padded = self.batches = 0

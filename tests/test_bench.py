@@ -77,6 +77,29 @@ def test_checkpoint_stall_bench_core(tmp_path):
         "metric"] == "checkpoint_blocking_stall_async_over_sync"
 
 
+def test_serve_bench_smoke(tmp_path):
+    """bench.serve_bench drives the REAL dynamic-batching server through
+    all three load regimes and writes a complete BENCH_SERVE artifact.
+    The committed BENCH_SERVE.json pins the acceptance numbers (fill >=
+    0.8 saturated, p99 bounded at trickle); this smoke asserts the
+    harness itself — rows present, counters sane, saturation actually
+    batching — at a CI-noise-tolerant threshold."""
+    import bench
+    out = bench.serve_bench(out_path=str(tmp_path / "BENCH_SERVE.json"),
+                            duration_s=0.4, max_batch=4)
+    rows = out["rows"]
+    assert [r["load"] for r in rows] == [
+        "trickle", "open_50rps", "open_200rps", "saturate"]
+    for r in rows:
+        assert r["requests_failed"] == 0
+        assert r["requests_ok"] > 0
+        assert r["p99_ms"] is not None
+    assert rows[0]["batch_fill_ratio"] == 1.0  # closed-loop single client
+    assert rows[-1]["batch_fill_ratio"] > 0.5  # saturation batches up
+    art = json.load(open(tmp_path / "BENCH_SERVE.json"))
+    assert art["headline"]["metric"] == "serve_saturated_batch_fill_ratio"
+
+
 def test_profiler_trace_capture(tmp_path):
     """maybe_trace writes a TensorBoard-loadable capture; None is a no-op."""
     import jax

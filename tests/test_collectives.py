@@ -214,17 +214,21 @@ def test_tp_round_collective_kinds_and_weight_bytes(tp_tau2):
         f"actually sharded? kinds={kinds}")
     ar_bytes = sum(b for k, b in colls if k == "all-reduce")
     # sharded-layer params (here: ALL layers are TP-shardable InnerProducts)
-    # cross the wire as 1/tp each; only f32 SCALARS ride along — the loss
-    # plus the two health signals (grad_norm, nonfinite), each psum'd over
-    # data AND vma-cleared over the model axis: 6 × 4 = 24 bytes. Slack 32
-    # stays tight: at these ~360-byte shapes a single layer's shards-summed
-    # regression is ~130 bytes — a big blanket slack would mask exactly
-    # the bug class this pins.
+    # cross the wire as 1/tp each; only small HEALTH/LOSS riders come
+    # along — three f32 scalars (loss, grad_norm, nonfinite), each
+    # psum'd over data AND vma-cleared over the model axis (2 legs), plus
+    # the [n_data + 1] attribution-plus-authority vector on the same two
+    # legs: 6×4 + 2×4×(n_data+1) bytes, computed exactly so the slack
+    # stays tight — at these ~360-byte shapes a single layer's
+    # shards-summed regression is ~130 bytes and a blanket slack would
+    # mask exactly the bug class this pins.
+    n_data = 4  # dp in _tp_round_collectives
+    riders = 6 * 4 + 2 * 4 * (n_data + 1)
     logical = full_param_bytes / tp
-    assert logical <= ar_bytes <= logical + 32, (
+    assert logical <= ar_bytes <= logical + riders + 8, (
         f"weight-average all-reduce moved {ar_bytes} bytes; expected "
         f"~{int(logical)} (one LOGICAL copy: full {full_param_bytes} / "
-        f"tp {tp}) + scalar riders")
+        f"tp {tp}) + {riders} rider bytes")
 
 
 def test_tp_round_allgather_bytes_tau_scale(tp_tau2):

@@ -148,7 +148,38 @@ def test_round_health_scalars_flag_nan_poison(tiny_trainer):
                                            jax.random.PRNGKey(43))
     # every data group saw poison: the psum'd flag counts all 8 workers
     assert float(tiny_trainer.last_health["nonfinite"]) == 8.0
+    np.testing.assert_array_equal(
+        np.asarray(tiny_trainer.last_health["nonfinite_by_worker"]),
+        np.ones(8, np.float32))
     assert not np.isfinite(float(loss))
+
+
+def test_round_health_attributes_single_bad_worker(tiny_trainer):
+    """NaNs fed to ONE worker's shard light exactly that worker's slot in
+    the [n_data] attribution vector: the per-worker flag reads the
+    PRE-average local state, so the weight-averaging pmean (which smears
+    the NaN onto every replica one sync later) cannot erase the origin.
+    A consistently bad host/feed is argmax of this vector."""
+    bad = 5
+    state = tiny_trainer.init_state(jax.random.PRNGKey(0))
+    batches = _mlp_batches(3)
+    per = batches["data"].shape[1] // 8  # [tau, n_dev*local_b, ...] rows
+    data = batches["data"].copy()
+    data[:, bad * per:(bad + 1) * per] = np.nan
+    state, loss = tiny_trainer.train_round(
+        state, {"data": data, "label": batches["label"]},
+        jax.random.PRNGKey(44))
+    h = tiny_trainer.last_health
+    vec = np.asarray(h["nonfinite_by_worker"])
+    expect = np.zeros(8, np.float32)
+    expect[bad] = 1.0
+    np.testing.assert_array_equal(vec, expect)
+    assert float(h["nonfinite"]) == 1.0
+    assert int(np.argmax(vec)) == bad
+    # and the averaged params ARE poisoned (the attribution beat the
+    # smear, it didn't prevent it — rollback is still the remedy)
+    avg = tiny_trainer.averaged_params(state)
+    assert not np.isfinite(np.asarray(avg["ip1"]["w"])).all()
 
 
 def test_lr_scale_shrinks_the_update(tiny_trainer):
@@ -280,7 +311,7 @@ def test_anomalous_checkpoints_skipped_by_rollback_selector(tmp_path):
 
 
 def _train_with_injection(tmp_path, health, max_rounds=8, log_every=1,
-                          checkpoint_every=1):
+                          checkpoint_every=1, **cfg_kw):
     from sparknet_tpu.data import cifar
     from sparknet_tpu.data.dataset import ArrayDataset
     from sparknet_tpu.solver import SolverConfig
@@ -296,7 +327,7 @@ def _train_with_injection(tmp_path, health, max_rounds=8, log_every=1,
         tau=2, local_batch=4, eval_every=0, max_rounds=max_rounds, seed=0,
         workdir=str(tmp_path), log_every=log_every,
         checkpoint_dir=str(tmp_path / "ck"),
-        checkpoint_every=checkpoint_every, health=health)
+        checkpoint_every=checkpoint_every, health=health, **cfg_kw)
     jsonl = str(tmp_path / "metrics.jsonl")
     state = train(cfg, cifar10_quick(batch=4), train_ds,
                   logger=Logger(str(tmp_path / "log.txt"), echo=False,
@@ -347,6 +378,32 @@ def test_injected_nan_round_detected_rolled_back_and_recovered(tmp_path):
     # the supervisor's recovery state rides the checkpoint: a preemption-
     # resume must not silently revert the backoff / retried data order
     assert extra["health"] == {"retry": 1, "lr_scale": 0.5, "rollbacks": 1}
+
+
+@pytest.mark.chaos
+def test_heartbeat_and_worker_attribution_in_loop(tmp_path):
+    """The loop-level surface of both satellites: with heartbeat_path
+    set, the run leaves a fresh heartbeat whose status reflects the
+    outcome ("done", rollbacks counted), and the poisoned round's JSONL
+    row carries the worst-worker attribution."""
+    from sparknet_tpu.utils.heartbeat import read_heartbeat, staleness_s
+    hb_path = str(tmp_path / "hb.json")
+    R = 3
+    cfg, state, recs = _train_with_injection(
+        tmp_path, HealthConfig(inject_nan_rounds=(R,), min_history=2),
+        max_rounds=6, heartbeat_path=hb_path, heartbeat_every_s=0.0)
+    hb = read_heartbeat(hb_path)
+    assert hb is not None and hb["role"] == "train"
+    assert hb["status"] == "done" and hb["step"] == cfg.max_rounds
+    assert hb["rollbacks"] == 1
+    assert staleness_s(hb) < 120
+    # the nonfinite round's metrics row names the worst worker (the
+    # injection poisons every worker's shard, so index 0 wins the argmax
+    # and ALL workers are flagged)
+    row = next(r for r in recs if r.get("health") == "nonfinite")
+    assert row["step"] == R
+    assert row["worst_worker"] == 0
+    assert row["nonfinite_workers"] == 8  # every worker's shard poisoned
 
 
 @pytest.mark.chaos

@@ -38,6 +38,7 @@ case "$*" in
   *"tpu-vm ssh"*)
     case "$*" in
       *"pip install"*) exit 0 ;;   # setup
+      *"--command cat "*) cat "$DIR/heartbeat" 2>/dev/null; exit 0 ;;
     esac
     line=$(head -n 1 "$DIR/runplan" 2>/dev/null || echo ok)
     tail -n +2 "$DIR/runplan" > "$DIR/runplan.t" 2>/dev/null || true
@@ -136,6 +137,24 @@ def test_watch_retries_transient_run_failure(launcher):
     assert "retrying once" in r.stderr
     assert "command completed" in r.stderr
     assert launcher.calls().count("tpu-vm create") == 1  # no recreate
+
+
+def test_watch_reports_heartbeat_on_ready_failure(launcher):
+    """With TPU_HEARTBEAT_FILE set, a run failure on a READY pod fetches
+    the app's heartbeat JSON from worker 0 and echoes it — watch's
+    "slow vs sick" answer without log parsing (the stub serves the
+    fixture's heartbeat file for `--command cat` ssh calls)."""
+    launcher("create", "pod", "z", "v5e-32")
+    (launcher.stub_dir / "heartbeat").write_text(
+        '{"t": 1.0, "step": 12, "status": "nonfinite", "rollbacks": 2}')
+    r = launcher("watch", "pod", "z", "v5e-32", "python -m app",
+                 plan=["fail", "fail"],
+                 env={"TPU_HEARTBEAT_FILE": "/tmp/hb.json"})
+    assert r.returncode == 1  # two READY failures: app error
+    assert "last heartbeat from worker 0" in r.stderr
+    assert "nonfinite" in r.stderr
+    # and without the knob no heartbeat ssh traffic happens at all
+    assert launcher.calls().count("--command cat") == 2
 
 
 def test_watch_creates_from_nothing(launcher):

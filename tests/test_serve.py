@@ -1,0 +1,375 @@
+"""`sparknet_tpu.serve` — dynamic batching, hot-reload, parity, chaos.
+
+Tier-1 (CPU mesh, local/fake stores, small nets). The contracts pinned:
+
+  - batching policy: max-batch flush, oldest-request deadline flush,
+    queue-capacity backpressure, batches never exceed their bucket.
+  - concurrency: N client threads, every request answered exactly once
+    with ITS OWN answer (responses keyed to request content).
+  - parity: padded rows are BITWISE-identical to an unpadded forward at
+    the same compiled bucket (padding is lossless); across different
+    buckets outputs are allclose (XLA may re-associate per-shape — the
+    same contract training accepts, pinned empirically here).
+  - chaos: a checkpoint hot-swap lands mid-traffic without dropping or
+    corrupting a single response; a corrupt snapshot is rejected
+    (digest verify) with traffic unharmed; a poisoned-but-valid
+    snapshot is rolled back by the canary.
+"""
+import json
+import os
+import threading
+import time
+import urllib.request
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.net_api import JaxNet
+from sparknet_tpu.serve import (DynamicBatcher, InferenceServer,
+                                ModelManager, QueueFullError, ServeConfig,
+                                ServeModelError, zeros_batch)
+from sparknet_tpu.serve.model_manager import params_from_checkpoint_flat
+from sparknet_tpu.utils import checkpoint as ckpt
+from sparknet_tpu.utils.heartbeat import read_heartbeat
+from sparknet_tpu.zoo import lenet
+
+
+def _example(i: int) -> dict:
+    """Deterministic per-request input keyed on i — responses can be
+    matched back to the request that produced them."""
+    r = np.random.default_rng(1000 + i)
+    return {"data": r.standard_normal((28, 28, 1)).astype(np.float32)}
+
+
+@pytest.fixture(scope="module")
+def net():
+    return JaxNet(lenet(batch=4))
+
+
+@pytest.fixture()
+def server(net):
+    cfg = ServeConfig(max_batch=4, max_wait_ms=10.0,
+                      outputs=("fc2", "prob"), metrics_every_batches=0)
+    with InferenceServer(net, cfg) as srv:
+        yield srv
+
+
+# -- batcher policy ----------------------------------------------------------
+
+def test_batcher_flushes_at_max_batch():
+    b = DynamicBatcher(max_batch=4, max_wait_s=60.0)  # deadline far away
+    for i in range(9):
+        b.submit({"x": np.float32(i)})
+    got = b.next_batch()
+    assert [r.payload["x"] for r in got] == [0, 1, 2, 3]  # FIFO, full
+    assert len(b.next_batch()) == 4
+    # 1 leftover: the deadline (not size) must flush it
+    b.max_wait_s = 0.01
+    t0 = time.perf_counter()
+    got = b.next_batch()
+    assert len(got) == 1 and got[0].payload["x"] == 8
+    assert time.perf_counter() - t0 < 5.0
+
+
+def test_batcher_deadline_keyed_on_oldest():
+    """A steady trickle must not reset the timer: the batch closes at
+    oldest.t_enqueue + max_wait even while new requests keep arriving."""
+    b = DynamicBatcher(max_batch=64, max_wait_s=0.08)
+    stop = threading.Event()
+
+    def trickle():
+        while not stop.is_set():
+            b.submit({"x": np.float32(0)})
+            time.sleep(0.005)
+
+    t = threading.Thread(target=trickle, daemon=True)
+    b.submit({"x": np.float32(-1)})
+    t0 = time.perf_counter()
+    t.start()
+    try:
+        got = b.next_batch()
+    finally:
+        stop.set()
+        t.join()
+    dt = time.perf_counter() - t0
+    assert got[0].payload["x"] == -1
+    assert dt < 1.0, f"trickle starved the head of the queue for {dt:.2f}s"
+    b.close()
+
+
+def test_batcher_backpressure_and_close():
+    b = DynamicBatcher(max_batch=2, max_wait_s=60.0, max_queue=3)
+    futs = [b.submit({"x": np.float32(i)}) for i in range(3)]
+    with pytest.raises(QueueFullError):
+        b.submit({"x": np.float32(9)})
+    b.close()
+    with pytest.raises(RuntimeError):
+        b.submit({"x": np.float32(9)})
+    for f in futs:  # queued-but-unserved requests must not hang clients
+        with pytest.raises(RuntimeError, match="shut down"):
+            f.result(timeout=1.0)
+
+
+# -- serving: concurrency + bucket discipline --------------------------------
+
+def test_concurrent_clients_every_request_answered_exactly_once(server,
+                                                                net):
+    """8 client threads x 12 requests: every future resolves exactly once,
+    with the answer belonging to ITS request (matched against a direct
+    forward of the same example), and every formed batch fits a bucket."""
+    n_clients, per = 8, 12
+    results: dict = {}
+    errs = []
+
+    def client(c):
+        try:
+            futs = [(i, server.submit(_example(c * per + i)))
+                    for i in range(per)]
+            for i, f in futs:
+                results[(c, i)] = f.result(timeout=30.0)
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append(e)
+
+    ts = [threading.Thread(target=client, args=(c,))
+          for c in range(n_clients)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    assert len(results) == n_clients * per  # exactly once, none dropped
+    st = server.status()
+    assert st["requests_ok"] == n_clients * per
+    assert st["requests_failed"] == 0
+    # responses match their own request: direct forward of example k
+    # (cross-bucket tolerance — the response may have run in any bucket;
+    # see test_cross_bucket_outputs_allclose for why not bitwise)
+    for (c, i), resp in results.items():
+        k = c * per + i
+        direct = net.forward({**zeros_batch(net, 1), **{
+            "data": _example(k)["data"][None]}}, blob_names=["fc2"])
+        np.testing.assert_allclose(resp["fc2"], direct["fc2"][0],
+                                   rtol=1e-4, atol=1e-4)
+    # bucket discipline: n <= bucket, bucket is a configured bucket
+    assert server.batch_log, "no batches recorded"
+    for n, bucket in server.batch_log:
+        assert bucket in server.buckets
+        assert 1 <= n <= bucket <= server.cfg.max_batch
+
+
+def test_mis_shaped_request_fails_alone(server):
+    """A bad request in the same window as good ones fails ITS future;
+    the good requests still answer (signature grouping)."""
+    good = [server.submit(_example(i)) for i in range(2)]
+    bad = server.submit({"data": np.zeros((7, 7, 1), np.float32)})
+    for f in good:
+        assert np.isfinite(f.result(timeout=30.0)["prob"]).all()
+    with pytest.raises(Exception):
+        bad.result(timeout=30.0)
+    assert server.status()["requests_failed"] == 1
+
+
+# -- parity ------------------------------------------------------------------
+
+def test_padded_batch_bitwise_matches_unpadded_rows(net):
+    """Padding is lossless WITHIN a compiled bucket: rows of a 2-real/
+    2-pad forward are bitwise-identical to the same rows of a full-4
+    forward (every layer is row-independent across the batch)."""
+    data = np.stack([_example(i)["data"] for i in range(4)])
+    full = net.forward({**zeros_batch(net, 4), "data": data},
+                       blob_names=["fc2", "prob"])
+    padded_in = np.concatenate([data[:2], np.zeros_like(data[:2])])
+    padded = net.forward({**zeros_batch(net, 4), "data": padded_in},
+                         blob_names=["fc2", "prob"])
+    for k in ("fc2", "prob"):
+        np.testing.assert_array_equal(padded[k][:2], full[k][:2])
+
+
+def test_server_single_bucket_bitwise_parity(net):
+    """With ONE bucket, a lone request and a full concurrent batch run
+    the SAME compiled forward — server answers are bitwise-identical to
+    direct single-request forwards padded to that bucket."""
+    cfg = ServeConfig(max_batch=4, max_wait_ms=5.0, buckets=(4,),
+                      outputs=("fc2",))
+    with InferenceServer(net, cfg) as srv:
+        lone = srv.infer(_example(0))  # padded 1 -> 4 by the server
+        futs = [srv.submit(_example(i)) for i in range(4)]
+        batched = [f.result(timeout=30.0) for f in futs]
+        assert all(b == 4 for _, b in srv.batch_log)
+    direct_in = np.stack([_example(i)["data"] for i in range(4)])
+    direct = net.forward({**zeros_batch(net, 4), "data": direct_in},
+                         blob_names=["fc2"])
+    # the lone request and its batched twin took different-fill batches
+    # of the SAME bucket: bitwise equal, and equal to the direct forward
+    np.testing.assert_array_equal(lone["fc2"], batched[0]["fc2"])
+    for i in range(4):
+        np.testing.assert_array_equal(batched[i]["fc2"], direct["fc2"][i])
+
+
+def test_cross_bucket_outputs_allclose(server, net):
+    """Across DIFFERENT compiled buckets XLA may re-associate reductions:
+    the contract is allclose, not bitwise (measured ~3e-5 max drift on
+    f32 lenet logits) — pinned so a real numerical regression (layout
+    bug, wrong padding) still fails loudly."""
+    lone = server.infer(_example(3))  # bucket 1
+    futs = [server.submit(_example(i)) for i in range(3, 7)]  # bucket 4
+    batched = futs[0].result(timeout=30.0)
+    for f in futs[1:]:
+        f.result(timeout=30.0)
+    np.testing.assert_allclose(lone["fc2"], batched["fc2"],
+                               rtol=1e-4, atol=1e-4)
+
+
+# -- checkpoint hot-reload ---------------------------------------------------
+
+def _save_trainstate_like(net, d, step, scale=1.0, anomalous=False):
+    """A TrainState-shaped checkpoint (params/<l>/<p> with a leading
+    replica axis) holding this net's weights scaled by `scale`."""
+    flat = {}
+    for lname, lp in net.params.items():
+        for pname, w in lp.items():
+            flat[f"params/{lname}/{pname}"] = np.asarray(w)[None] * scale
+    extra = {"anomalous": True} if anomalous else None
+    return ckpt.save(str(d), flat, step=step, extra=extra)
+
+
+def test_manager_initial_load_and_flat_extraction(net, tmp_path):
+    d = tmp_path / "ck"
+    _save_trainstate_like(net, d, step=3, scale=0.5)
+    m = ModelManager(net, checkpoint_dir=str(d))
+    assert m.load_initial() == 3
+    assert m.step == 3
+    # and the extraction helper round-trips shapes exactly
+    flat, _, _ = ckpt.restore_flat(str(d))
+    params = params_from_checkpoint_flat(flat, net.params)
+    for lname, lp in net.params.items():
+        for pname, w in lp.items():
+            assert params[lname][pname].shape == w.shape
+
+
+def test_manager_rejects_tp_and_missing_leaves(net, tmp_path):
+    d = tmp_path / "ck"
+    _save_trainstate_like(net, d, step=1)
+    m = ModelManager(net, checkpoint_dir=str(d), poll_interval_s=0.0)
+    flat, _, _ = ckpt.restore_flat(str(d))
+    assert not m._install(flat, 1, {"tp": 2})  # column shards: unservable
+    assert m.swap_failures == 1
+    with pytest.raises(ServeModelError, match="conv1"):
+        params_from_checkpoint_flat(
+            {k: v for k, v in flat.items() if "conv1" not in k},
+            net.params)
+
+
+@pytest.mark.chaos
+def test_hot_swap_mid_traffic_chaos(net, tmp_path):
+    """The acceptance chaos: continuous client traffic while (1) a GOOD
+    new checkpoint hot-swaps in, (2) a CORRUPT newer one is rejected,
+    (3) a NONFINITE-but-digest-valid one is rolled back by the canary.
+    Zero dropped responses, zero corrupted (all finite, right shape),
+    and the swap/rejection counters tell the story."""
+    d = tmp_path / "ck"
+    _save_trainstate_like(net, d, step=1)
+    hb_path = str(tmp_path / "hb.json")
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      checkpoint_dir=str(d), poll_interval_s=0.05,
+                      heartbeat_path=hb_path, heartbeat_every_s=0.01)
+    answered, bad = [], []
+    stop = threading.Event()
+
+    def client():
+        i = 0
+        while not stop.is_set():
+            try:
+                out = srv.infer(_example(i), timeout=30.0)
+                p = out["prob"]
+                if p.shape != (10,) or not np.isfinite(p).all() or \
+                        abs(float(p.sum()) - 1.0) > 1e-3:
+                    bad.append((i, p))
+                answered.append(i)
+            except Exception as e:
+                bad.append((i, e))
+            i += 1
+
+    with InferenceServer(net, cfg) as srv:
+        assert srv.manager.step == 1
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            # (1) good swap lands without a hiccup
+            _save_trainstate_like(net, d, step=2, scale=0.9)
+            _wait(lambda: srv.manager.step == 2)
+            # (2) corrupt snapshot: digest verify must reject it
+            path = _save_trainstate_like(net, d, step=3)
+            npz = os.path.join(path, "state.npz")
+            raw = bytearray(open(npz, "rb").read())
+            raw[-32] ^= 0x01
+            open(npz, "wb").write(bytes(raw))
+            fails = srv.manager.swap_failures
+            _wait(lambda: srv.manager.swap_failures > fails)
+            assert srv.manager.step == 2  # still on the good one
+            assert "corrupt" in srv.manager.last_error
+            # (3) digest-valid but poisoned weights: canary rolls back
+            _save_trainstate_like(net, d, step=4, scale=np.nan)
+            fails = srv.manager.swap_failures
+            _wait(lambda: srv.manager.swap_failures > fails)
+            assert srv.manager.step == 2
+            assert "canary" in srv.manager.last_error
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not bad, bad[:3]
+        assert len(answered) > 20  # real traffic flowed throughout
+        assert srv.manager.swaps == 1
+        assert srv.manager.swap_failures == 2
+        st = srv.status()
+        assert st["requests_failed"] == 0
+        assert st["requests_ok"] >= len(answered)
+    hb = read_heartbeat(hb_path)
+    assert hb is not None and hb["role"] == "serve"
+    assert hb["step"] == 2 and hb["rollbacks"] == 2
+
+
+def _wait(cond, timeout=20.0):
+    t0 = time.monotonic()
+    while not cond():
+        assert time.monotonic() - t0 < timeout, "condition never held"
+        time.sleep(0.02)
+
+
+# -- status surfaces ---------------------------------------------------------
+
+def test_healthz_and_metrics_http(net):
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      status_port=0)  # ephemeral port
+    with InferenceServer(net, cfg) as srv:
+        srv.infer(_example(0))
+        host, port = srv.status_address
+        h = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        assert h["status"] == "ok"
+        m = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read())
+        assert m["requests_ok"] == 1
+        assert m["batch_fill_ratio"] == 1.0  # one request, bucket 1
+        assert m["p50_ms"] is not None
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope",
+                                   timeout=10)
+
+
+def test_serve_cli_demo(tmp_path, capsys):
+    """The `sparknet-serve` entry point end to end in --demo mode."""
+    from sparknet_tpu.serve.app import main
+    main(["--model", "lenet", "--outputs", "prob", "--max-batch", "4",
+          "--demo", "12", "--workdir", str(tmp_path),
+          "--heartbeat", str(tmp_path / "hb.json")])
+    status = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert status["requests_ok"] == 12 and status["requests_failed"] == 0
+    assert read_heartbeat(str(tmp_path / "hb.json"))["status"] == "done"
+
+
+def test_future_type(server):
+    assert isinstance(server.submit(_example(0)), Future)
