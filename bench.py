@@ -614,8 +614,10 @@ def checkpoint_stall(mb: int = 64, saves: int = 3,
         "per_store": by_store,
     }
     if out_path:
+        from sparknet_tpu.obs import run_metadata
         with open(out_path, "w") as f:
-            json.dump({"headline": out, "rows": rows}, f, indent=1)
+            json.dump({"headline": out, "rows": rows,
+                       "meta": run_metadata()}, f, indent=1)
     print(json.dumps(out))
     return rows
 
@@ -796,10 +798,137 @@ def serve_bench(out_path: str | None = "BENCH_SERVE.json",
         "max_wait_ms": max_wait_ms,
     }
     if out_path:
+        from sparknet_tpu.obs import run_metadata
         with open(out_path, "w") as f:
-            json.dump({"headline": out, "rows": rows}, f, indent=1)
+            json.dump({"headline": out, "rows": rows,
+                       "meta": run_metadata()}, f, indent=1)
     print(json.dumps(out))
     return {"headline": out, "rows": rows}
+
+
+def obs_bench(out_path: str | None = "BENCH_OBS.json", rounds: int = 40,
+              warmup: int = 8, reps: int = 3) -> dict:
+    """Telemetry overhead: the SAME tiny training run with the obs layer
+    fully on (per-run registry + per-round step-time breakdown rows +
+    host-span tracing + a live /metrics status server being scraped) vs
+    telemetry disabled (`RunConfig.telemetry=False`, no trace, no status
+    server — the pre-obs loop). Headline: median steady-state per-round
+    overhead, acceptance target <= 2%.
+
+    CPU backend, lenet shapes: rounds are a few ms, which makes this a
+    WORST-CASE ratio — the fixed per-round telemetry cost is divided by
+    the smallest realistic round. On a real chip training CaffeNet the
+    denominator grows ~100x and the ratio shrinks accordingly."""
+    import os
+    import statistics
+    import tempfile
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from sparknet_tpu.apps.train_loop import train
+    from sparknet_tpu.data.dataset import ArrayDataset
+    from sparknet_tpu.obs import run_metadata
+    from sparknet_tpu.utils.config import RunConfig
+    from sparknet_tpu.utils.logger import Logger
+    from sparknet_tpu.zoo import lenet
+
+    r = np.random.default_rng(0)
+    n, b, tau = 2048, 32, 2
+    ds = ArrayDataset({
+        "data": r.standard_normal((n, 1, 28, 28)).astype(np.float32),
+        "label": r.integers(0, 10, (n, 1)).astype(np.int32)})
+
+    def run(telemetry: bool, root: str) -> float:
+        cfg = RunConfig(model="lenet", n_devices=1, local_batch=b, tau=tau,
+                        max_rounds=rounds, eval_every=0, workdir=root,
+                        telemetry=telemetry,
+                        status_port=0 if telemetry else None,
+                        trace_out=(os.path.join(root, "trace.json")
+                                   if telemetry else None))
+        marks: list[float] = []
+        stop = threading.Event()
+        scraper = None
+
+        def hook(rnd, state):
+            marks.append(time.perf_counter())
+            if telemetry and rnd == 0 and cfg.status_address:
+                # a live scraper during the timed window: real telemetry
+                # includes being read, not just being written
+                host, port = cfg.status_address
+
+                def scrape():
+                    # 1 Hz: already ~15-60x denser than a production
+                    # Prometheus scrape interval, without turning a
+                    # CPU-contended bench host into a scrape benchmark
+                    while not stop.is_set():
+                        try:
+                            urllib.request.urlopen(
+                                f"http://{host}:{port}/metrics",
+                                timeout=5).read()
+                        except Exception:
+                            pass
+                        stop.wait(1.0)
+                nonlocal scraper
+                scraper = threading.Thread(target=scrape, daemon=True)
+                scraper.start()
+
+        log = Logger(os.path.join(root, "log.txt"), echo=False,
+                     jsonl_path=os.path.join(root, "metrics.jsonl"))
+        try:
+            train(cfg, lenet(batch=b), ds, None, logger=log,
+                  round_hook=hook)
+        finally:
+            stop.set()
+            log.close()
+            if scraper is not None:
+                scraper.join(timeout=2.0)
+        deltas = [b_ - a for a, b_ in zip(marks[warmup:], marks[warmup + 1:])]
+        return statistics.median(deltas)
+
+    # interleave the arms in ABBA order (off,on,on,off) and take the MIN
+    # median per arm: on a contended bench host the background load
+    # drifts by more than the effect size between back-to-back runs
+    # (observed monotonic ~10% creep across four runs), so a fixed
+    # off-then-on order systematically charges the drift to the on arm;
+    # ABBA cancels the linear component and the minimum discards the
+    # most-polluted runs
+    rows = []
+    best = {False: float("inf"), True: float("inf")}
+    with tempfile.TemporaryDirectory() as tmp:
+        for rep in range(reps):
+            for telemetry in ((False, True) if rep % 2 == 0
+                              else (True, False)):
+                d = os.path.join(tmp, f"{'on' if telemetry else 'off'}{rep}")
+                os.makedirs(d)
+                med = run(telemetry, d)
+                best[telemetry] = min(best[telemetry], med)
+                rows.append({"telemetry": "on" if telemetry else "off",
+                             "rep": rep,
+                             "median_round_ms": round(med * 1e3, 4),
+                             "rounds": rounds, "warmup": warmup})
+                print(f"  telemetry {'on' if telemetry else 'off'} "
+                      f"(rep {rep}): {med * 1e3:.3f} ms/round",
+                      file=sys.stderr)
+    off = round(best[False] * 1e3, 4)
+    on = round(best[True] * 1e3, 4)
+    overhead = max(on / off - 1.0, 0.0)
+    out = {
+        "metric": "obs_full_telemetry_per_round_overhead",
+        "value": round(overhead, 4),
+        "unit": "median per-round overhead, telemetry on vs off "
+                "(registry + breakdown rows + trace + scraped /metrics; "
+                "target <= 0.02)",
+        "vs_baseline": round(min(0.02 / max(overhead, 1e-9), 100.0), 2),
+        "per_mode": {"off_ms": off, "on_ms": on},
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"headline": out, "rows": rows,
+                       "meta": run_metadata()}, f, indent=1)
+    print(json.dumps(out))
+    return out
 
 
 def e2e_smoke() -> None:
@@ -871,6 +1000,10 @@ def main() -> None:
                    "vs latency/throughput/batch-fill; writes BENCH_SERVE")
     p.add_argument("--serve-secs", type=float, default=2.0,
                    help="seconds per load level for --serve")
+    p.add_argument("--obs", action="store_true",
+                   help="telemetry overhead: per-round time with the obs "
+                   "layer fully on (registry + breakdown + trace + "
+                   "scraped /metrics) vs disabled; writes BENCH_OBS")
     p.add_argument("--featurize", action="store_true",
                    help="batched forward(blob_names=['fc7']) img/s on both "
                    "backends (the FeaturizerApp inference path)")
@@ -897,6 +1030,8 @@ def main() -> None:
     elif args.serve:
         serve_bench(duration_s=args.serve_secs,
                     max_batch=args.batch or 8)
+    elif args.obs:
+        obs_bench()
     elif args.featurize:
         featurize_bench(batch=args.batch or 64)
     elif args.graph:

@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Summarize a sparknet_tpu metrics JSONL (loss curve tail, step-time
+breakdown table, health-event audit trail). Thin runnable wrapper over
+`sparknet_tpu.obs.summary` — the installed console entry is
+`sparknet-metrics`; this file serves checkouts without an install:
+
+    python scripts/metrics_summary.py run/training_metrics_*.jsonl
+"""
+import os
+import sys
+
+try:
+    from sparknet_tpu.obs.summary import main
+except ModuleNotFoundError:  # uninstalled checkout: repo root on the path
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from sparknet_tpu.obs.summary import main
+
+if __name__ == "__main__":
+    sys.exit(main())

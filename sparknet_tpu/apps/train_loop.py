@@ -23,6 +23,8 @@ the rollback budget is spent (utils/health.py).
 """
 from __future__ import annotations
 
+import math
+import time
 import warnings
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional
@@ -32,6 +34,8 @@ import numpy as np
 
 from ..model.net import CompiledNet
 from ..model.spec import NetSpec
+from ..obs import (MetricsRegistry, StatusServer, register_build_info,
+                   trace as obs_trace)
 from ..parallel.mesh import fetch_global, make_mesh
 from ..parallel.trainer import ParallelTrainer, TrainState
 from ..data.dataset import ArrayDataset, RoundSampler
@@ -47,7 +51,6 @@ from .. import precision
 
 def _hb_float(v: float):
     """Heartbeat-safe float: NaN/Inf -> None (RFC 8259, like the JSONL)."""
-    import math
     return float(v) if math.isfinite(v) else None
 
 
@@ -249,8 +252,32 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
             _seek_stream(source, extra, log)
             resumed_extra = extra
 
-    timers = PhaseTimers()
-    meter = ThroughputMeter(n_chips=n_dev)
+    # unified telemetry: one per-run registry every meter/supervisor/
+    # writer below registers into; the training process's own /metrics
+    # (status server) and the per-round step-time breakdown render from
+    # it. cfg.telemetry=False restores the pre-obs loop (the bench.py
+    # --obs "disabled" arm measures exactly this switch) — unless a
+    # status_port is also set, which is an explicit ask for the scrape
+    # surface and therefore forces the registry (an empty /metrics would
+    # silently betray the documented contract).
+    registry = (MetricsRegistry()
+                if cfg.telemetry or cfg.status_port is not None else None)
+    g_round = g_loss = c_rounds = None
+    if registry is not None:
+        register_build_info(registry)
+        g_round = registry.gauge("sparknet_train_round",
+                                 "last flushed round index")
+        g_loss = registry.gauge("sparknet_train_loss",
+                                "last flushed round loss")
+        c_rounds = registry.counter("sparknet_train_rounds_total",
+                                    "rounds dispatched")
+    timers = PhaseTimers(registry=registry)
+    if cfg.telemetry and hasattr(trainer, "phase_timers"):
+        # h2d / dispatch split from inside train_round (ParallelTrainer).
+        # Gated on telemetry so the disabled arm really is the pre-obs
+        # round path (bench.py --obs compares against it).
+        trainer.phase_timers = timers
+    meter = ThroughputMeter(n_chips=n_dev, registry=registry)
     # round-keyed rngs: resume at round R reproduces the uninterrupted
     # schedule exactly (reference had no resume at all, SURVEY §5.3)
     base_rng = jax.random.PRNGKey(cfg.seed ^ 0xABCD)
@@ -264,20 +291,45 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     # the compiled round can't disagree about whether health is on
     health_cfg = (cfg.health if cfg.health is not None
                   else HealthConfig(enabled=False))
-    monitor = HealthMonitor(health_cfg) if health_cfg.enabled else None
+    monitor = (HealthMonitor(health_cfg, registry=registry)
+               if health_cfg.enabled else None)
     # stage-2 background checkpoint writer (serialize+digest+persist off
     # the round loop's critical path; at most one snapshot in flight).
     # None = fully synchronous saves (cfg.checkpoint_async=False).
-    ck_writer = (ckpt.AsyncCheckpointWriter()
+    ck_writer = (ckpt.AsyncCheckpointWriter(registry=registry)
                  if cfg.checkpoint_dir and cfg.checkpoint_async else None)
     # liveness heartbeat (process 0 writes; the launcher's watch probes
     # worker 0): one atomic JSON at the flush cadence — "slow vs sick"
     # without log parsing. Every beat is best-effort: a full disk must
     # degrade observability, not kill the run.
     heartbeat = (HeartbeatWriter(cfg.heartbeat_path, role="train",
-                                 interval_s=cfg.heartbeat_every_s)
+                                 interval_s=cfg.heartbeat_every_s,
+                                 registry=registry)
                  if cfg.heartbeat_path and jax.process_index() == 0
                  else None)
+    # host-side span capture (--trace-out): spans from the round loop,
+    # the round-prep prefetch thread and the ckpt-write thread land on
+    # per-thread lanes of ONE Chrome-trace timeline (obs/trace.py) —
+    # written at loop exit, loadable in Perfetto next to the
+    # cfg.profile_dir device trace
+    tracer = (obs_trace.start_tracing()
+              if cfg.trace_out and jax.process_index() == 0 else None)
+    # live vitals for /healthz + /status on the training status server
+    vitals: Dict[str, Any] = {"role": "train", "round": start_round,
+                              "status": "ok", "loss": None}
+    status_srv = None
+    if cfg.status_port is not None and jax.process_index() == 0:
+        status_srv = StatusServer(
+            cfg.status_port, registry, host=cfg.status_host,
+            healthz=lambda: (vitals["status"] not in ("nonfinite",),
+                             {k: v for k, v in vitals.items()}),
+            status=lambda: {**vitals,
+                            "rollbacks": (monitor.rollbacks
+                                          if monitor else 0),
+                            "phase_means": timers.summary()})
+        cfg.status_address = status_srv.address
+        log.log(f"train status server at http://{status_srv.address[0]}:"
+                f"{status_srv.address[1]}/metrics")
 
     def beat(step: int, status: str, force: bool = False, **kv) -> None:
         if heartbeat is None:
@@ -323,10 +375,22 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
 
     def prepare_round(rnd: int, retry_: int,
                       first_pass: bool) -> Dict[str, np.ndarray]:
-        return prepare_round_batches(source, rnd, cfg.tau, cfg.seed,
-                                     batch_transform, compute_dt,
-                                     retry=retry_, health=health_cfg,
-                                     first_pass=first_pass)
+        # span: host-side round prep runs on the `round-prep_0` prefetch
+        # thread — its own lane in the trace timeline, visualizing the
+        # overlap with the device round
+        with obs_trace.span("round_prep", round=rnd):
+            return prepare_round_batches(source, rnd, cfg.tau, cfg.seed,
+                                         batch_transform, compute_dt,
+                                         retry=retry_, health=health_cfg,
+                                         first_pass=first_pass)
+
+    # step-time breakdown bookkeeping: per-round deltas of the phase
+    # timers (data wait / H2D / compiled-round dispatch / checkpoint
+    # stage-1 fetch), plus the collect (deferred loss fetch) and log
+    # durations measured at flush. `_last_flush_ms[0]` carries the
+    # previous flush's own cost into the next record — a flush cannot
+    # time itself into the row it is writing.
+    _last_flush_ms = [0.0]
 
     def flush_round_log(rec) -> None:
         """Emit round R's metrics. `float(loss)` here is the pipeline's
@@ -337,9 +401,17 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         health scalars ride the same deferred fetch: classification
         happens here, so anomaly detection costs no extra per-round sync
         and latches a recovery decision at the same log_every cadence."""
-        rnd_, loss_, probe_, health_ = rec
+        t_flush0 = time.perf_counter()
+        rnd_, loss_, probe_, health_, breakdown_ = rec
+        t_c0 = time.perf_counter()
         loss_ = float(loss_)
+        t_collect = time.perf_counter() - t_c0
         kv: Dict[str, Any] = {}
+        if breakdown_ is not None:
+            breakdown_["collect"] = t_collect
+            breakdown_["log"] = _last_flush_ms[0] / 1e3
+            kv.update({f"t_{k}_ms": round(v * 1e3, 3)
+                       for k, v in breakdown_.items()})
         gnorm = nonf = None
         worker_txt = ""
         if health_ is not None:
@@ -376,12 +448,22 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
                 f"{worker_txt}", rnd_)
         log.metrics(rnd_, loss=loss_, images_per_sec_per_chip=round(
             meter.images_per_sec_per_chip(), 2), **kv)
+        vitals["round"] = rnd_
+        vitals["loss"] = _hb_float(loss_)
+        vitals["status"] = cls or "ok"
+        if g_round is not None:
+            g_round.set(rnd_)
+            if math.isfinite(loss_):
+                g_loss.set(loss_)
+        if tracer is not None:
+            tracer.instant("flush", round=rnd_, loss=_hb_float(loss_))
         beat(rnd_, status=cls or "ok", force=(cls not in (None, "ok")),
              last_loss=_hb_float(loss_))
         if cls == "spike" and not monitor.rollback_needed:
             # every supervisor DECISION is an event record: this spike was
             # skipped (excluded from the stats window, training continues)
             log.event(rnd_, "spike_skip", loss=loss_)
+        _last_flush_ms[0] = (time.perf_counter() - t_flush0) * 1e3
 
     # one-deep host prefetch: round R+1 is sampled/decoded/preprocessed on
     # this thread pool while round R's XLA program runs. The "sample" phase
@@ -447,6 +529,17 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         beat(ck_round, status="rollback", force=True, reason=reason)
         return state, ck_round
 
+    # per-round phase deltas for the step-time breakdown rows: the phase
+    # timers accumulate forever; this tracks the last-seen totals so each
+    # round's record carries only its own share
+    last_tot: Dict[str, float] = {}
+
+    def _phase_delta(name: str) -> float:
+        cur = timers.total.get(name, 0.0)
+        d = cur - last_tot.get(name, 0.0)
+        last_tot[name] = cur
+        return d
+
     log_every = max(1, cfg.log_every)
     rnd = start_round
     loop_completed = False  # set on the normal exit path only: the
@@ -507,8 +600,26 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
             round_dt = timers.total["train_round"] - before
             n_images = cfg.tau * cfg.local_batch * n_dev
             meter.add(n_images, round_dt)
+            breakdown = None
+            if cfg.telemetry:
+                d_sample = _phase_delta("sample")
+                d_h2d = _phase_delta("h2d")
+                d_disp = _phase_delta("dispatch")
+                # checkpoint stage-1 accrues AFTER the record is appended,
+                # so the delta seen here is the PREVIOUS round's fetch —
+                # honest attribution: that stall delayed THIS round
+                d_ck = _phase_delta("checkpoint")
+                breakdown = {
+                    "data": d_sample, "h2d": d_h2d,
+                    # trainers without the h2d/dispatch split (GraphTrainer)
+                    # report the whole timed round
+                    "round": d_disp if d_disp > 0 else round_dt,
+                    "ckpt_fetch": d_ck}
+            if c_rounds is not None:
+                c_rounds.inc()
             deferred.append((rnd, loss, probe_val,
-                             getattr(trainer, "last_health", None)))
+                             getattr(trainer, "last_health", None),
+                             breakdown))
 
             if cfg.checkpoint_dir and cfg.checkpoint_every and \
                     (rnd + 1) % cfg.checkpoint_every == 0:
@@ -555,21 +666,42 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         prefetch.shutdown(wait=False, cancel_futures=True)
         if hasattr(source, "close"):
             source.close()
-        if ck_writer is not None:
-            # loop exit barriers on the in-flight write: a RUNNING stage-2
-            # write always completes (the final checkpoint below, and any
-            # reader of the dir after train() returns, must see a settled
-            # store). On the normal path a failed background write raises
-            # here; when another exception is already propagating
-            # (loop_completed is still False) it must not be masked — log
-            # and let the original win.
-            try:
-                ck_writer.close(wait=True)
-            except Exception as e:
-                if loop_completed:
-                    raise
-                log.log(f"background checkpoint write failed during "
-                        f"abort: {e}")
+        try:
+            if ck_writer is not None:
+                # loop exit barriers on the in-flight write: a RUNNING
+                # stage-2 write always completes (the final checkpoint
+                # below, and any reader of the dir after train() returns,
+                # must see a settled store). On the normal path a failed
+                # background write raises here; when another exception is
+                # already propagating (loop_completed is still False) it
+                # must not be masked — log and let the original win.
+                try:
+                    ck_writer.close(wait=True)
+                except Exception as e:
+                    if loop_completed:
+                        raise
+                    log.log(f"background checkpoint write failed during "
+                            f"abort: {e}")
+        finally:
+            # obs teardown runs EVEN when the writer's failure is
+            # re-raising: the port must unbind and the process-global
+            # tracer must uninstall (a leaked active tracer would keep
+            # swallowing every later span in this process)
+            if status_srv is not None:
+                status_srv.stop()
+            if tracer is not None:
+                # stop AFTER the writer drained: the final
+                # checkpoint_write span must land on its lane. Writing
+                # the file is observability, not training — it degrades,
+                # never raises.
+                obs_trace.stop_tracing()
+                try:
+                    n_ev = tracer.write(cfg.trace_out)
+                    log.log(f"host trace written to {cfg.trace_out} "
+                            f"({n_ev} events; load in Perfetto or "
+                            f"chrome://tracing)")
+                except OSError as e:
+                    log.log(f"host trace write failed: {e}")
 
     if cfg.checkpoint_dir and start_round < cfg.max_rounds:
         # start_round >= max_rounds means the loop ran ZERO rounds (a

@@ -1,0 +1,38 @@
+"""sparknet_tpu.obs — unified telemetry: one registry, one exporter, one
+cross-thread trace timeline.
+
+Three pieces (ROADMAP "Dapper-tradition observability"):
+
+  - `registry`: a thread-safe metrics registry (counters / gauges /
+    histograms with labels) every subsystem registers into —
+    PhaseTimers, ThroughputMeter, LatencyStats, FillMeter, the health
+    supervisor, the checkpoint writer, the serve batcher — replacing
+    their private ad-hoc state-reporting paths, plus the Prometheus text
+    exposition renderer.
+  - `http.StatusServer`: /metrics (Prometheus), /healthz, /status — the
+    SAME server for the training process (`RunConfig.status_port`) and
+    the inference server, so train and serve share one metric-name
+    schema.
+  - `trace`: `span("name")` host-side spans with per-thread lanes,
+    written as Chrome-trace-event JSON (`--trace-out`), showing where a
+    round's wall clock went across the round loop, the prefetch thread,
+    the async checkpoint writer, and the serve worker — the picture the
+    device-only `jax.profiler` trace cannot draw.
+
+`meta.run_metadata()` stamps artifacts (BENCH_*.json) and the
+`sparknet_build_info` gauge with provenance; `summary` is the
+`sparknet-metrics` JSONL reader.
+"""
+from .registry import (DEFAULT_BUCKETS, Metric, MetricsRegistry,
+                       default_registry)
+from .http import StatusServer
+from .meta import register_build_info, run_metadata
+from .trace import (Tracer, active_tracer, span, start_tracing,
+                    stop_tracing, tracing)
+
+__all__ = [
+    "DEFAULT_BUCKETS", "Metric", "MetricsRegistry", "default_registry",
+    "StatusServer", "register_build_info", "run_metadata",
+    "Tracer", "active_tracer", "span", "start_tracing", "stop_tracing",
+    "tracing",
+]

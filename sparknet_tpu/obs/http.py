@@ -1,0 +1,98 @@
+"""One small HTTP status server for BOTH roles (train and serve).
+
+Endpoints:
+  /metrics   Prometheus text exposition rendered from the process's
+             MetricsRegistry (text/plain; version=0.0.4) — the scrape
+             surface, one metric-name schema for trainer and server.
+  /healthz   {"status": "ok"|"unhealthy", ...} with 200/503 — liveness,
+             from a caller-supplied probe.
+  /status    free-form JSON vitals (the serve status dict, the trainer's
+             round/loss view) — the human-curl surface the old serve-only
+             /metrics JSON used to be.
+
+The server runs on its own daemon threads (ThreadingHTTPServer) and every
+handler reads CONSISTENT snapshots: the registry renders under its lock,
+and the healthz/status callables are expected to read locked snapshots
+too (see utils/metrics.py) — never live mutating attributes.
+
+Port 0 binds an ephemeral port (tests, and multi-process hosts); the bound
+address is `StatusServer.address`.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from .registry import MetricsRegistry
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class StatusServer:
+    """Threaded HTTP server for /metrics, /healthz, /status."""
+
+    def __init__(self, port: int, registry: Optional[MetricsRegistry] = None,
+                 healthz: Optional[Callable[[], Tuple[bool,
+                                                      Dict[str, Any]]]] = None,
+                 status: Optional[Callable[[], Dict[str, Any]]] = None,
+                 host: str = "127.0.0.1"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        owner = self
+        self.registry = registry
+        self.healthz = healthz
+        self.status = status
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib casing)
+                try:
+                    if self.path.startswith("/metrics"):
+                        if owner.registry is None:
+                            self._reply(404, '{"error": "no registry"}')
+                            return
+                        self._reply(200, owner.registry.render_prometheus(),
+                                    content_type=PROM_CONTENT_TYPE)
+                    elif self.path.startswith("/healthz"):
+                        ok, body = (owner.healthz() if owner.healthz
+                                    else (True, {}))
+                        body = {"status": "ok" if ok else "unhealthy",
+                                **body}
+                        self._reply(200 if ok else 503, json.dumps(body))
+                    elif self.path.startswith("/status"):
+                        body = owner.status() if owner.status else {}
+                        self._reply(200, json.dumps(body))
+                    else:
+                        self._reply(404, '{"error": "not found"}')
+                except Exception as e:  # a broken probe must 500, not hang
+                    try:
+                        self._reply(500, json.dumps({"error": str(e)}))
+                    except Exception:
+                        pass
+
+            def _reply(self, code: int, body: str,
+                       content_type: str = "application/json") -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):  # quiet: scrapes are not log news
+                pass
+
+        self._http = ThreadingHTTPServer((host, port), Handler)
+        self._http.daemon_threads = True
+        self._thread = threading.Thread(target=self._http.serve_forever,
+                                        name="obs-status", daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        """(host, port) actually bound (port 0 resolves here)."""
+        return self._http.server_address[:2]
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()

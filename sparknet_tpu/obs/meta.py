@@ -1,0 +1,66 @@
+"""Run metadata: who/what/where produced an artifact.
+
+`BENCH_*.json` rows were previously bare numbers — a trajectory of
+measurements with nothing saying which jax version, backend, device kind,
+host count, or commit produced each one, so rows from different rounds
+were not comparable and the bench trajectory stayed empty. Every bench
+artifact now carries `run_metadata()`, and the same dict is exported as
+the `sparknet_build_info` gauge (value 1, metadata as labels — the
+Prometheus *_info idiom) so a scrape identifies its process too.
+"""
+from __future__ import annotations
+
+import os
+import platform
+import subprocess
+import time
+from typing import Any, Dict
+
+from .registry import MetricsRegistry
+
+
+def git_rev(cwd: str = None) -> str:
+    """Short git revision of the source tree, or 'unknown' outside a
+    checkout (an installed wheel, a stripped container)."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=cwd or os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+        rev = out.stdout.strip()
+        return rev if out.returncode == 0 and rev else "unknown"
+    except Exception:
+        return "unknown"
+
+
+def run_metadata() -> Dict[str, Any]:
+    """One flat dict of run provenance. jax is imported lazily and its
+    absence degrades the dict, never raises (the summary CLI must work on
+    a laptop without an accelerator stack)."""
+    meta: Dict[str, Any] = {
+        "ts": round(time.time(), 3),
+        "python": platform.python_version(),
+        "hostname": platform.node(),
+        "git_rev": git_rev(),
+    }
+    try:
+        import jax
+
+        meta["jax_version"] = jax.__version__
+        meta["backend"] = jax.default_backend()
+        meta["device_kind"] = jax.devices()[0].device_kind
+        meta["n_devices"] = jax.device_count()
+        meta["process_count"] = jax.process_count()
+    except Exception as e:
+        meta["jax_error"] = str(e)
+    return meta
+
+
+def register_build_info(registry: MetricsRegistry) -> None:
+    """Export run provenance as the `sparknet_build_info` gauge."""
+    m = run_metadata()
+    labels = {k: str(m.get(k, "unknown"))
+              for k in ("jax_version", "backend", "device_kind", "git_rev")}
+    registry.gauge("sparknet_build_info",
+                   "constant 1; run provenance in the labels",
+                   labels=tuple(sorted(labels))).set(1, **labels)

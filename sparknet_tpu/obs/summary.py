@@ -1,0 +1,226 @@
+"""`sparknet-metrics` — summarize a metrics JSONL on the console.
+
+The metrics JSONL (utils/logger.py) is the run's machine-readable record:
+loss rows, eval rows, per-round step-time breakdowns (t_*_ms fields), and
+the health supervisor's event audit trail. Reading it used to mean ad-hoc
+jq one-liners documented nowhere; this tool is the blessed reader:
+
+    sparknet-metrics training_metrics_1234.jsonl
+    sparknet-metrics --tail 20 --json run/*.jsonl
+
+prints the loss-curve tail, a step-time breakdown table (where each
+round's wall clock went: data / H2D / compiled round / collect /
+checkpoint-fetch / log), the eval trajectory, and every event record
+(spike_skip, rollback, anomalous_checkpoint, ...) next to the losses they
+explain. Multiple files merge on the wall-clock `ts` field — a trainer
+JSONL and its serve JSONL interleave into one timeline.
+
+`--selfcheck` runs a 3-round synthetic training first and summarizes its
+freshly written JSONL (the CI step: the tooling cannot rot against the
+live schema).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import shutil
+import sys
+from typing import Any, Dict, List, Optional
+
+#: step-time breakdown columns, in pipeline order (emitted by run_loop)
+BREAKDOWN_FIELDS = ("t_data_ms", "t_h2d_ms", "t_round_ms", "t_collect_ms",
+                    "t_ckpt_fetch_ms", "t_log_ms")
+
+
+def load_records(paths: List[str]) -> List[Dict[str, Any]]:
+    recs: List[Dict[str, Any]] = []
+    for path in paths:
+        with open(path) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    recs.append(json.loads(line))
+                except json.JSONDecodeError as e:
+                    print(f"{path}:{i + 1}: skipping unparseable line "
+                          f"({e})", file=sys.stderr)
+    # merge multiple processes' files on the wall-clock ts (satellite of
+    # the same PR); files predating the ts field fall back to input order
+    if len(paths) > 1 and all("ts" in r for r in recs):
+        recs.sort(key=lambda r: r["ts"])
+    return recs
+
+
+def _mean(xs: List[float]) -> Optional[float]:
+    xs = [x for x in xs if x is not None and math.isfinite(x)]
+    return sum(xs) / len(xs) if xs else None
+
+
+def summarize(recs: List[Dict[str, Any]], tail: int = 10) -> Dict[str, Any]:
+    """The machine half (--json): everything the text report prints."""
+    loss_rows = [r for r in recs if "loss" in r and "event" not in r]
+    eval_rows = [r for r in recs if "test_accuracy" in r]
+    events = [r for r in recs if "event" in r]
+    losses = [r["loss"] for r in loss_rows if r.get("loss") is not None]
+    out: Dict[str, Any] = {
+        "records": len(recs),
+        "rounds": len(loss_rows),
+        "events": len(events),
+        "loss_first": losses[0] if losses else None,
+        "loss_final": losses[-1] if losses else None,
+        "loss_min": min(losses) if losses else None,
+        "loss_tail": [
+            {"step": r["step"], "loss": r.get("loss"),
+             **({"health": r["health"]} if "health" in r else {})}
+            for r in loss_rows[-tail:]],
+        "eval_tail": [{"step": r["step"], "test_accuracy":
+                       r["test_accuracy"]} for r in eval_rows[-tail:]],
+        "images_per_sec_per_chip": _mean(
+            [r.get("images_per_sec_per_chip") for r in loss_rows[-tail:]]),
+        "event_trail": [
+            {k: v for k, v in r.items() if k not in ("t", "ts")}
+            for r in events],
+    }
+    breakdown: Dict[str, Any] = {}
+    for fld in BREAKDOWN_FIELDS:
+        vals = [r[fld] for r in loss_rows if fld in r]
+        if vals:
+            breakdown[fld] = {"mean_ms": round(_mean(vals), 3),
+                              "max_ms": round(max(vals), 3),
+                              "total_s": round(sum(vals) / 1e3, 3)}
+    if breakdown:
+        out["step_time_breakdown"] = breakdown
+    return out
+
+
+def format_text(s: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    lines.append(f"records: {s['records']}  loss rows: {s['rounds']}  "
+                 f"events: {s['events']}")
+    if s["loss_final"] is not None:
+        lines.append(f"loss: first {s['loss_first']:.4f}  min "
+                     f"{s['loss_min']:.4f}  final {s['loss_final']:.4f}")
+    if s.get("images_per_sec_per_chip"):
+        lines.append(f"throughput (tail mean): "
+                     f"{s['images_per_sec_per_chip']:.1f} img/s/chip")
+    if s["loss_tail"]:
+        lines.append("")
+        lines.append("loss tail:")
+        for r in s["loss_tail"]:
+            flag = f"  [{r['health']}]" if "health" in r else ""
+            loss = ("nan/inf" if r["loss"] is None
+                    else f"{r['loss']:.4f}")
+            lines.append(f"  round {r['step']:>6}  loss {loss}{flag}")
+    if s["eval_tail"]:
+        lines.append("")
+        lines.append("eval tail:")
+        for r in s["eval_tail"]:
+            lines.append(f"  round {r['step']:>6}  accuracy "
+                         f"{r['test_accuracy']:.4f}")
+    bd = s.get("step_time_breakdown")
+    if bd:
+        lines.append("")
+        lines.append("step-time breakdown (per round):")
+        lines.append(f"  {'phase':<14}{'mean ms':>10}{'max ms':>10}"
+                     f"{'total s':>10}")
+        for fld, row in bd.items():
+            name = fld[2:-3]  # t_<phase>_ms
+            lines.append(f"  {name:<14}{row['mean_ms']:>10.3f}"
+                         f"{row['max_ms']:>10.3f}{row['total_s']:>10.3f}")
+    if s["event_trail"]:
+        lines.append("")
+        lines.append("health/event audit trail:")
+        for r in s["event_trail"]:
+            step = r.get("step", "?")
+            ev = r.get("event", "?")
+            rest = " ".join(f"{k}={v}" for k, v in r.items()
+                            if k not in ("step", "event"))
+            lines.append(f"  round {step:>6}  {ev}  {rest}".rstrip())
+    else:
+        lines.append("")
+        lines.append("health/event audit trail: clean (no events)")
+    return "\n".join(lines)
+
+
+def _selfcheck_jsonl() -> str:
+    """Run a tiny synthetic training (3 rounds, lenet shapes, CPU) and
+    return the metrics JSONL it wrote — the freshest possible schema."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from ..apps.train_loop import train
+    from ..data.dataset import ArrayDataset
+    from ..utils.config import RunConfig
+    from ..utils.logger import Logger
+    from ..zoo import lenet
+
+    root = tempfile.mkdtemp(prefix="sparknet-metrics-selfcheck-")
+    r = np.random.default_rng(0)
+    n, b, tau = 256, 16, 2
+    ds = ArrayDataset({
+        "data": r.standard_normal((n, 1, 28, 28)).astype(np.float32),
+        "label": r.integers(0, 10, (n, 1)).astype(np.int32)})
+    jsonl = os.path.join(root, "selfcheck_metrics.jsonl")
+    cfg = RunConfig(model="lenet", n_devices=1, local_batch=b, tau=tau,
+                    max_rounds=3, eval_every=0, workdir=root)
+    log = Logger(os.path.join(root, "selfcheck_log.txt"), echo=False,
+                 jsonl_path=jsonl)
+    try:
+        train(cfg, lenet(batch=b), ds, None, logger=log)
+    finally:
+        log.close()
+    return jsonl
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="sparknet-metrics",
+        description="Summarize sparknet_tpu metrics JSONL files: loss "
+                    "curve, step-time breakdown, health-event audit trail.")
+    p.add_argument("paths", nargs="*", help="metrics JSONL file(s); "
+                   "multiple files merge on the wall-clock ts field")
+    p.add_argument("--tail", type=int, default=10,
+                   help="rows of loss/eval tail to show (default 10)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the summary as one JSON object")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="run a 3-round synthetic training and summarize "
+                   "its fresh JSONL (CI: the tool vs the live schema)")
+    args = p.parse_args(argv)
+
+    paths: List[str] = []
+    for pat in args.paths:
+        hits = sorted(glob.glob(pat))
+        paths.extend(hits or [pat])
+    selfcheck_dir = None
+    if args.selfcheck:
+        jsonl = _selfcheck_jsonl()
+        selfcheck_dir = os.path.dirname(jsonl)
+        paths.append(jsonl)
+    if not paths:
+        p.error("no JSONL paths given (or use --selfcheck)")
+
+    try:
+        recs = load_records(paths)
+    finally:
+        if selfcheck_dir is not None:  # the run was only food for the
+            shutil.rmtree(selfcheck_dir, ignore_errors=True)  # summary
+    s = summarize(recs, tail=args.tail)
+    if args.json:
+        print(json.dumps(s))
+    else:
+        print(format_text(s))
+    if args.selfcheck and not s["rounds"]:
+        print("selfcheck: training produced no loss rows", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -153,6 +153,12 @@ class ParallelTrainer:
         #: sync.
         self.last_health: Optional[Dict[str, jax.Array]] = None
         self._lr_scale_dev: Optional[Tuple[float, jax.Array]] = None
+        #: optional PhaseTimers (utils/metrics.py): when the train loop
+        #: installs one, train_round splits its wall time into "h2d" (the
+        #: host->device batch placement in _shard_batches) and "dispatch"
+        #: (the compiled round's enqueue) — the per-round step-time
+        #: breakdown's two finest columns. None costs nothing.
+        self.phase_timers = None
         self._eval = jax.jit(
             shard_map(self._eval_impl, mesh=mesh,
                       in_specs=(dev, P(DATA_AXIS)),
@@ -520,8 +526,17 @@ class ParallelTrainer:
                 self._lr_scale_dev[0] != float(lr_scale):
             self._lr_scale_dev = (float(lr_scale),
                                   jnp.asarray(lr_scale, jnp.float32))
-        new_state, loss, health = self._round(
-            state, self._shard_batches(batches), rngs, self._lr_scale_dev[1])
+        timers = self.phase_timers
+        if timers is not None:
+            with timers.phase("h2d"):
+                sharded = self._shard_batches(batches)
+            with timers.phase("dispatch"):
+                new_state, loss, health = self._round(
+                    state, sharded, rngs, self._lr_scale_dev[1])
+        else:
+            new_state, loss, health = self._round(
+                state, self._shard_batches(batches), rngs,
+                self._lr_scale_dev[1])
         self.last_health = health or None  # {} when compute_health=False
         return new_state, loss
 

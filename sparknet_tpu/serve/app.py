@@ -18,6 +18,7 @@ Examples:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import sys
 import time
@@ -99,6 +100,10 @@ def main(argv=None) -> None:
                    "(0 = ephemeral)")
     p.add_argument("--heartbeat", default=None,
                    help="write the utils/heartbeat.py liveness file here")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="capture host-side spans (serve worker lane: "
+                   "forwards, hot swaps) as Chrome-trace-event JSON — "
+                   "merges on one timeline with a trainer's --trace-out")
     p.add_argument("--workdir", default=None,
                    help="log/JSONL directory (default $SPARKNET_TPU_HOME)")
     p.add_argument("--demo", type=int, default=None, metavar="N",
@@ -119,18 +124,22 @@ def main(argv=None) -> None:
         canary=not args.no_canary, status_port=args.status_port,
         heartbeat_path=args.heartbeat)
     server = InferenceServer(net, cfg, logger=log)
-    with server:
-        if args.demo is not None:
-            status = run_demo(server, args.demo)
-            print(json.dumps(status))
-            return
-        log.log("serving; Ctrl-C to stop")
-        try:
-            while True:
-                time.sleep(3600)
-        except KeyboardInterrupt:
-            log.log("interrupted; draining")
-            print(json.dumps(server.status()), file=sys.stderr)
+    from ..obs import trace as obs_trace
+
+    with obs_trace.tracing(args.trace_out) if args.trace_out \
+            else contextlib.nullcontext():
+        with server:
+            if args.demo is not None:
+                status = run_demo(server, args.demo)
+                print(json.dumps(status))
+                return
+            log.log("serving; Ctrl-C to stop")
+            try:
+                while True:
+                    time.sleep(3600)
+            except KeyboardInterrupt:
+                log.log("interrupted; draining")
+                print(json.dumps(server.status()), file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -50,7 +50,7 @@ class DynamicBatcher:
     """Thread-safe queue + max-batch/max-wait batch former (one consumer)."""
 
     def __init__(self, max_batch: int = 8, max_wait_s: float = 0.005,
-                 max_queue: int = 1024):
+                 max_queue: int = 1024, registry=None):
         assert max_batch >= 1 and max_queue >= max_batch
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_s)
@@ -60,6 +60,19 @@ class DynamicBatcher:
         self._nonempty = threading.Condition(self._lock)
         self._ids = itertools.count()
         self._closed = False
+        # shared-schema telemetry (obs.MetricsRegistry): accepted vs shed
+        # demand, and the live queue depth as a scrape-time gauge
+        self._c_submitted = self._c_rejected = None
+        if registry is not None:
+            self._c_submitted = registry.counter(
+                "sparknet_serve_submitted_total", "requests accepted")
+            self._c_rejected = registry.counter(
+                "sparknet_serve_queue_rejected_total",
+                "requests shed by backpressure (queue at capacity)")
+            registry.gauge(
+                "sparknet_serve_queue_depth",
+                "requests queued, not yet formed into a batch"
+            ).set_fn(self.depth)
 
     def depth(self) -> int:
         return len(self._q)  # len(deque) is atomic; hot path, no lock
@@ -73,11 +86,15 @@ class DynamicBatcher:
             if self._closed:
                 raise RuntimeError("batcher is closed")
             if len(self._q) >= self.max_queue:
+                if self._c_rejected is not None:
+                    self._c_rejected.inc()
                 raise QueueFullError(
                     f"request queue at capacity ({self.max_queue})")
             req.id = next(self._ids)
             self._q.append(req)
             self._nonempty.notify()
+        if self._c_submitted is not None:
+            self._c_submitted.inc()
         return req.future
 
     def next_batch(self, poll_s: float = 0.05

@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import trace as obs_trace
 from ..utils import checkpoint as ckpt
 from ..utils.heartbeat import HeartbeatWriter
 from ..utils.logger import Logger
@@ -95,7 +96,7 @@ class ModelManager:
                  canary_outputs: Optional[tuple] = None,
                  logger: Optional[Logger] = None,
                  heartbeat: Optional[HeartbeatWriter] = None,
-                 bad_step_retry_s: float = 30.0):
+                 bad_step_retry_s: float = 30.0, registry=None):
         if checkpoint_dir and not hasattr(net, "params"):
             raise ServeModelError(
                 "checkpoint hot-reload needs a layer-IR JaxNet (exposes "
@@ -114,6 +115,17 @@ class ModelManager:
         self.last_error: Optional[str] = None
         self._next_poll = 0.0
         self._bad: Dict[int, float] = {}  # step -> retry-not-before time
+        # shared-schema telemetry (obs.MetricsRegistry): swap outcomes and
+        # the step answering traffic right now
+        self._c_swaps = None
+        if registry is not None:
+            self._c_swaps = registry.counter(
+                "sparknet_serve_swaps_total",
+                "weight-swap attempts by outcome", labels=("outcome",))
+            registry.gauge(
+                "sparknet_serve_model_step",
+                "checkpoint step currently serving (-1 = initial weights)"
+            ).set_fn(lambda: -1 if self.step is None else self.step)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -159,20 +171,23 @@ class ModelManager:
     # -- swap machinery ------------------------------------------------------
 
     def _try_swap(self, step: int) -> bool:
-        try:
-            # full integrity path: every digest is recomputed over the
-            # fetched bytes (restore IS the verification — one read)
-            flat, got, extra = ckpt.restore_flat(self.checkpoint_dir,
-                                                 step=step)
-        except ckpt.CheckpointCorruptError as e:
-            self._reject(step, f"corrupt: {e}")
-            return False
-        except Exception as e:
-            self.last_error = f"load step {step}: {e}"
-            self._log(f"serve: could not fetch step {step} ({e}); "
-                      f"will retry")
-            return False
-        return self._install(flat, got, extra)
+        # the span puts the whole fetch+verify+install+canary on the
+        # serve worker's trace lane — the gap where no batch can run
+        with obs_trace.span("hot_swap", step=step):
+            try:
+                # full integrity path: every digest is recomputed over the
+                # fetched bytes (restore IS the verification — one read)
+                flat, got, extra = ckpt.restore_flat(self.checkpoint_dir,
+                                                     step=step)
+            except ckpt.CheckpointCorruptError as e:
+                self._reject(step, f"corrupt: {e}")
+                return False
+            except Exception as e:
+                self.last_error = f"load step {step}: {e}"
+                self._log(f"serve: could not fetch step {step} ({e}); "
+                          f"will retry")
+                return False
+            return self._install(flat, got, extra)
 
     def _install(self, flat: Dict[str, np.ndarray], step: int,
                  extra: Dict[str, Any], initial: bool = False) -> bool:
@@ -207,6 +222,8 @@ class ModelManager:
         self.step = step
         if not initial:
             self.swaps += 1
+        if self._c_swaps is not None:
+            self._c_swaps.inc(outcome="initial" if initial else "ok")
         self.last_error = None
         self._log(f"serve: weights {'loaded' if initial else 'hot-swapped'}"
                   f" from checkpoint step {step}")
@@ -222,6 +239,8 @@ class ModelManager:
 
     def _reject(self, step: int, why: str) -> None:
         self.swap_failures += 1
+        if self._c_swaps is not None:
+            self._c_swaps.inc(outcome="rejected")
         self.last_error = f"step {step}: {why}"
         self._bad[step] = time.monotonic() + self.bad_step_retry_s
         self._log(f"serve: REJECTED checkpoint step {step}: {why} — "

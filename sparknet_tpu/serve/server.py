@@ -27,7 +27,6 @@ accuracy heads; an inference client has no labels). An optional
 """
 from __future__ import annotations
 
-import json
 import threading
 import time
 from dataclasses import dataclass
@@ -35,6 +34,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import (MetricsRegistry, StatusServer, register_build_info,
+                   trace as obs_trace)
 from ..utils.heartbeat import HeartbeatWriter
 from ..utils.logger import Logger
 from ..utils.metrics import FillMeter, LatencyStats
@@ -91,12 +92,18 @@ class ServeConfig:
     checkpoint_dir: Optional[str] = None
     poll_interval_s: float = 2.0
     canary: bool = True                 # nonfinite-canary gate on swaps
-    # observability
+    # observability. status_port serves /metrics (Prometheus text from
+    # the shared obs registry — the SAME metric-name schema the training
+    # process exports), /healthz and /status (the JSON vitals dict).
+    # registry: pass a MetricsRegistry to share one registry across
+    # co-located components; None = a fresh per-server instance.
     status_port: Optional[int] = None   # None = no HTTP; 0 = ephemeral
+    status_host: str = "127.0.0.1"      # "0.0.0.0" for cross-host scrapes
     heartbeat_path: Optional[str] = None
     heartbeat_every_s: float = 10.0
     metrics_every_batches: int = 50     # JSONL cadence (0 = off)
     idle_poll_s: float = 0.05           # worker tick when the queue is idle
+    registry: Optional[MetricsRegistry] = None
 
 
 class InferenceServer:
@@ -113,11 +120,20 @@ class InferenceServer:
         assert self.buckets[-1] >= cfg.max_batch, (
             f"largest bucket {self.buckets[-1]} < max_batch "
             f"{cfg.max_batch}: a full batch would have no bucket")
+        # the shared-schema registry: every serve component registers into
+        # it and /metrics renders it (one exporter for train AND serve)
+        self.registry = cfg.registry or MetricsRegistry()
+        register_build_info(self.registry)
+        self._c_requests = self.registry.counter(
+            "sparknet_serve_requests_total", "served requests by outcome",
+            labels=("outcome",))
         self.batcher = DynamicBatcher(cfg.max_batch,
                                       max_wait_s=cfg.max_wait_ms / 1e3,
-                                      max_queue=cfg.max_queue)
+                                      max_queue=cfg.max_queue,
+                                      registry=self.registry)
         hb = (HeartbeatWriter(cfg.heartbeat_path, role="serve",
-                              interval_s=cfg.heartbeat_every_s)
+                              interval_s=cfg.heartbeat_every_s,
+                              registry=self.registry)
               if cfg.heartbeat_path else None)
         self.heartbeat = hb
         self.manager = ModelManager(
@@ -125,10 +141,12 @@ class InferenceServer:
             poll_interval_s=cfg.poll_interval_s,
             canary_batch=(zeros_batch(net, self.buckets[0])
                           if cfg.canary else None),
-            canary_outputs=cfg.outputs, logger=logger, heartbeat=hb)
-        # metrics (worker-thread-written; readers accept slight skew)
-        self.latency = LatencyStats()
-        self.fill = FillMeter()
+            canary_outputs=cfg.outputs, logger=logger, heartbeat=hb,
+            registry=self.registry)
+        # meters: worker-thread-written, internally locked — status() and
+        # the HTTP scrape read consistent snapshots, never torn state
+        self.latency = LatencyStats(registry=self.registry)
+        self.fill = FillMeter(registry=self.registry)
         self.requests_ok = 0
         self.requests_failed = 0
         self.batch_log: List[Tuple[int, int]] = []  # (n_real, bucket)
@@ -176,7 +194,7 @@ class InferenceServer:
             self._worker.join(timeout=max(drain_s, 1.0))
             self._worker = None
         if self._http is not None:
-            self._http.shutdown()
+            self._http.stop()
             self._http = None
         if self.heartbeat is not None:
             try:
@@ -195,9 +213,13 @@ class InferenceServer:
     # -- status --------------------------------------------------------------
 
     def status(self) -> Dict[str, Any]:
-        """The /metrics JSON: serving vitals in one flat dict."""
+        """The /status JSON: serving vitals in one flat dict. Every field
+        comes from a locked snapshot (FillMeter.snapshot, LatencyStats.
+        summary) or a single-writer attribute — the HTTP thread reading
+        while the worker mutates sees one consistent moment, not a mix."""
         dt = max(time.time() - self._t0, 1e-9)
         m = self.manager
+        real, padded, batches = self.fill.snapshot()
         out = {
             "role": "serve",
             "uptime_s": round(dt, 1),
@@ -205,8 +227,8 @@ class InferenceServer:
             "requests_ok": self.requests_ok,
             "requests_failed": self.requests_failed,
             "images_per_sec": round(self._images / dt, 2),
-            "batches": self.fill.batches,
-            "batch_fill_ratio": round(self.fill.ratio(), 4),
+            "batches": batches,
+            "batch_fill_ratio": round(real / padded if padded else 0.0, 4),
             "buckets": list(self.buckets),
             "model_step": m.step,
             "swaps": m.swaps,
@@ -284,6 +306,10 @@ class InferenceServer:
             self._forward_group(group)
 
     def _forward_group(self, reqs: List[ServeRequest]) -> None:
+        with obs_trace.span("forward", n=len(reqs)):
+            self._forward_group_inner(reqs)
+
+    def _forward_group_inner(self, reqs: List[ServeRequest]) -> None:
         n = len(reqs)
         bucket = next(b for b in self.buckets if b >= n)
         try:
@@ -321,11 +347,13 @@ class InferenceServer:
                                      for k, v, per_row in fields})
                 self.latency.add(now - r.t_enqueue)
             self.requests_ok += n
+            self._c_requests.inc(n, outcome="ok")
         except Exception as e:
             for r in reqs:
                 if not r.future.done():
                     r.future.set_exception(e)
             self.requests_failed += n
+            self._c_requests.inc(n, outcome="failed")
             self._log(f"serve: batch of {n} failed: {e}")
         self._images += n
         self.fill.add(n, bucket)
@@ -342,46 +370,22 @@ class InferenceServer:
         if self.log is not None:
             self.log.log(msg)
 
-    # -- /healthz HTTP -------------------------------------------------------
+    # -- status HTTP (shared obs.StatusServer) -------------------------------
 
     @property
     def status_address(self) -> Optional[Tuple[str, int]]:
         """(host, port) of the status HTTP server, once started."""
-        return None if self._http is None else self._http.server_address
+        return None if self._http is None else self._http.address
 
     def _start_http(self, port: int) -> None:
-        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
-        server = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (stdlib casing)
-                if self.path.startswith("/healthz"):
-                    ok = server.healthy()
-                    body = json.dumps(
-                        {"status": "ok" if ok else "unhealthy",
-                         "model_step": server.manager.step,
-                         "queue_depth": server.batcher.depth()})
-                    self._reply(200 if ok else 503, body)
-                elif self.path.startswith("/metrics"):
-                    self._reply(200, json.dumps(server.status()))
-                else:
-                    self._reply(404, '{"error": "not found"}')
-
-            def _reply(self, code: int, body: str) -> None:
-                data = body.encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-            def log_message(self, *a):  # quiet: the JSONL is the record
-                pass
-
-        self._http = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-        self._http.daemon_threads = True
-        threading.Thread(target=self._http.serve_forever,
-                         name="serve-status", daemon=True).start()
-        self._log(f"serve: status at http://127.0.0.1:"
-                  f"{self._http.server_address[1]}/healthz")
+        # the SAME server class the training process runs: /metrics is
+        # Prometheus text from the shared registry (one metric-name
+        # schema for both roles); the old JSON vitals live at /status
+        self._http = StatusServer(
+            port, self.registry, host=self.cfg.status_host,
+            healthz=lambda: (self.healthy(),
+                             {"model_step": self.manager.step,
+                              "queue_depth": self.batcher.depth()}),
+            status=self.status)
+        self._log(f"serve: status at http://{self._http.address[0]}:"
+                  f"{self._http.address[1]}/healthz")

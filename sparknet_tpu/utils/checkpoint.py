@@ -54,6 +54,7 @@ import json
 import os
 import shutil
 import tempfile
+import time
 import urllib.error
 import warnings
 from concurrent.futures import ThreadPoolExecutor
@@ -632,9 +633,24 @@ class AsyncCheckpointWriter:
     store (the in-flight write may be the newest verified checkpoint, and
     reading mid-write would race the commit marker)."""
 
-    def __init__(self):
+    def __init__(self, registry=None):
         self._ex = ThreadPoolExecutor(1, thread_name_prefix="ckpt-write")
         self._pending = None
+        # shared-schema telemetry (obs): write outcomes and durations, and
+        # the submit-side backpressure stall the round loop actually feels
+        self._c_writes = self._h_write = self._h_stall = None
+        if registry is not None:
+            self._c_writes = registry.counter(
+                "sparknet_checkpoint_writes_total",
+                "background checkpoint writes by outcome",
+                labels=("outcome",))
+            self._h_write = registry.histogram(
+                "sparknet_checkpoint_write_seconds",
+                "stage-2 serialize+digest+persist duration")
+            self._h_stall = registry.histogram(
+                "sparknet_checkpoint_submit_stall_seconds",
+                "round-loop blocking wait for the previous in-flight "
+                "write at submit")
 
     @property
     def in_flight(self) -> bool:
@@ -643,8 +659,29 @@ class AsyncCheckpointWriter:
     def submit(self, fn, *args, **kwargs) -> None:
         """Queue one write; blocks until the PREVIOUS one finished (and
         re-raises its exception, if any)."""
+        t0 = time.perf_counter()
         self.wait()
-        self._pending = self._ex.submit(fn, *args, **kwargs)
+        if self._h_stall is not None:
+            self._h_stall.observe(time.perf_counter() - t0)
+
+        def run():
+            # the span puts stage 2 on its own `ckpt-write_0` lane in the
+            # trace timeline — the cross-thread view of what the round
+            # loop overlapped
+            t1 = time.perf_counter()
+            from ..obs import trace as _trace
+            try:
+                with _trace.span("checkpoint_write"):
+                    fn(*args, **kwargs)
+            except BaseException:
+                if self._c_writes is not None:
+                    self._c_writes.inc(outcome="error")
+                raise
+            if self._c_writes is not None:
+                self._c_writes.inc(outcome="ok")
+                self._h_write.observe(time.perf_counter() - t1)
+
+        self._pending = self._ex.submit(run)
 
     def wait(self) -> None:
         """Block until the in-flight write (if any) completes; re-raise

@@ -69,6 +69,28 @@ class RunConfig:
     # schema with role="serve".
     heartbeat_path: Optional[str] = None
     heartbeat_every_s: float = 10.0
+    # unified telemetry (sparknet_tpu.obs). telemetry=True builds a
+    # per-run MetricsRegistry every meter/supervisor/writer registers
+    # into and emits per-round step-time breakdown fields (t_data_ms /
+    # t_h2d_ms / t_round_ms / t_collect_ms / t_ckpt_fetch_ms / t_log_ms)
+    # in the metrics JSONL; False restores the pre-obs behavior (the
+    # bench.py --obs "disabled" arm). status_port serves /metrics
+    # (Prometheus text, same name schema as serve), /healthz and /status
+    # from the TRAINING process (process 0; 0 = ephemeral — the bound
+    # address lands on cfg.status_address). trace_out captures host-side
+    # spans (round loop / prefetch / async checkpoint writer lanes) into
+    # a Chrome-trace-event JSON loadable in Perfetto next to the
+    # jax.profiler device trace.
+    # status_host defaults to loopback (scrape via SSH tunnel / sidecar);
+    # set "0.0.0.0" for a cross-host Prometheus to reach it directly.
+    # status_address is OUTPUT, not input: run_loop writes the bound
+    # (host, port) here once the server is up (port 0 resolves to the
+    # ephemeral port) — leave it None in configs.
+    telemetry: bool = True
+    status_port: Optional[int] = None
+    status_host: str = "127.0.0.1"
+    status_address: Optional[Tuple[str, int]] = None
+    trace_out: Optional[str] = None
     # logging. None -> $SPARKNET_TPU_HOME, else "." (the reference logged
     # to $SPARKNET_HOME/training_log_<ms>.txt); tests set the env var to a
     # tmp dir so stray default-config runs never litter the repo root

@@ -113,7 +113,7 @@ class HealthMonitor:
     decision without extra communication.
     """
 
-    def __init__(self, cfg: HealthConfig):
+    def __init__(self, cfg: HealthConfig, registry=None):
         self.cfg = cfg
         self._window: deque = deque(maxlen=max(2, cfg.window))
         self._consecutive_spikes = 0
@@ -121,6 +121,19 @@ class HealthMonitor:
         self.last_anomaly_round: Optional[int] = None
         self.rollbacks = 0
         self.counts = {OK: 0, SPIKE: 0, NONFINITE: 0}
+        # shared-schema telemetry (obs.MetricsRegistry): classification
+        # counts and the rollback budget as scrapeable counters/gauges
+        self._c_rounds = self._c_rollbacks = self._g_gnorm = None
+        if registry is not None:
+            self._c_rounds = registry.counter(
+                "sparknet_health_rounds_total",
+                "rounds by health classification", labels=("cls",))
+            self._c_rollbacks = registry.counter(
+                "sparknet_health_rollbacks_total",
+                "recoveries consumed from the rollback budget")
+            self._g_gnorm = registry.gauge(
+                "sparknet_health_grad_norm",
+                "last flushed global gradient norm")
 
     # -- rolling robust statistics -------------------------------------------
 
@@ -162,6 +175,10 @@ class HealthMonitor:
                     sigma, 1e-3 * max(abs(med), 1.0)):
                 cls = SPIKE
         self.counts[cls] += 1
+        if self._c_rounds is not None:
+            self._c_rounds.inc(cls=cls)
+            if grad_norm is not None and _is_finite(grad_norm):
+                self._g_gnorm.set(grad_norm)
         if cls == OK:
             self._window.append(float(loss))
             self._consecutive_spikes = 0
@@ -191,6 +208,8 @@ class HealthMonitor:
         # checkpoints anomalous for an incident that was rolled away
         self.last_anomaly_round = None
         self.rollbacks += 1
+        if self._c_rollbacks is not None:
+            self._c_rollbacks.inc()
         if self.rollbacks > max(0, self.cfg.max_rollbacks):
             raise TrainingHealthError(
                 f"training health: rollback budget exhausted "

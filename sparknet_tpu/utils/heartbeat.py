@@ -40,7 +40,7 @@ class HeartbeatWriter:
     """Throttled atomic writer of the heartbeat schema above."""
 
     def __init__(self, path: str, role: str = "train",
-                 interval_s: float = 10.0):
+                 interval_s: float = 10.0, registry=None):
         self.path = path
         self.role = role
         self.interval_s = float(interval_s)
@@ -48,6 +48,17 @@ class HeartbeatWriter:
         self._last_status: Optional[str] = None
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
+        # registry mirror (obs): a scraper that cannot reach the file —
+        # Prometheus across hosts — still sees beat freshness and status
+        self._c_beats = self._g_ts = None
+        if registry is not None:
+            self._c_beats = registry.counter(
+                "sparknet_heartbeat_beats_total",
+                "heartbeat file writes", labels=("role",))
+            self._g_ts = registry.gauge(
+                "sparknet_heartbeat_timestamp_seconds",
+                "epoch seconds of the last beat (staleness = now - this)",
+                labels=("role",))
 
     def beat(self, step: int, status: str = "ok", rollbacks: int = 0,
              force: bool = False, **extra: Any) -> bool:
@@ -77,6 +88,9 @@ class HeartbeatWriter:
             raise
         self._last_t = now
         self._last_status = status
+        if self._c_beats is not None:
+            self._c_beats.inc(role=self.role)
+            self._g_ts.set(now, role=self.role)
         return True
 
 

@@ -44,10 +44,19 @@ class Logger:
             print(line, file=sys.stderr, flush=True)
 
     def metrics(self, step: int, **kv: Any) -> None:
-        """One JSONL record: {"step": ..., "t": ..., **metrics}."""
+        """One JSONL record: {"step": ..., "t": ..., "ts": ..., **metrics}.
+
+        `t` is run-relative (human diffing within one file); `ts` is
+        wall-clock epoch seconds, so JSONLs from different PROCESSES — a
+        trainer, its serve fleet, the checkpoint writer's events — merge
+        on one timeline (`sparknet-metrics a.jsonl b.jsonl` sorts on it,
+        and it matches the trace timeline's epoch-anchored microseconds).
+        """
         if self._jsonl:
+            now = time.time()
             rec: Dict[str, Any] = {"step": step,
-                                   "t": round(time.time() - self.t0, 3)}
+                                   "t": round(now - self.t0, 3),
+                                   "ts": round(now, 3)}
             rec.update({k: _json_safe(float(v) if hasattr(v, "__float__")
                                       else v)
                         for k, v in kv.items()})

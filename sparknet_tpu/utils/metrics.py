@@ -1,70 +1,135 @@
-"""Metrics registry + phase timers.
+"""Metrics meters + phase timers, registry-backed.
 
 Replaces the reference's ad-hoc stdout spans (`transformInto took ...`,
 `ForwardBackward took ...` at `libs/CaffeNet.scala:113-120`; `stuff took /
 iters took` in the apps) with named accumulating timers and a throughput
 meter (images/sec/chip — the BASELINE.md headline unit). `LatencyStats` and
 `FillMeter` are the serving side's additions: request-latency quantiles and
-the dynamic batcher's fill ratio (sparknet_tpu/serve surfaces both through
-its /metrics status and the metrics JSONL).
+the dynamic batcher's fill ratio.
+
+Since the obs PR these meters are the WRITE-side convenience layer over
+`sparknet_tpu.obs.MetricsRegistry`: constructed with a registry they also
+register the shared-schema metrics (sparknet_*_phase_seconds_total,
+sparknet_serve_request_latency_seconds, ...) and update them on every
+mutation, so /metrics on the train and serve status servers render from
+one source of truth. They also carry their own locks: `summary()` /
+`snapshot()` readers on the HTTP thread get a CONSISTENT view of state a
+worker thread is mutating (the old live-attribute reads could tear — a
+sorted() over a deque being appended raises mid-iteration).
+
+`PhaseTimers.phase(...)` additionally emits a host-side trace span
+(obs.trace) — when a tracer is active every timed phase becomes a lane
+entry in the Chrome trace timeline for free.
 """
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
+
+from ..obs import trace as _trace
+from ..obs.registry import MetricsRegistry
 
 
 class PhaseTimers:
-    """Accumulating named wall-clock spans (per-phase step breakdown)."""
+    """Accumulating named wall-clock spans (per-phase step breakdown).
 
-    def __init__(self):
+    With a registry, each phase exit also feeds the counters
+    `<prefix>_phase_seconds_total{phase=...}` and
+    `<prefix>_phase_count_total{phase=...}`; an active tracer gets the
+    phase as a span on the calling thread's lane."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 prefix: str = "sparknet_train"):
         self.total: Dict[str, float] = {}
         self.count: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._c_seconds = self._c_count = None
+        if registry is not None:
+            self._c_seconds = registry.counter(
+                f"{prefix}_phase_seconds_total",
+                "wall seconds accumulated per host-side phase",
+                labels=("phase",))
+            self._c_count = registry.counter(
+                f"{prefix}_phase_count_total",
+                "entries per host-side phase", labels=("phase",))
 
     @contextmanager
     def phase(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            self.total[name] = self.total.get(name, 0.0) + dt
-            self.count[name] = self.count.get(name, 0) + 1
+        with _trace.span(name):
+            t0 = time.perf_counter()
+            try:
+                yield
+            finally:
+                dt = time.perf_counter() - t0
+                with self._lock:
+                    self.total[name] = self.total.get(name, 0.0) + dt
+                    self.count[name] = self.count.get(name, 0) + 1
+                if self._c_seconds is not None:
+                    self._c_seconds.inc(dt, phase=name)
+                    self._c_count.inc(1, phase=name)
 
     def mean(self, name: str) -> float:
-        return self.total.get(name, 0.0) / max(self.count.get(name, 0), 1)
+        with self._lock:
+            return self.total.get(name, 0.0) / max(self.count.get(name, 0),
+                                                   1)
 
     def summary(self) -> Dict[str, float]:
-        return {f"{k}_mean_s": round(self.mean(k), 6) for k in self.total}
+        with self._lock:
+            names = list(self.total)
+        return {f"{k}_mean_s": round(self.mean(k), 6) for k in names}
 
     def reset(self) -> None:
-        self.total.clear()
-        self.count.clear()
+        with self._lock:
+            self.total.clear()
+            self.count.clear()
 
 
 class ThroughputMeter:
     """images/sec (/chip if n_chips given), over a sliding accumulation."""
 
-    def __init__(self, n_chips: int = 1):
+    def __init__(self, n_chips: int = 1,
+                 registry: Optional[MetricsRegistry] = None,
+                 prefix: str = "sparknet_train"):
         self.n_chips = n_chips
         self.images = 0
         self.seconds = 0.0
+        self._lock = threading.Lock()
+        self._c_images = self._g_ips = None
+        if registry is not None:
+            self._c_images = registry.counter(
+                f"{prefix}_images_total", "examples trained/served")
+            self._g_ips = registry.gauge(
+                f"{prefix}_images_per_sec_per_chip",
+                "throughput over the accumulation window")
 
     def add(self, n_images: int, seconds: float) -> None:
-        self.images += n_images
-        self.seconds += seconds
+        with self._lock:
+            self.images += n_images
+            self.seconds += seconds
+        if self._c_images is not None:
+            self._c_images.inc(n_images)
+            self._g_ips.set(self.images_per_sec_per_chip())
 
     def images_per_sec(self) -> float:
-        return self.images / self.seconds if self.seconds else 0.0
+        with self._lock:
+            return self.images / self.seconds if self.seconds else 0.0
 
     def images_per_sec_per_chip(self) -> float:
         return self.images_per_sec() / self.n_chips
 
     def reset(self) -> None:
-        self.images = 0
-        self.seconds = 0.0
+        with self._lock:
+            self.images = 0
+            self.seconds = 0.0
+
+
+def _rank(xs, q: float) -> float:
+    """Nearest-rank order statistic over sorted xs (non-empty)."""
+    i = min(len(xs) - 1, max(0, int(q * len(xs))))
+    return xs[i]
 
 
 class LatencyStats:
@@ -72,36 +137,52 @@ class LatencyStats:
     observations. A bounded deque, not a histogram: serving windows are a
     few thousand requests, where exact order statistics are cheaper than
     tuning bucket boundaries, and the window naturally ages out a warmup
-    or a transient stall instead of averaging it into eternity."""
+    or a transient stall instead of averaging it into eternity. (The
+    registry half DOES get a fixed-bucket histogram —
+    `<name>` in seconds — because Prometheus quantiles are computed
+    server-side from cumulative buckets.)"""
 
-    def __init__(self, window: int = 4096):
+    def __init__(self, window: int = 4096,
+                 registry: Optional[MetricsRegistry] = None,
+                 name: str = "sparknet_serve_request_latency_seconds"):
         self._obs: deque = deque(maxlen=max(2, window))
+        self._lock = threading.Lock()
         self.count = 0
+        self._hist = None
+        if registry is not None:
+            self._hist = registry.histogram(
+                name, "request latency, submit to response")
 
     def add(self, seconds: float) -> None:
-        self._obs.append(float(seconds))
-        self.count += 1
+        with self._lock:
+            self._obs.append(float(seconds))
+            self.count += 1
+        if self._hist is not None:
+            self._hist.observe(seconds)
 
     def quantile(self, q: float) -> Optional[float]:
         """Exact order statistic over the window (nearest-rank), or None
         with no observations."""
-        if not self._obs:
-            return None
-        xs = sorted(self._obs)
-        i = min(len(xs) - 1, max(0, int(q * len(xs))))
-        return xs[i]
+        with self._lock:
+            xs = sorted(self._obs)
+        return _rank(xs, q) if xs else None
 
     def summary(self) -> Dict[str, Optional[float]]:
-        out: Dict[str, Optional[float]] = {"n": self.count}
+        # ONE consistent copy for all three quantiles: a scrape racing the
+        # worker's add() must not see p50 and p99 from different windows
+        with self._lock:
+            xs = sorted(self._obs)
+            n = self.count
+        out: Dict[str, Optional[float]] = {"n": n}
         for name, q in (("p50_ms", 0.50), ("p90_ms", 0.90),
                         ("p99_ms", 0.99)):
-            v = self.quantile(q)
-            out[name] = None if v is None else round(v * 1e3, 3)
+            out[name] = round(_rank(xs, q) * 1e3, 3) if xs else None
         return out
 
     def reset(self) -> None:
-        self._obs.clear()
-        self.count = 0
+        with self._lock:
+            self._obs.clear()
+            self.count = 0
 
 
 class FillMeter:
@@ -110,18 +191,44 @@ class FillMeter:
     its bucket's full width; low fill at high offered load means the
     batcher is flushing early (deadline too tight or buckets too big)."""
 
-    def __init__(self):
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 prefix: str = "sparknet_serve_batch"):
         self.real = 0
         self.padded = 0
         self.batches = 0
+        self._lock = threading.Lock()
+        self._c_rows = self._c_batches = self._g_fill = None
+        if registry is not None:
+            self._c_rows = registry.counter(
+                f"{prefix}_rows_total",
+                "batch rows by kind (real examples vs padding slots)",
+                labels=("kind",))
+            self._c_batches = registry.counter(
+                f"{prefix}es_total", "compiled forwards run")
+            self._g_fill = registry.gauge(
+                f"{prefix}_fill_ratio",
+                "real rows / padded bucket slots, cumulative")
 
     def add(self, n_real: int, bucket: int) -> None:
-        self.real += int(n_real)
-        self.padded += int(bucket)
-        self.batches += 1
+        with self._lock:
+            self.real += int(n_real)
+            self.padded += int(bucket)
+            self.batches += 1
+        if self._c_rows is not None:
+            self._c_rows.inc(int(n_real), kind="real")
+            self._c_rows.inc(int(bucket) - int(n_real), kind="padding")
+            self._c_batches.inc()
+            self._g_fill.set(self.ratio())
 
     def ratio(self) -> float:
-        return self.real / self.padded if self.padded else 0.0
+        with self._lock:
+            return self.real / self.padded if self.padded else 0.0
+
+    def snapshot(self) -> Tuple[int, int, int]:
+        """(real, padded, batches) read consistently under the lock."""
+        with self._lock:
+            return self.real, self.padded, self.batches
 
     def reset(self) -> None:
-        self.real = self.padded = self.batches = 0
+        with self._lock:
+            self.real = self.padded = self.batches = 0

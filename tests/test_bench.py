@@ -100,6 +100,24 @@ def test_serve_bench_smoke(tmp_path):
     assert art["headline"]["metric"] == "serve_saturated_batch_fill_ratio"
 
 
+def test_obs_bench_smoke(tmp_path, monkeypatch):
+    """bench.obs_bench runs the REAL train loop in both arms (telemetry
+    on with status server + trace + scraper, and off) and writes a
+    complete BENCH_OBS artifact. The committed BENCH_OBS.json pins the
+    acceptance number (<= 2% overhead); this smoke asserts the harness —
+    both arms ran, the artifact is stamped — without asserting the
+    noise-sensitive ratio on a contended CI host."""
+    import bench
+    monkeypatch.setenv("SPARKNET_TPU_HOME", str(tmp_path))
+    out_path = str(tmp_path / "BENCH_OBS.json")
+    out = bench.obs_bench(out_path=out_path, rounds=6, warmup=2, reps=1)
+    assert out["metric"] == "obs_full_telemetry_per_round_overhead"
+    assert out["per_mode"]["off_ms"] > 0 and out["per_mode"]["on_ms"] > 0
+    art = json.load(open(out_path))
+    assert {r["telemetry"] for r in art["rows"]} == {"on", "off"}
+    assert art["meta"]["jax_version"]  # run_metadata stamp
+
+
 def test_profiler_trace_capture(tmp_path):
     """maybe_trace writes a TensorBoard-loadable capture; None is a no-op."""
     import jax

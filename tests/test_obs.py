@@ -1,0 +1,405 @@
+"""Unified telemetry (sparknet_tpu.obs): registry + Prometheus exposition
+golden, Chrome-trace validity, registry concurrency under a live scraper,
+the train-side /metrics status server, per-round breakdown rows, the
+wall-clock ts field, bench metadata stamps, and the sparknet-metrics
+summarizer."""
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.obs import (MetricsRegistry, StatusServer, run_metadata,
+                              trace as obs_trace)
+from sparknet_tpu.obs.summary import main as summary_main
+from sparknet_tpu.utils.logger import Logger
+
+
+# -- Prometheus exposition golden (the name/type/label schema is a
+#    compatibility surface: scrapers and dashboards key on it) --------------
+
+def test_prometheus_exposition_golden():
+    reg = MetricsRegistry()
+    c = reg.counter("sparknet_test_requests_total", "requests by outcome",
+                    labels=("outcome",))
+    c.inc(outcome="ok")
+    c.inc(2, outcome="failed")
+    g = reg.gauge("sparknet_test_queue_depth", "queued requests")
+    g.set(3)
+    h = reg.histogram("sparknet_test_latency_seconds", "latency",
+                      buckets=(0.3, 1.0))
+    for v in (0.25, 0.5, 4.0):
+        h.observe(v)
+    expected = (
+        '# HELP sparknet_test_latency_seconds latency\n'
+        '# TYPE sparknet_test_latency_seconds histogram\n'
+        'sparknet_test_latency_seconds_bucket{le="0.3"} 1\n'
+        'sparknet_test_latency_seconds_bucket{le="1"} 2\n'
+        'sparknet_test_latency_seconds_bucket{le="+Inf"} 3\n'
+        'sparknet_test_latency_seconds_sum 4.75\n'
+        'sparknet_test_latency_seconds_count 3\n'
+        '# HELP sparknet_test_queue_depth queued requests\n'
+        '# TYPE sparknet_test_queue_depth gauge\n'
+        'sparknet_test_queue_depth 3\n'
+        '# HELP sparknet_test_requests_total requests by outcome\n'
+        '# TYPE sparknet_test_requests_total counter\n'
+        'sparknet_test_requests_total{outcome="failed"} 2\n'
+        'sparknet_test_requests_total{outcome="ok"} 1\n')
+    assert reg.render_prometheus() == expected
+
+
+def test_registry_label_escaping_and_callback_gauge():
+    reg = MetricsRegistry()
+    g = reg.gauge("g", labels=("path",))
+    g.set(1, path='a"b\\c\nd')
+    reg.gauge("live").set_fn(lambda: 7)
+    text = reg.render_prometheus()
+    assert r'g{path="a\"b\\c\nd"} 1' in text
+    assert "live 7" in text
+    # a callback that raises drops its sample, never the scrape
+    reg.gauge("broken").set_fn(lambda: 1 / 0)
+    assert "live 7" in reg.render_prometheus()
+
+
+def test_registry_kind_conflict_rejected():
+    reg = MetricsRegistry()
+    reg.counter("m", labels=("a",))
+    with pytest.raises(ValueError):
+        reg.gauge("m", labels=("a",))
+    with pytest.raises(ValueError):
+        reg.counter("m", labels=("b",))
+    # idempotent get-or-create returns the same family
+    assert reg.counter("m", labels=("a",)) is reg.counter("m",
+                                                          labels=("a",))
+    c = reg.counter("m", labels=("a",))
+    c.inc(2, a="x")
+    assert c.value(a="x") == 2 and c.value(a="y") is None
+    # value() on a raising callback drops the sample, like snapshot()
+    g = reg.gauge("cb")
+    g.set_fn(lambda: 1 / 0)
+    assert g.value() is None
+
+
+# -- concurrency: N writers hammer the registry while a reader scrapes ------
+
+def test_registry_concurrent_writers_vs_scraper():
+    reg = MetricsRegistry()
+    c = reg.counter("hammer_total", labels=("worker",))
+    h = reg.histogram("hammer_seconds", buckets=(0.5,))
+    g = reg.gauge("hammer_gauge")
+    n_threads, per = 8, 2000
+    stop = threading.Event()
+    scrapes = []
+
+    def scraper():
+        while not stop.is_set():
+            text = reg.render_prometheus()
+            snap = reg.snapshot()
+            # a scrape mid-hammer must be internally consistent:
+            # histogram count == sum of its bucket counts (all
+            # observations land in the 0.5 bucket here)
+            v = snap["hammer_seconds"]["values"].get(())
+            if v is not None:
+                assert v["count"] == sum(v["buckets"])
+            scrapes.append(len(text))
+
+    def writer(i):
+        for _ in range(per):
+            c.inc(worker=str(i))
+            h.observe(0.25)
+            g.set(i)
+
+    ts = [threading.Thread(target=writer, args=(i,))
+          for i in range(n_threads)]
+    sc = threading.Thread(target=scraper)
+    sc.start()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    stop.set()
+    sc.join()
+    assert scrapes, "scraper never ran"
+    # nothing lost: every inc/observe landed exactly once
+    snap = reg.snapshot()
+    totals = snap["hammer_total"]["values"]
+    assert all(totals[(str(i),)] == per for i in range(n_threads))
+    assert snap["hammer_seconds"]["values"][()]["count"] == n_threads * per
+
+
+def test_latency_stats_concurrent_summary():
+    """The old live-attribute read path could sort a deque mid-append
+    (RuntimeError) or mix windows; the locked summary cannot."""
+    from sparknet_tpu.utils.metrics import LatencyStats
+
+    ls = LatencyStats(window=256)
+    stop = threading.Event()
+    errs = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                s = ls.summary()
+                if s["n"]:
+                    assert s["p50_ms"] is not None
+        except Exception as e:  # pragma: no cover - the failure we pin
+            errs.append(e)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    for i in range(20000):
+        ls.add(i * 1e-6)
+    stop.set()
+    t.join()
+    assert not errs
+
+
+# -- StatusServer ------------------------------------------------------------
+
+def test_status_server_endpoints():
+    reg = MetricsRegistry()
+    reg.counter("sparknet_x_total").inc(5)
+    srv = StatusServer(0, reg, healthz=lambda: (False, {"why": "testing"}),
+                       status=lambda: {"role": "test"})
+    try:
+        host, port = srv.address
+        resp = urllib.request.urlopen(f"http://{host}:{port}/metrics",
+                                      timeout=10)
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        assert "sparknet_x_total 5" in resp.read().decode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{host}:{port}/healthz",
+                                   timeout=10)
+        assert ei.value.code == 503
+        s = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/status", timeout=10).read())
+        assert s == {"role": "test"}
+    finally:
+        srv.stop()
+
+
+# -- tracer ------------------------------------------------------------------
+
+def test_span_noop_when_off():
+    assert obs_trace.active_tracer() is None
+    with obs_trace.span("nothing"):
+        pass  # must not raise, must not record anywhere
+
+
+def test_tracer_events_and_lanes(tmp_path):
+    out = tmp_path / "t.json"
+    with obs_trace.tracing(str(out)) as tr:
+        with obs_trace.span("outer", round=1):
+            with obs_trace.span("inner"):
+                pass
+
+        def worker():
+            with obs_trace.span("worker_side"):
+                pass
+        th = threading.Thread(target=worker, name="lane-two")
+        th.start()
+        th.join()
+        tr.instant("mark", k="v")
+    data = json.loads(out.read_text())
+    evs = data["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"outer", "inner", "worker_side"}
+    for e in xs:
+        assert {"ph", "ts", "dur", "pid", "tid"} <= set(e)
+        assert e["dur"] >= 0
+    # two distinct lanes, both named via thread_name metadata
+    assert len({e["tid"] for e in xs}) == 2
+    names = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "lane-two" in names
+    assert any(e["ph"] == "i" and e["name"] == "mark" for e in evs)
+
+
+# -- the full train-side loop: /metrics + trace + breakdown + ts ------------
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """One tiny training run with full telemetry: checkpointing (async
+    writer lane), status server, trace capture, metrics JSONL."""
+    from sparknet_tpu.apps.train_loop import train
+    from sparknet_tpu.data.dataset import ArrayDataset
+    from sparknet_tpu.utils.config import RunConfig
+    from sparknet_tpu.zoo import lenet
+
+    root = str(tmp_path_factory.mktemp("obs_train"))
+    r = np.random.default_rng(0)
+    n, b, tau = 256, 16, 2
+    ds = ArrayDataset({
+        "data": r.standard_normal((n, 1, 28, 28)).astype(np.float32),
+        "label": r.integers(0, 10, (n, 1)).astype(np.int32)})
+    jsonl = os.path.join(root, "m.jsonl")
+    cfg = RunConfig(model="lenet", n_devices=1, local_batch=b, tau=tau,
+                    max_rounds=4, eval_every=0, workdir=root,
+                    checkpoint_dir=os.path.join(root, "ck"),
+                    checkpoint_every=2, status_port=0,
+                    trace_out=os.path.join(root, "trace.json"))
+    scraped = {}
+
+    def hook(rnd, state):
+        if rnd == 2:
+            host, port = cfg.status_address
+            scraped["metrics"] = urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=10).read().decode()
+            scraped["healthz"] = json.loads(urllib.request.urlopen(
+                f"http://{host}:{port}/healthz", timeout=10).read())
+
+    log = Logger(os.path.join(root, "l.txt"), echo=False, jsonl_path=jsonl)
+    train(cfg, lenet(batch=b), ds, None, logger=log, round_hook=hook)
+    log.close()
+    return {"cfg": cfg, "jsonl": jsonl, "scraped": scraped, "root": root}
+
+
+def test_train_metrics_endpoint_schema(trained):
+    text = trained["scraped"]["metrics"]
+    # shared-schema names the serve side also exports from ITS registry
+    assert "sparknet_build_info{" in text
+    for name in ("sparknet_train_rounds_total",
+                 "sparknet_train_loss",
+                 "sparknet_train_images_per_sec_per_chip",
+                 'sparknet_train_phase_seconds_total{phase="sample"}',
+                 'sparknet_train_phase_seconds_total{phase="h2d"}',
+                 'sparknet_train_phase_seconds_total{phase="dispatch"}',
+                 "sparknet_health_rounds_total",
+                 "sparknet_checkpoint_writes_total"):
+        assert name in text, f"missing {name} in train /metrics"
+    assert trained["scraped"]["healthz"]["status"] == "ok"
+
+
+def test_trace_file_valid_with_expected_lanes(trained):
+    data = json.load(open(trained["cfg"].trace_out))
+    evs = data["traceEvents"]
+    assert evs, "empty trace"
+    for e in evs:
+        assert "ph" in e and "pid" in e
+        if e["ph"] != "M":
+            assert "ts" in e and "tid" in e
+    lanes = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    # the three host threads of a checkpointing training run
+    assert any(n == "MainThread" for n in lanes)
+    assert any(n.startswith("round-prep") for n in lanes), lanes
+    assert any(n.startswith("ckpt-write") for n in lanes), lanes
+    spans = {e["name"] for e in evs if e["ph"] == "X"}
+    assert {"sample", "train_round", "round_prep",
+            "checkpoint_write"} <= spans
+
+
+def test_jsonl_breakdown_and_ts(trained):
+    rows = [json.loads(l) for l in open(trained["jsonl"])]
+    loss_rows = [r for r in rows if "loss" in r]
+    assert loss_rows
+    import time as _time
+    now = _time.time()
+    for r in loss_rows:
+        # wall-clock epoch ts on every record (cross-process merge key)
+        assert now - 3600 < r["ts"] <= now
+        for fld in ("t_data_ms", "t_h2d_ms", "t_round_ms",
+                    "t_collect_ms", "t_ckpt_fetch_ms", "t_log_ms"):
+            assert fld in r and r[fld] >= 0
+    # the round after a checkpoint round carries its stage-1 fetch stall
+    assert any(r["t_ckpt_fetch_ms"] > 0 for r in loss_rows)
+
+
+def test_serve_trace_has_worker_lane(tmp_path):
+    """The serve half of the cross-thread picture: forwards on the
+    serve-worker lane."""
+    from sparknet_tpu.net_api import JaxNet
+    from sparknet_tpu.serve import InferenceServer, ServeConfig
+    from sparknet_tpu.zoo import lenet
+
+    out = tmp_path / "serve_trace.json"
+    net = JaxNet(lenet(batch=4))
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      metrics_every_batches=0)
+    with obs_trace.tracing(str(out)):
+        with InferenceServer(net, cfg) as srv:
+            srv.infer({"data": np.zeros((28, 28, 1), np.float32)})
+    data = json.loads(out.read_text())
+    evs = data["traceEvents"]
+    lanes = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "serve-worker" in lanes
+    assert any(e["ph"] == "X" and e["name"] == "forward" for e in evs)
+
+
+def test_telemetry_off_is_clean(tmp_path):
+    """cfg.telemetry=False: no breakdown fields, no registry, no status
+    attr — the bench's control arm."""
+    from sparknet_tpu.apps.train_loop import train
+    from sparknet_tpu.data.dataset import ArrayDataset
+    from sparknet_tpu.utils.config import RunConfig
+    from sparknet_tpu.zoo import lenet
+
+    r = np.random.default_rng(0)
+    ds = ArrayDataset({
+        "data": r.standard_normal((128, 1, 28, 28)).astype(np.float32),
+        "label": r.integers(0, 10, (128, 1)).astype(np.int32)})
+    jsonl = str(tmp_path / "m.jsonl")
+    cfg = RunConfig(model="lenet", n_devices=1, local_batch=16, tau=1,
+                    max_rounds=2, eval_every=0, workdir=str(tmp_path),
+                    telemetry=False)
+    log = Logger(str(tmp_path / "l.txt"), echo=False, jsonl_path=jsonl)
+    train(cfg, lenet(batch=16), ds, None, logger=log)
+    log.close()
+    rows = [json.loads(l) for l in open(jsonl)]
+    assert rows and all("t_round_ms" not in r for r in rows)
+    assert all("ts" in r for r in rows)  # the merge key stays
+
+
+# -- run metadata + summary tool --------------------------------------------
+
+def test_run_metadata_fields():
+    m = run_metadata()
+    for k in ("ts", "python", "git_rev", "jax_version", "backend",
+              "device_kind", "n_devices"):
+        assert k in m, m
+
+
+def test_bench_obs_artifact_stamped():
+    """BENCH artifacts carry the run_metadata stamp (attribution
+    satellite). Checked against the committed BENCH_OBS.json."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_OBS.json")
+    if not os.path.exists(path):
+        pytest.skip("BENCH_OBS.json not generated yet")
+    art = json.load(open(path))
+    assert art["meta"]["jax_version"]
+    assert art["meta"]["backend"]
+    assert "git_rev" in art["meta"]
+    assert art["headline"]["value"] <= 0.02  # the acceptance bound
+
+
+def test_metrics_summary_cli(trained, capsys):
+    rc = summary_main([trained["jsonl"]])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "loss tail:" in out
+    assert "step-time breakdown" in out
+    assert "round" in out
+
+
+def test_metrics_summary_events_and_json(tmp_path, capsys):
+    """Event audit trail + --json machine output + multi-file ts merge."""
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    la = Logger(None, echo=False, jsonl_path=a)
+    lb = Logger(None, echo=False, jsonl_path=b)
+    la.metrics(0, loss=2.0)
+    lb.event(1, "rollback", reason="nonfinite", target_step=0)
+    la.metrics(2, loss=1.0, t_data_ms=1.5, t_round_ms=20.0)
+    la.close()
+    lb.close()
+    rc = summary_main(["--json", a, b])
+    assert rc == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["rounds"] == 2 and s["events"] == 1
+    assert s["event_trail"][0]["event"] == "rollback"
+    assert s["loss_final"] == 1.0
+    assert s["step_time_breakdown"]["t_round_ms"]["mean_ms"] == 20.0

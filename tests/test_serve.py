@@ -300,12 +300,18 @@ def test_hot_swap_mid_traffic_chaos(net, tmp_path):
             # (1) good swap lands without a hiccup
             _save_trainstate_like(net, d, step=2, scale=0.9)
             _wait(lambda: srv.manager.step == 2)
-            # (2) corrupt snapshot: digest verify must reject it
-            path = _save_trainstate_like(net, d, step=3)
+            # (2) corrupt snapshot: digest verify must reject it. Stage
+            # the save OUTSIDE the watched dir and corrupt it there —
+            # corrupting in place races the 50 ms poll, which can install
+            # the still-clean step 3 before the byte flips (observed
+            # flake). The rename publishes step 3 already-corrupt.
+            stage = tmp_path / "stage"
+            path = _save_trainstate_like(net, stage, step=3)
             npz = os.path.join(path, "state.npz")
             raw = bytearray(open(npz, "rb").read())
             raw[-32] ^= 0x01
             open(npz, "wb").write(bytes(raw))
+            os.rename(path, os.path.join(str(d), os.path.basename(path)))
             fails = srv.manager.swap_failures
             _wait(lambda: srv.manager.swap_failures > fails)
             assert srv.manager.step == 2  # still on the good one
@@ -350,11 +356,21 @@ def test_healthz_and_metrics_http(net):
         h = json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{port}/healthz", timeout=10).read())
         assert h["status"] == "ok"
-        m = json.loads(urllib.request.urlopen(
-            f"http://127.0.0.1:{port}/metrics", timeout=10).read())
-        assert m["requests_ok"] == 1
-        assert m["batch_fill_ratio"] == 1.0  # one request, bucket 1
-        assert m["p50_ms"] is not None
+        # /metrics is now the Prometheus text exposition rendered from
+        # the shared obs registry (same name schema as the train side);
+        # the JSON vitals moved to /status
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10)
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+        assert 'sparknet_serve_requests_total{outcome="ok"} 1' in text
+        assert "sparknet_serve_batch_fill_ratio 1" in text
+        assert "sparknet_build_info{" in text
+        s = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/status", timeout=10).read())
+        assert s["requests_ok"] == 1
+        assert s["batch_fill_ratio"] == 1.0  # one request, bucket 1
+        assert s["p50_ms"] is not None
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(f"http://127.0.0.1:{port}/nope",
                                    timeout=10)
