@@ -212,12 +212,14 @@ def scaling(max_devices: int = 8, virtual: bool = True) -> dict:
         eff = times[1] / times[top]
         metric = f"weak_scaling_efficiency_{top}dev"
         unit = "t(1)/t(n), 1.0 = perfect"
+    from sparknet_tpu.obs import run_metadata
     result = {
         "metric": metric,
         "value": round(eff, 3),
         "unit": unit,
         "vs_baseline": round(eff / 0.9, 3),  # BASELINE.md: >=90% efficiency
         "round_ms": {str(k): round(v * 1e3, 1) for k, v in times.items()},
+        "meta": run_metadata(),  # SCALING_*.json artifacts are this dict
     }
     print(json.dumps(result))
     return result
@@ -399,6 +401,8 @@ def e2e(sources: int = 1, store: str | None = None) -> dict:
         out["device_only_images_per_sec_per_chip"] = round(device_rate, 1)
         out["readers_serial_ceiling_covers_chip"] = (
             None if crit_clamped else round(device_rate * crit_ms / 1e3, 2))
+    from sparknet_tpu.obs import run_metadata
+    out["meta"] = run_metadata()  # E2E_*.json artifacts are this dict
     print(json.dumps(out))
     return out
 
@@ -810,7 +814,9 @@ def obs_bench(out_path: str | None = "BENCH_OBS.json", rounds: int = 40,
               warmup: int = 8, reps: int = 3) -> dict:
     """Telemetry overhead: the SAME tiny training run with the obs layer
     fully on (per-run registry + per-round step-time breakdown rows +
-    host-span tracing + a live /metrics status server being scraped) vs
+    host-span tracing + a live /metrics status server being scraped +
+    since the pod PR: device telemetry sampling, per-worker pod
+    heartbeats, and a live PodAggregator endpoint being polled) vs
     telemetry disabled (`RunConfig.telemetry=False`, no trace, no status
     server — the pre-obs loop). Headline: median steady-state per-round
     overhead, acceptance target <= 2%.
@@ -845,6 +851,12 @@ def obs_bench(out_path: str | None = "BENCH_OBS.json", rounds: int = 40,
                         max_rounds=rounds, eval_every=0, workdir=root,
                         telemetry=telemetry,
                         status_port=0 if telemetry else None,
+                        # the pod layer rides the on arm: per-worker
+                        # heartbeats + a live aggregator being polled
+                        pod_dir=(os.path.join(root, "pod") if telemetry
+                                 else None),
+                        pod_port=0 if telemetry else None,
+                        heartbeat_every_s=1.0,
                         trace_out=(os.path.join(root, "trace.json")
                                    if telemetry else None))
         marks: list[float] = []
@@ -858,15 +870,24 @@ def obs_bench(out_path: str | None = "BENCH_OBS.json", rounds: int = 40,
                 # includes being read, not just being written
                 host, port = cfg.status_address
 
+                pod_addr = cfg.pod_address
+
                 def scrape():
                     # 1 Hz: already ~15-60x denser than a production
                     # Prometheus scrape interval, without turning a
-                    # CPU-contended bench host into a scrape benchmark
+                    # CPU-contended bench host into a scrape benchmark.
+                    # The pod endpoint (merged exposition + /pod/status,
+                    # which re-reads the worker heartbeat) is polled in
+                    # the same breath — the full pod-PR surface is live.
                     while not stop.is_set():
                         try:
                             urllib.request.urlopen(
                                 f"http://{host}:{port}/metrics",
                                 timeout=5).read()
+                            if pod_addr:
+                                urllib.request.urlopen(
+                                    f"http://{pod_addr[0]}:{pod_addr[1]}"
+                                    f"/pod/status", timeout=5).read()
                         except Exception:
                             pass
                         stop.wait(1.0)
@@ -918,7 +939,8 @@ def obs_bench(out_path: str | None = "BENCH_OBS.json", rounds: int = 40,
         "metric": "obs_full_telemetry_per_round_overhead",
         "value": round(overhead, 4),
         "unit": "median per-round overhead, telemetry on vs off "
-                "(registry + breakdown rows + trace + scraped /metrics; "
+                "(registry + breakdown rows + trace + scraped /metrics + "
+                "device sampling + pod heartbeat/aggregator; "
                 "target <= 0.02)",
         "vs_baseline": round(min(0.02 / max(overhead, 1e-9), 100.0), 2),
         "per_mode": {"off_ms": off, "on_ms": on},

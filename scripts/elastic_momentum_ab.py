@@ -118,9 +118,11 @@ def main():
     winner = min((p for p in POLICIES
                   if summary[p]["all_descending"]),
                  key=lambda p: summary[p]["worst_max_rel_dev"])
+    from sparknet_tpu.obs import run_metadata
     out = {"task": "TINY_MLP trajectory-band (tests/test_apps.py harness), "
                    "3 seeds, 8->4 and 8->2 resumes, 8 post-resume rounds",
-           "results": results, "summary": summary, "winner": winner}
+           "results": results, "summary": summary, "winner": winner,
+           "meta": run_metadata()}
     path = os.path.join(os.path.dirname(__file__), "..",
                         "ELASTIC_AB_r05.json")
     with open(path, "w") as f:

@@ -409,6 +409,8 @@ def main():
                 multi["final_val_accuracy"],
             "gap": round(serial["final_val_accuracy"]
                          - multi["final_val_accuracy"], 4)}
+    from sparknet_tpu.obs import run_metadata
+    results["meta"] = run_metadata()
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)
     print(json.dumps(results.get("summary", runs[-1])))

@@ -206,6 +206,8 @@ def main():
         "gap": round(s["final_test_accuracy"]
                      - m["final_test_accuracy"], 4),
     }
+    from sparknet_tpu.obs import run_metadata
+    results["meta"] = run_metadata()
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)
     print(json.dumps(results["summary"]))

@@ -147,6 +147,8 @@ def main() -> None:
                        "all_finite": bool(np.isfinite(losses).all())},
             "rss_samples": samples[:: max(1, len(samples) // 60)],
         }
+        from sparknet_tpu.obs import run_metadata
+        result["meta"] = run_metadata()
         with open(args.out, "w") as f:
             json.dump(result, f, indent=1)
         if os.path.exists(partial_path):

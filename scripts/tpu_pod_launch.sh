@@ -69,13 +69,27 @@
 #                    (default 900) counts as having made progress: its
 #                    failure resets watch's consecutive-failure count
 #                    instead of accumulating across a multi-day run
+#   TPU_POD_STATUS_PORT  port of the pod aggregation endpoint on worker 0
+#                    (RunConfig.pod_port, or a sidecar `sparknet-podview
+#                    --serve PORT`): when a run fails on a READY pod,
+#                    watch curls http://127.0.0.1:PORT/pod/status from
+#                    worker 0 and echoes the MERGED pod JSON — per-worker
+#                    round/status/staleness plus straggler attribution,
+#                    so a sick worker != 0 is NAMED, not inferred
+#   TPU_POD_DIR      shared per-worker heartbeat prefix (RunConfig.
+#                    pod_dir) on a filesystem worker 0 can read: the
+#                    file fallback when the pod endpoint is not up —
+#                    watch cats every worker-*.heartbeat.json there
+#                    (for gs://|s3:// prefixes use TPU_POD_STATUS_PORT;
+#                    cat cannot read a bucket)
 #   TPU_HEARTBEAT_FILE  remote path of the app's heartbeat JSON (set
 #                    RunConfig.heartbeat_path, or sparknet-serve
-#                    --heartbeat, to the same path): when a run fails on
-#                    a READY pod, watch fetches it from worker 0 and
-#                    reports step/status/staleness — "slow" (fresh beat,
-#                    status ok) vs "sick" (stale beat, or spike/
-#                    nonfinite/rollback status) without log parsing
+#                    --heartbeat, to the same path): the legacy worker-0
+#                    probe — last fallback when neither pod knob is set
+#                    or both came up empty; reports step/status/staleness
+#                    — "slow" (fresh beat, status ok) vs "sick" (stale
+#                    beat, or spike/nonfinite/rollback status) without
+#                    log parsing
 #   ALLOW_NO_NATIVE=1  continue setup if the C++ data plane fails to build
 #
 # Multi-host run path: `run` executes the SAME command on every worker
@@ -173,9 +187,37 @@ do_run() {
 }
 
 report_heartbeat() {
-  # Best-effort "slow vs sick" probe: cat the app's heartbeat JSON from
-  # worker 0 (see TPU_HEARTBEAT_FILE above). Never fails the caller — a
-  # dead VM or a missing file just means no heartbeat to report.
+  # Best-effort "slow vs sick" probe, pod-scope first. Never fails the
+  # caller — a dead VM or a missing file just means nothing to report.
+  # 1. pod aggregation endpoint on worker 0 (TPU_POD_STATUS_PORT): the
+  #    merged view NAMES a sick/straggling worker != 0
+  if [ -n "${TPU_POD_STATUS_PORT:-}" ]; then
+    ps=$($TPU ssh "$NAME" --worker=0 --zone "$ZONE" --command \
+         "curl -fsS -m 5 http://127.0.0.1:${TPU_POD_STATUS_PORT}/pod/status 2>/dev/null" \
+         2>/dev/null) || true
+    if [ -n "${ps:-}" ]; then
+      echo "watch: pod status from worker 0: $ps" >&2
+      echo "watch: (stragglers/stale workers are named per worker id;" \
+           "status spike/nonfinite/rollback => sick)" >&2
+      return 0
+    fi
+    echo "watch: no pod status at :${TPU_POD_STATUS_PORT}; falling back" >&2
+  fi
+  # 2. per-worker heartbeat files on the shared TPU_POD_DIR prefix
+  if [ -n "${TPU_POD_DIR:-}" ]; then
+    hb=$($TPU ssh "$NAME" --worker=0 --zone "$ZONE" --command \
+         "cat ${TPU_POD_DIR}/worker-*.heartbeat.json 2>/dev/null" \
+         2>/dev/null) || true
+    if [ -n "${hb:-}" ]; then
+      echo "watch: per-worker heartbeats from ${TPU_POD_DIR}:" >&2
+      echo "$hb" >&2
+      echo "watch: (each line carries its worker id; stale t or status" \
+           "spike/nonfinite/rollback => that worker is sick)" >&2
+      return 0
+    fi
+    echo "watch: no heartbeats readable under ${TPU_POD_DIR}; falling back" >&2
+  fi
+  # 3. the legacy single worker-0 heartbeat file
   [ -n "${TPU_HEARTBEAT_FILE:-}" ] || return 0
   hb=$($TPU ssh "$NAME" --worker=0 --zone "$ZONE" --command \
        "cat $TPU_HEARTBEAT_FILE 2>/dev/null" 2>/dev/null) || true

@@ -36,6 +36,8 @@ from ..model.net import CompiledNet
 from ..model.spec import NetSpec
 from ..obs import (MetricsRegistry, StatusServer, register_build_info,
                    trace as obs_trace)
+from ..obs import device as obs_device
+from ..obs import pod as obs_pod
 from ..parallel.mesh import fetch_global, make_mesh
 from ..parallel.trainer import ParallelTrainer, TrainState
 from ..data.dataset import ArrayDataset, RoundSampler
@@ -221,6 +223,10 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     round."""
     n_dev = trainer.n_devices
     n_local = getattr(trainer, "n_local_devices", n_dev)
+    if getattr(log, "worker", None) is None and jax.process_count() > 1:
+        # stamp this process's JSONL records with its worker id so the
+        # pod summary view can merge the N per-host files
+        log.worker = jax.process_index()
     if hasattr(train_ds, "next_round"):
         source = train_ds
         log.log(f"train source: streaming ({n_dev} devices / {n_local} "
@@ -263,6 +269,7 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     registry = (MetricsRegistry()
                 if cfg.telemetry or cfg.status_port is not None else None)
     g_round = g_loss = c_rounds = None
+    g_round_s = g_wait_s = dev_tel = None
     if registry is not None:
         register_build_info(registry)
         g_round = registry.gauge("sparknet_train_round",
@@ -271,6 +278,26 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
                                 "last flushed round loss")
         c_rounds = registry.counter("sparknet_train_rounds_total",
                                     "rounds dispatched")
+        # per-worker straggler-attribution inputs: THIS worker's last
+        # round wall time and residual data wait — the pod aggregator
+        # compares them across workers (median+MAD) to name the slow host
+        g_round_s = registry.gauge(
+            "sparknet_train_round_seconds",
+            "last round wall time on this worker")
+        g_wait_s = registry.gauge(
+            "sparknet_train_data_wait_seconds",
+            "last round's residual data wait on this worker")
+        # device telemetry (obs/device.py): HBM + live arrays sampled at
+        # the flush cadence, compile events replayed + followed, and the
+        # jitted round's cache size (churn = recompiles) live-read
+        dev_tel = obs_device.DeviceTelemetry(registry)
+        obs_device.attach_compile_metrics(registry)
+        if hasattr(trainer, "compiled_variants"):
+            registry.gauge(
+                "sparknet_train_round_compiled_variants",
+                "jit-cache entries for the compiled round (1 = steady "
+                "state; growth = recompiles)").set_fn(
+                    trainer.compiled_variants)
     timers = PhaseTimers(registry=registry)
     if cfg.telemetry and hasattr(trainer, "phase_timers"):
         # h2d / dispatch split from inside train_round (ParallelTrainer).
@@ -307,6 +334,16 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
                                  registry=registry)
                  if cfg.heartbeat_path and jax.process_index() == 0
                  else None)
+    # pod-scope telemetry (obs/pod.py): EVERY worker rewrites its own
+    # heartbeat under the shared pod_dir prefix (local/NFS or gs://|s3://
+    # — single small atomic object PUTs), carrying the per-worker round
+    # wall time + data wait the aggregator's straggler attribution needs.
+    # registry=None: the primary heartbeat above already owns the
+    # sparknet_heartbeat_* counters; double-registering would double-count.
+    pod_hb = (HeartbeatWriter(
+        obs_pod.worker_heartbeat_path(cfg.pod_dir, jax.process_index()),
+        role="train", interval_s=cfg.heartbeat_every_s)
+        if cfg.pod_dir else None)
     # host-side span capture (--trace-out): spans from the round loop,
     # the round-prep prefetch thread and the ckpt-write thread land on
     # per-thread lanes of ONE Chrome-trace timeline (obs/trace.py) —
@@ -314,32 +351,78 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     # cfg.profile_dir device trace
     tracer = (obs_trace.start_tracing()
               if cfg.trace_out and jax.process_index() == 0 else None)
-    # live vitals for /healthz + /status on the training status server
+    # live vitals for /healthz + /status on the training status server.
+    # round_s / data_wait_s are the per-worker straggler inputs — the pod
+    # aggregator reads them straight off /status without parsing metrics.
+    # beat_ts is the LOOP's own freshness stamp (updated at each flush):
+    # a hung round loop whose HTTP daemon thread still answers must read
+    # as stale to the pod aggregator, not as alive-and-fresh
     vitals: Dict[str, Any] = {"role": "train", "round": start_round,
-                              "status": "ok", "loss": None}
+                              "status": "ok", "loss": None,
+                              "worker": jax.process_index(),
+                              "round_s": None, "data_wait_s": None,
+                              "beat_ts": round(time.time(), 3)}
+    # every process serves its own /metrics since the pod PR: each worker
+    # is a scrape surface (the raw feed pod aggregation merges); on a
+    # shared host use port 0 — each process binds its own ephemeral port
     status_srv = None
-    if cfg.status_port is not None and jax.process_index() == 0:
-        status_srv = StatusServer(
-            cfg.status_port, registry, host=cfg.status_host,
-            healthz=lambda: (vitals["status"] not in ("nonfinite",),
-                             {k: v for k, v in vitals.items()}),
-            status=lambda: {**vitals,
-                            "rollbacks": (monitor.rollbacks
-                                          if monitor else 0),
-                            "phase_means": timers.summary()})
-        cfg.status_address = status_srv.address
-        log.log(f"train status server at http://{status_srv.address[0]}:"
-                f"{status_srv.address[1]}/metrics")
+    if cfg.status_port is not None:
+        try:
+            status_srv = StatusServer(
+                cfg.status_port, registry, host=cfg.status_host,
+                healthz=lambda: (vitals["status"] not in ("nonfinite",),
+                                 {k: v for k, v in vitals.items()}),
+                status=lambda: {**vitals,
+                                "rollbacks": (monitor.rollbacks
+                                              if monitor else 0),
+                                "phase_means": timers.summary()})
+        except OSError as e:
+            # a taken port (co-located processes sharing a fixed
+            # status_port) degrades observability, never training —
+            # use port 0 for one-ephemeral-port-per-process instead
+            warnings.warn(f"status server failed to bind port "
+                          f"{cfg.status_port}: {e}; continuing without",
+                          RuntimeWarning)
+        if status_srv is not None:
+            cfg.status_address = status_srv.address
+            if jax.process_index() == 0:
+                log.log(f"train status server at "
+                        f"http://{status_srv.address[0]}:"
+                        f"{status_srv.address[1]}/metrics")
+    # worker 0 additionally serves the POD view over the shared heartbeat
+    # prefix: merged /metrics + /pod/status with straggler attribution
+    pod_srv = None
+    if cfg.pod_port is not None and cfg.pod_dir and \
+            jax.process_index() == 0:
+        try:
+            pod_srv = obs_pod.PodAggregator(pod_dir=cfg.pod_dir).serve(
+                cfg.pod_port, host=cfg.status_host)
+        except OSError as e:
+            warnings.warn(f"pod status server failed to bind port "
+                          f"{cfg.pod_port}: {e}; continuing without",
+                          RuntimeWarning)
+        else:
+            cfg.pod_address = pod_srv.address
+            log.log(f"pod status server at http://{pod_srv.address[0]}:"
+                    f"{pod_srv.address[1]}/pod/status")
 
     def beat(step: int, status: str, force: bool = False, **kv) -> None:
-        if heartbeat is None:
-            return
-        try:
-            heartbeat.beat(step, status=status, force=force,
-                           rollbacks=(monitor.rollbacks
-                                      if monitor is not None else 0), **kv)
-        except OSError as e:
-            warnings.warn(f"heartbeat write failed: {e}", RuntimeWarning)
+        rollbacks = monitor.rollbacks if monitor is not None else 0
+        for hb, extra in ((heartbeat, kv),
+                          (pod_hb, {**kv,
+                                    "worker": jax.process_index(),
+                                    "n_workers": jax.process_count(),
+                                    "round_s": vitals.get("round_s"),
+                                    "data_wait_s": vitals.get(
+                                        "data_wait_s")})):
+            if hb is None:
+                continue
+            try:
+                hb.beat(step, status=status, force=force,
+                        rollbacks=rollbacks, **extra)
+            except OSError as e:
+                warnings.warn(f"heartbeat write failed: {e}",
+                              RuntimeWarning)
 
     def ckpt_barrier() -> None:
         """Settle the store before READING it: drain the in-flight write
@@ -412,6 +495,15 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
             breakdown_["log"] = _last_flush_ms[0] / 1e3
             kv.update({f"t_{k}_ms": round(v * 1e3, 3)
                        for k, v in breakdown_.items()})
+            # per-worker straggler-attribution feed: /status vitals, the
+            # worker's own gauges, and (via beat below) the pod heartbeat
+            vitals["round_s"] = round(breakdown_["round"], 6)
+            vitals["data_wait_s"] = round(breakdown_["data"], 6)
+            if g_round_s is not None:
+                g_round_s.set(breakdown_["round"])
+                g_wait_s.set(breakdown_["data"])
+        if dev_tel is not None:
+            dev_tel.sample()  # HBM + live arrays at the log_every cadence
         gnorm = nonf = None
         worker_txt = ""
         if health_ is not None:
@@ -451,6 +543,7 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         vitals["round"] = rnd_
         vitals["loss"] = _hb_float(loss_)
         vitals["status"] = cls or "ok"
+        vitals["beat_ts"] = round(time.time(), 3)
         if g_round is not None:
             g_round.set(rnd_)
             if math.isfinite(loss_):
@@ -689,6 +782,8 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
             # swallowing every later span in this process)
             if status_srv is not None:
                 status_srv.stop()
+            if pod_srv is not None:
+                pod_srv.stop()
             if tracer is not None:
                 # stop AFTER the writer drained: the final
                 # checkpoint_write span must land on its lane. Writing
@@ -722,6 +817,9 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
                 f"{monitor.counts['nonfinite']} nonfinite rounds, "
                 f"{monitor.rollbacks} rollbacks")
     beat(rnd, status="done", force=True)
+    for hb in (heartbeat, pod_hb):
+        if hb is not None:
+            hb.flush()  # bounded wait so the done beat lands on buckets
     log.log(f"done; phase means: {timers.summary()}")
     return state
 

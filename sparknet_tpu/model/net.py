@@ -50,6 +50,15 @@ class CompiledNet:
 
     @staticmethod
     def compile(spec: NetSpec) -> "CompiledNet":
+        # stamped as a compile event (obs/device.py): every spec compile
+        # lands in the process-wide record, so jit-cache churn driven by
+        # repeated net construction is scrapeable, not invisible
+        from ..obs.device import timed_compile
+        with timed_compile("net"):
+            return CompiledNet._compile(spec)
+
+    @staticmethod
+    def _compile(spec: NetSpec) -> "CompiledNet":
         validate(spec)
         input_shapes = {i.name: _to_nhwc_shape(i.shape) for i in spec.inputs}
         input_dtypes = {i.name: i.dtype for i in spec.inputs}

@@ -19,6 +19,17 @@ Three pieces (ROADMAP "Dapper-tradition observability"):
     the async checkpoint writer, and the serve worker — the picture the
     device-only `jax.profiler` trace cannot draw.
 
+Since the pod PR, two more layers sit on top:
+
+  - `device`: device-level telemetry — HBM gauges from
+    `Device.memory_stats()`, live-array counts, and the process-wide
+    compile-event record (`note_compile` / `attach_compile_metrics`)
+    that makes jit-cache churn scrapeable.
+  - `pod`: cross-worker aggregation — `PodAggregator` merges every
+    worker's /metrics + /status (or per-worker heartbeat files on a
+    shared prefix) into ONE pod exposition + `/pod/status`, with
+    median+MAD straggler attribution; `sparknet-podview` is its console.
+
 `meta.run_metadata()` stamps artifacts (BENCH_*.json) and the
 `sparknet_build_info` gauge with provenance; `summary` is the
 `sparknet-metrics` JSONL reader.
@@ -29,10 +40,16 @@ from .http import StatusServer
 from .meta import register_build_info, run_metadata
 from .trace import (Tracer, active_tracer, span, start_tracing,
                     stop_tracing, tracing)
+from .device import (DeviceTelemetry, attach_compile_metrics, compile_stats,
+                     note_compile, timed_compile)
+from .pod import PodAggregator, WorkerView, flag_stragglers
 
 __all__ = [
     "DEFAULT_BUCKETS", "Metric", "MetricsRegistry", "default_registry",
     "StatusServer", "register_build_info", "run_metadata",
     "Tracer", "active_tracer", "span", "start_tracing", "stop_tracing",
     "tracing",
+    "DeviceTelemetry", "attach_compile_metrics", "compile_stats",
+    "note_compile", "timed_compile",
+    "PodAggregator", "WorkerView", "flag_stragglers",
 ]

@@ -1,0 +1,166 @@
+"""Device-level telemetry: HBM occupancy, live arrays, compile events.
+
+Two signals the host-side registry could not see before this module:
+
+  - **Memory.** `Device.memory_stats()` (bytes-in-use / peak / limit per
+    accelerator) and the process's live jax array count, exported as
+    gauges and sampled at the train loop's `log_every` flush cadence —
+    the curve that answers "is this OOM a leak or a step change" without
+    attaching a profiler. On backends without allocator stats (CPU
+    returns None) the memory gauges simply never appear; the live-array
+    gauge always does.
+
+  - **Compiles.** XLA compilation is the serving tail-latency cliff and
+    the training warm-up tax, yet it was invisible: nothing counted how
+    often it happened or how long it took. `note_compile(what, seconds)`
+    is the process-wide record — `CompiledNet.compile` stamps spec
+    compiles, the serve worker stamps the first forward of each batch
+    bucket (the jit-cache entry being built), and
+    `attach_compile_metrics` replays the history into a registry as
+    `sparknet_compile_events_total{what}` +
+    `sparknet_compile_seconds{what}` so a registry created AFTER the
+    model was compiled (the train loop's per-run registry) still shows
+    the compile that preceded it. Jit-cache CHURN — recompiles past the
+    expected steady state — is then a first-class scrapeable number
+    instead of a log-grep.
+
+The accumulator is process-global by design (compiles happen before any
+registry exists); attached registries are held weakly so per-run/test
+registries die normally.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+from .registry import Metric, MetricsRegistry
+
+#: compile durations span four orders of magnitude: a sub-ms cached spec
+#: rebuild to a multi-minute pod-scale XLA compile
+COMPILE_BUCKETS = (0.001, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                   10.0, 30.0, 60.0, 120.0, 300.0)
+
+_lock = threading.Lock()
+_events: List[Tuple[str, float]] = []  # (what, seconds), process lifetime
+#: weakly-held (counter, histogram) pairs of attached registries
+_attached: List[Tuple["weakref.ref[Metric]", "weakref.ref[Metric]"]] = []
+
+
+def note_compile(what: str, seconds: float) -> None:
+    """Record one compile event (`what` is the site: "net" for
+    CompiledNet.compile, "serve_bucket" for a serve bucket's first
+    forward). Fans out to every attached registry; never raises."""
+    with _lock:
+        _events.append((str(what), float(seconds)))
+        pairs = list(_attached)
+    for c_ref, h_ref in pairs:
+        c, h = c_ref(), h_ref()
+        if c is None or h is None:
+            continue
+        try:
+            c.inc(what=what)
+            h.observe(seconds, what=what)
+        except Exception:
+            pass  # a dying registry must not break the compile path
+
+
+def attach_compile_metrics(registry: MetricsRegistry) -> None:
+    """Register the compile counter + histogram into `registry`, replay
+    every event recorded so far (compiles routinely PRECEDE registry
+    creation), and keep feeding it (weakly held) as new ones land."""
+    c = registry.counter("sparknet_compile_events_total",
+                         "XLA/spec compile events by site", labels=("what",))
+    h = registry.histogram("sparknet_compile_seconds",
+                           "seconds per compile event", labels=("what",),
+                           buckets=COMPILE_BUCKETS)
+    with _lock:
+        history = list(_events)
+        _attached[:] = [(cr, hr) for cr, hr in _attached
+                        if cr() is not None and hr() is not None]
+        _attached.append((weakref.ref(c), weakref.ref(h)))
+    for what, seconds in history:
+        c.inc(what=what)
+        h.observe(seconds, what=what)
+
+
+def compile_stats() -> Dict[str, Dict[str, float]]:
+    """{what: {"events": n, "seconds": total}} — the accumulated record
+    (tests, status JSON)."""
+    out: Dict[str, Dict[str, float]] = {}
+    with _lock:
+        for what, seconds in _events:
+            d = out.setdefault(what, {"events": 0, "seconds": 0.0})
+            d["events"] += 1
+            d["seconds"] += seconds
+    return out
+
+
+class timed_compile:
+    """Context manager stamping its wall time as one compile event."""
+
+    def __init__(self, what: str):
+        self.what = what
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if exc[0] is None:
+            note_compile(self.what, time.perf_counter() - self._t0)
+        return False
+
+
+#: memory_stats() keys -> gauge name suffix (jaxlib's PJRT spelling; a
+#: backend missing a key just skips that gauge)
+_MEM_KEYS = (("bytes_in_use", "sparknet_device_hbm_bytes_in_use",
+              "allocator bytes currently in use"),
+             ("peak_bytes_in_use", "sparknet_device_hbm_peak_bytes",
+              "allocator high-water mark"),
+             ("bytes_limit", "sparknet_device_hbm_bytes_limit",
+              "allocator capacity"))
+
+
+class DeviceTelemetry:
+    """Registers + samples the device gauges. `sample()` is called at the
+    train loop's flush cadence (and is safe to call from anywhere): it
+    reads `memory_stats()` for every locally-addressable device and
+    counts live jax arrays; every failure degrades to a missing sample,
+    never an exception — observability must not take training down."""
+
+    def __init__(self, registry: MetricsRegistry, devices=None):
+        self.registry = registry
+        self._gauges = {name: registry.gauge(name, help_text,
+                                             labels=("device",))
+                        for _, name, help_text in _MEM_KEYS}
+        self._g_live = registry.gauge(
+            "sparknet_device_live_arrays",
+            "live jax arrays in this process (committed device buffers)")
+        if devices is None:
+            try:
+                import jax
+                devices = jax.local_devices()
+            except Exception:
+                devices = []
+        self.devices = list(devices)
+
+    def sample(self) -> None:
+        for d in self.devices:
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue  # CPU/backends without allocator stats
+            label = f"{getattr(d, 'platform', 'dev')}:{getattr(d, 'id', 0)}"
+            for key, name, _ in _MEM_KEYS:
+                v = stats.get(key)
+                if v is not None:
+                    self._gauges[name].set(float(v), device=label)
+        try:
+            import jax
+            self._g_live.set(float(len(jax.live_arrays())))
+        except Exception:
+            pass

@@ -36,18 +36,37 @@ class StatusServer:
                  healthz: Optional[Callable[[], Tuple[bool,
                                                       Dict[str, Any]]]] = None,
                  status: Optional[Callable[[], Dict[str, Any]]] = None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 metrics_text: Optional[Callable[[], str]] = None,
+                 routes: Optional[Dict[str,
+                                       Callable[[], Dict[str, Any]]]] = None):
+        """`metrics_text` overrides the registry render for /metrics —
+        the pod aggregator serves a MERGED exposition no single registry
+        holds. `routes` adds extra JSON GET endpoints (path prefix ->
+        dict-returning callable), e.g. the aggregator's /pod/status."""
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         owner = self
         self.registry = registry
         self.healthz = healthz
         self.status = status
+        self.metrics_text = metrics_text
+        # longest prefix first so /pod/status cannot be shadowed by /pod
+        self.routes = sorted((routes or {}).items(),
+                             key=lambda kv: -len(kv[0]))
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib casing)
                 try:
+                    for prefix, fn in owner.routes:
+                        if self.path.startswith(prefix):
+                            self._reply(200, json.dumps(fn()))
+                            return
                     if self.path.startswith("/metrics"):
+                        if owner.metrics_text is not None:
+                            self._reply(200, owner.metrics_text(),
+                                        content_type=PROM_CONTENT_TYPE)
+                            return
                         if owner.registry is None:
                             self._reply(404, '{"error": "no registry"}')
                             return

@@ -17,7 +17,16 @@ JSONL and its serve JSONL interleave into one timeline.
 
 `--selfcheck` runs a 3-round synthetic training first and summarizes its
 freshly written JSONL (the CI step: the tooling cannot rot against the
-live schema).
+live schema); `--selfcheck-workers 2` runs one per worker id and checks
+the POD view below; `--keep DIR` retains the artifacts for CI upload.
+
+**Pod view**: when the merged records span >= 2 workers (the `worker`
+field every multi-host run stamps, falling back to one-file-per-worker
+input order), the summary adds a per-worker step-time breakdown table
+plus a round-skew / straggler audit trail — per matched round, workers'
+`t_round_ms` are compared with the same median+MAD rule the live pod
+aggregator uses (`obs/pod.py`), so post-hoc JSONL analysis and the live
+`/pod/status` endpoint name the same sick host.
 """
 from __future__ import annotations
 
@@ -30,6 +39,8 @@ import shutil
 import sys
 from typing import Any, Dict, List, Optional
 
+from .pod import flag_stragglers
+
 #: step-time breakdown columns, in pipeline order (emitted by run_loop)
 BREAKDOWN_FIELDS = ("t_data_ms", "t_h2d_ms", "t_round_ms", "t_collect_ms",
                     "t_ckpt_fetch_ms", "t_log_ms")
@@ -37,17 +48,24 @@ BREAKDOWN_FIELDS = ("t_data_ms", "t_h2d_ms", "t_round_ms", "t_collect_ms",
 
 def load_records(paths: List[str]) -> List[Dict[str, Any]]:
     recs: List[Dict[str, Any]] = []
-    for path in paths:
+    for fi, path in enumerate(paths):
         with open(path) as f:
             for i, line in enumerate(f):
                 line = line.strip()
                 if not line:
                     continue
                 try:
-                    recs.append(json.loads(line))
+                    rec = json.loads(line)
                 except json.JSONDecodeError as e:
                     print(f"{path}:{i + 1}: skipping unparseable line "
                           f"({e})", file=sys.stderr)
+                    continue
+                if len(paths) > 1:
+                    # records without a worker stamp fall back to their
+                    # source file as the worker id (one JSONL per worker
+                    # is the pod layout)
+                    rec.setdefault("worker", fi)
+                recs.append(rec)
     # merge multiple processes' files on the wall-clock ts (satellite of
     # the same PR); files predating the ts field fall back to input order
     if len(paths) > 1 and all("ts" in r for r in recs):
@@ -94,7 +112,66 @@ def summarize(recs: List[Dict[str, Any]], tail: int = 10) -> Dict[str, Any]:
                               "total_s": round(sum(vals) / 1e3, 3)}
     if breakdown:
         out["step_time_breakdown"] = breakdown
+    pod = _pod_view(loss_rows)
+    if pod is not None:
+        out["pod"] = pod
     return out
+
+
+def _pod_view(loss_rows: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Per-worker breakdown + round-skew/straggler audit when the records
+    span >= 2 workers; None for single-worker runs (no pod to describe)."""
+    by_worker: Dict[int, List[Dict[str, Any]]] = {}
+    for r in loss_rows:
+        wid = r.get("worker")
+        if wid is None:
+            continue
+        by_worker.setdefault(int(wid), []).append(r)
+    if len(by_worker) < 2:
+        return None
+    workers: Dict[str, Any] = {}
+    for wid in sorted(by_worker):
+        rows = by_worker[wid]
+        w: Dict[str, Any] = {"rounds": len(rows)}
+        for fld in BREAKDOWN_FIELDS:
+            vals = [r[fld] for r in rows if fld in r]
+            if vals:
+                w[fld] = {"mean_ms": round(_mean(vals), 3),
+                          "max_ms": round(max(vals), 3)}
+        losses = [r["loss"] for r in rows if r.get("loss") is not None]
+        if losses:
+            w["loss_final"] = losses[-1]
+        workers[str(wid)] = w
+    # per-matched-round skew + straggler flags: the SAME median+MAD rule
+    # the live aggregator applies, over t_round_ms grouped by step
+    per_step: Dict[Any, Dict[str, float]] = {}
+    for wid, rows in by_worker.items():
+        for r in rows:
+            if "t_round_ms" in r:
+                per_step.setdefault(r["step"], {})[str(wid)] = r["t_round_ms"]
+    skews: List[float] = []
+    straggler_rounds: Dict[str, int] = {}
+    audit: List[Dict[str, Any]] = []
+    for step in sorted(per_step):
+        vals = per_step[step]
+        if len(vals) < 2:
+            continue
+        med_s, skew_s, flagged = flag_stragglers(
+            {w: v / 1e3 for w, v in vals.items()})
+        skews.append(skew_s * 1e3)
+        for w in sorted(flagged):
+            straggler_rounds[w] = straggler_rounds.get(w, 0) + 1
+            audit.append({"step": step, "worker": w,
+                          "round_ms": round(vals[w], 3),
+                          "median_ms": round(med_s * 1e3, 3)})
+    pod: Dict[str, Any] = {"n_workers": len(workers), "workers": workers,
+                           "straggler_rounds": straggler_rounds,
+                           "straggler_audit": audit[-20:]}
+    if skews:
+        pod["round_skew_ms"] = {"mean": round(_mean(skews), 3),
+                                "max": round(max(skews), 3),
+                                "rounds": len(skews)}
+    return pod
 
 
 def format_text(s: Dict[str, Any]) -> str:
@@ -131,6 +208,41 @@ def format_text(s: Dict[str, Any]) -> str:
             name = fld[2:-3]  # t_<phase>_ms
             lines.append(f"  {name:<14}{row['mean_ms']:>10.3f}"
                          f"{row['max_ms']:>10.3f}{row['total_s']:>10.3f}")
+    pod = s.get("pod")
+    if pod:
+        lines.append("")
+        lines.append(f"pod view ({pod['n_workers']} workers, per-worker "
+                     f"step-time means):")
+        # the table shows the three columns skew lives in; --json has all
+        cols = [f for f in ("t_data_ms", "t_h2d_ms", "t_round_ms")
+                if any(f in w for w in pod["workers"].values())]
+        hdr = f"  {'worker':<8}{'rounds':>7}{'loss':>10}"
+        hdr += "".join(f"{c[2:-3] + ' ms':>12}" for c in cols)
+        hdr += f"{'straggler':>11}"
+        lines.append(hdr)
+        for wid, w in pod["workers"].items():
+            row = f"  {wid:<8}{w['rounds']:>7}"
+            row += (f"{w['loss_final']:>10.4f}" if "loss_final" in w
+                    else f"{'-':>10}")
+            for c in cols:
+                row += (f"{w[c]['mean_ms']:>12.3f}" if c in w
+                        else f"{'-':>12}")
+            row += f"{pod['straggler_rounds'].get(wid, 0):>11}"
+            lines.append(row)
+        skew = pod.get("round_skew_ms")
+        if skew:
+            lines.append(f"  round skew across workers: mean "
+                         f"{skew['mean']:.3f} ms  max {skew['max']:.3f} ms "
+                         f"(over {skew['rounds']} matched rounds)")
+        if pod["straggler_audit"]:
+            lines.append("  straggler audit trail:")
+            for e in pod["straggler_audit"]:
+                lines.append(f"    round {e['step']:>6}  worker "
+                             f"{e['worker']}  {e['round_ms']:.3f} ms vs "
+                             f"median {e['median_ms']:.3f} ms")
+        else:
+            lines.append("  straggler audit trail: clean (no rounds "
+                         "flagged)")
     if s["event_trail"]:
         lines.append("")
         lines.append("health/event audit trail:")
@@ -146,9 +258,14 @@ def format_text(s: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
-def _selfcheck_jsonl() -> str:
-    """Run a tiny synthetic training (3 rounds, lenet shapes, CPU) and
-    return the metrics JSONL it wrote — the freshest possible schema."""
+def _selfcheck_jsonl(n_workers: int = 1,
+                     out_dir: Optional[str] = None) -> List[str]:
+    """Run tiny synthetic trainings (3 rounds each, lenet shapes, CPU) —
+    one per worker id — and return the metrics JSONLs they wrote, the
+    freshest possible schema. Each run also writes a trace JSON next to
+    its JSONL (with `out_dir` these survive as CI artifacts). Multi-worker
+    runs stamp `worker` on every record, so the merged summary exercises
+    the pod view against live-written files."""
     import os
     import tempfile
 
@@ -160,22 +277,30 @@ def _selfcheck_jsonl() -> str:
     from ..utils.logger import Logger
     from ..zoo import lenet
 
-    root = tempfile.mkdtemp(prefix="sparknet-metrics-selfcheck-")
+    root = out_dir or tempfile.mkdtemp(prefix="sparknet-metrics-selfcheck-")
+    os.makedirs(root, exist_ok=True)
     r = np.random.default_rng(0)
     n, b, tau = 256, 16, 2
     ds = ArrayDataset({
         "data": r.standard_normal((n, 1, 28, 28)).astype(np.float32),
         "label": r.integers(0, 10, (n, 1)).astype(np.int32)})
-    jsonl = os.path.join(root, "selfcheck_metrics.jsonl")
-    cfg = RunConfig(model="lenet", n_devices=1, local_batch=b, tau=tau,
-                    max_rounds=3, eval_every=0, workdir=root)
-    log = Logger(os.path.join(root, "selfcheck_log.txt"), echo=False,
-                 jsonl_path=jsonl)
-    try:
-        train(cfg, lenet(batch=b), ds, None, logger=log)
-    finally:
-        log.close()
-    return jsonl
+    paths: List[str] = []
+    for w in range(max(1, n_workers)):
+        suffix = f"_w{w}" if n_workers > 1 else ""
+        jsonl = os.path.join(root, f"selfcheck_metrics{suffix}.jsonl")
+        cfg = RunConfig(model="lenet", n_devices=1, local_batch=b, tau=tau,
+                        max_rounds=3, eval_every=0, workdir=root, seed=w,
+                        trace_out=os.path.join(
+                            root, f"selfcheck_trace{suffix}.json"))
+        log = Logger(os.path.join(root, f"selfcheck_log{suffix}.txt"),
+                     echo=False, jsonl_path=jsonl,
+                     worker=w if n_workers > 1 else None)
+        try:
+            train(cfg, lenet(batch=b), ds, None, logger=log)
+        finally:
+            log.close()
+        paths.append(jsonl)
+    return paths
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -192,6 +317,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--selfcheck", action="store_true",
                    help="run a 3-round synthetic training and summarize "
                    "its fresh JSONL (CI: the tool vs the live schema)")
+    p.add_argument("--selfcheck-workers", type=int, default=1,
+                   metavar="N",
+                   help="with --selfcheck: run N worker trainings and "
+                   "summarize the merged JSONLs — fails unless the pod "
+                   "view (per-worker breakdown + straggler audit) "
+                   "appears for N >= 2")
+    p.add_argument("--keep", metavar="DIR", default=None,
+                   help="with --selfcheck: write the selfcheck JSONL + "
+                   "trace artifacts under DIR and keep them (CI uploads "
+                   "these) instead of a deleted temp dir")
     args = p.parse_args(argv)
 
     paths: List[str] = []
@@ -200,9 +335,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         paths.extend(hits or [pat])
     selfcheck_dir = None
     if args.selfcheck:
-        jsonl = _selfcheck_jsonl()
-        selfcheck_dir = os.path.dirname(jsonl)
-        paths.append(jsonl)
+        jsonls = _selfcheck_jsonl(args.selfcheck_workers,
+                                  out_dir=args.keep)
+        if args.keep is None:
+            selfcheck_dir = os.path.dirname(jsonls[0])
+        paths.extend(jsonls)
     if not paths:
         p.error("no JSONL paths given (or use --selfcheck)")
 
@@ -218,6 +355,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(format_text(s))
     if args.selfcheck and not s["rounds"]:
         print("selfcheck: training produced no loss rows", file=sys.stderr)
+        return 1
+    if args.selfcheck and args.selfcheck_workers > 1 and "pod" not in s:
+        print("selfcheck: multi-worker run produced no pod view",
+              file=sys.stderr)
         return 1
     return 0
 

@@ -164,6 +164,19 @@ class ParallelTrainer:
                       in_specs=(dev, P(DATA_AXIS)),
                       out_specs=P()))
 
+    def compiled_variants(self) -> int:
+        """Entries in the jitted round's executable cache — 1 in steady
+        state; growth means something keeps retriggering XLA compilation
+        (a drifting batch shape/dtype, a layout change). The train loop
+        exports this as the `sparknet_train_round_compiled_variants`
+        gauge so jit-cache churn shows up on a scrape instead of as an
+        unexplained slow round. 0 when this jax version does not expose
+        the cache size."""
+        try:
+            return int(self._round._cache_size())
+        except Exception:
+            return 0
+
     # -- state construction --------------------------------------------------
 
     def _tp_sharded_layers(self) -> set:

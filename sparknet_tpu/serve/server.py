@@ -36,6 +36,7 @@ import numpy as np
 
 from ..obs import (MetricsRegistry, StatusServer, register_build_info,
                    trace as obs_trace)
+from ..obs import device as obs_device
 from ..utils.heartbeat import HeartbeatWriter
 from ..utils.logger import Logger
 from ..utils.metrics import FillMeter, LatencyStats
@@ -127,6 +128,18 @@ class InferenceServer:
         self._c_requests = self.registry.counter(
             "sparknet_serve_requests_total", "served requests by outcome",
             labels=("outcome",))
+        # jit-cache churn as a first-class metric: the FIRST forward of
+        # each batch bucket is the one that builds that bucket's compiled
+        # executable — count and time it. Steady state == len(buckets);
+        # growth past that means compile cliffs are back in the tail.
+        self._c_bucket_compiles = self.registry.counter(
+            "sparknet_serve_bucket_compiles_total",
+            "first forward per batch bucket (jit-cache entries built)")
+        self._h_bucket_compile = self.registry.histogram(
+            "sparknet_serve_bucket_compile_seconds",
+            "wall time of each bucket's first (compiling) forward",
+            buckets=obs_device.COMPILE_BUCKETS)
+        self._compiled_buckets: set = set()
         self.batcher = DynamicBatcher(cfg.max_batch,
                                       max_wait_s=cfg.max_wait_ms / 1e3,
                                       max_queue=cfg.max_queue,
@@ -230,6 +243,7 @@ class InferenceServer:
             "batches": batches,
             "batch_fill_ratio": round(real / padded if padded else 0.0, 4),
             "buckets": list(self.buckets),
+            "bucket_compiles": len(self._compiled_buckets),
             "model_step": m.step,
             "swaps": m.swaps,
             "swap_failures": m.swap_failures,
@@ -328,8 +342,16 @@ class InferenceServer:
                         f"(net has {sorted(full)})")
                 pad = np.zeros((bucket - n,) + v.shape[1:], v.dtype)
                 full[k] = np.concatenate([v, pad]) if bucket > n else v
+            t_fwd0 = time.perf_counter()
             out = self.net.forward(
                 full, blob_names=list(self.cfg.outputs or ()))
+            if bucket not in self._compiled_buckets:
+                # this forward traced+compiled the bucket's executable
+                self._compiled_buckets.add(bucket)
+                dt = time.perf_counter() - t_fwd0
+                self._c_bucket_compiles.inc()
+                self._h_bucket_compile.observe(dt)
+                obs_device.note_compile("serve_bucket", dt)
             # de-pad: slice each request's own row out of per-row blobs;
             # batch-AGGREGATE blobs (the zoo heads' scalar loss/accuracy
             # — averaged over padding, meaningless per request) are

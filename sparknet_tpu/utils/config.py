@@ -76,8 +76,10 @@ class RunConfig:
     # in the metrics JSONL; False restores the pre-obs behavior (the
     # bench.py --obs "disabled" arm). status_port serves /metrics
     # (Prometheus text, same name schema as serve), /healthz and /status
-    # from the TRAINING process (process 0; 0 = ephemeral — the bound
-    # address lands on cfg.status_address). trace_out captures host-side
+    # from EVERY training process (since the pod PR — each worker is its
+    # own scrape surface, the raw feed of pod aggregation; 0 = ephemeral,
+    # and co-located processes on one host MUST use 0 or distinct ports —
+    # the bound address lands on cfg.status_address). trace_out captures host-side
     # spans (round loop / prefetch / async checkpoint writer lanes) into
     # a Chrome-trace-event JSON loadable in Perfetto next to the
     # jax.profiler device trace.
@@ -91,6 +93,18 @@ class RunConfig:
     status_host: str = "127.0.0.1"
     status_address: Optional[Tuple[str, int]] = None
     trace_out: Optional[str] = None
+    # pod-scope observability (obs/pod.py). pod_dir is a shared prefix —
+    # local/NFS dir or a gs://|s3:// bucket — where EVERY worker rewrites
+    # its own worker-<i>.heartbeat.json (step/status/loss plus round_s /
+    # data_wait_s, the straggler-attribution inputs) at the heartbeat
+    # cadence. pod_port makes process 0 additionally run a PodAggregator
+    # endpoint over that prefix: merged pod /metrics, /pod/status JSON
+    # naming stragglers and stale workers (0 = ephemeral; bound address
+    # lands on pod_address — OUTPUT, leave None in configs). The
+    # standalone `sparknet-podview` console reads either surface.
+    pod_dir: Optional[str] = None
+    pod_port: Optional[int] = None
+    pod_address: Optional[Tuple[str, int]] = None
     # logging. None -> $SPARKNET_TPU_HOME, else "." (the reference logged
     # to $SPARKNET_HOME/training_log_<ms>.txt); tests set the env var to a
     # tmp dir so stray default-config runs never litter the repo root

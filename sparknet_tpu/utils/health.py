@@ -233,6 +233,34 @@ def _median(xs) -> float:
     return float(xs[m]) if n % 2 else 0.5 * (xs[m - 1] + xs[m])
 
 
+def mad_classify(values, thresh_sigma: float = 5.0,
+                 rel_floor: float = 0.25):
+    """Median+MAD outlier flags over one cross-sectional sample — the same
+    robust-sigma rule `HealthMonitor.observe` applies to its rolling loss
+    window, packaged for the pod aggregator's per-worker round times and
+    the summary tool's per-round skew audit.
+
+    Returns (median, robust_sigma, [flag per value]): value i is flagged
+    when it exceeds median + thresh_sigma * sigma, with sigma =
+    MAD * 1.4826 floored at rel_floor * |median| — a degenerate MAD
+    (identical values, the healthy-pod common case) must not turn
+    measurement noise into straggler flags, and a zero median must not
+    zero the floor (the max(|med|, tiny) guard). Fewer than 3 values
+    returns all-False: with n == 2 both deviations EQUAL the MAD, so the
+    rule mathematically cannot fire — callers wanting a 2-sample verdict
+    need a ratio rule (see obs/pod.py) instead of a fake sigma.
+    """
+    xs = [float(v) for v in values]
+    if len(xs) < 3:
+        med = _median(sorted(xs)) if xs else 0.0
+        return med, 0.0, [False] * len(xs)
+    s = sorted(xs)
+    med = _median(s)
+    mad = _median(sorted(abs(x - med) for x in s))
+    sigma = max(1.4826 * mad, rel_floor * max(abs(med), 1e-12))
+    return med, sigma, [x > med + thresh_sigma * sigma for x in xs]
+
+
 def poison_batch(batches: Dict[str, Any], mode: str,
                  scale: float = 1e3) -> Dict[str, Any]:
     """Deterministically poison one round's prepared batch (fault-injection

@@ -26,14 +26,33 @@ Writes are atomic (tmp file + os.replace in the same directory) so a
 reader never sees a torn JSON, and throttled to `interval_s` except when
 `force=True` (status CHANGES always deserve a beat — the whole point is
 that "sick" shows up promptly).
+
+`path` may also be a `gs://`/`s3://` URL: the beat becomes one small
+object PUT through the same native bucket writers checkpointing uses
+(single-object writes are atomic on both stores), which is what lets a
+POD write per-worker heartbeats to one shared prefix with no shared
+filesystem — the pod aggregator (`obs/pod.py`) reads them back from
+anywhere. Bucket PUTs run on a background thread with a latest-wins
+one-slot queue: the caller is the training round loop, and an object-
+store stall must cost it a dict handoff, not a client timeout (the same
+off-the-critical-path rule the async checkpoint writer enforces).
+`flush()` drains the slot (bounded wait) so a final "done" beat lands
+before process exit.
 """
 from __future__ import annotations
 
 import json
 import os
+import queue
 import tempfile
+import threading
 import time
+import warnings
 from typing import Any, Dict, Optional
+
+
+def _is_bucket(path: str) -> bool:
+    return isinstance(path, str) and path.startswith(("gs://", "s3://"))
 
 
 class HeartbeatWriter:
@@ -46,8 +65,17 @@ class HeartbeatWriter:
         self.interval_s = float(interval_s)
         self._last_t = 0.0
         self._last_status: Optional[str] = None
-        d = os.path.dirname(os.path.abspath(path))
-        os.makedirs(d, exist_ok=True)
+        self._q: Optional["queue.Queue"] = None
+        if _is_bucket(path):
+            # latest-wins one-slot queue + daemon writer: a beat is a
+            # dict handoff on the caller's (round-loop) thread; the PUT
+            # and any store stall happen over here
+            self._q = queue.Queue(maxsize=1)
+            threading.Thread(target=self._drain_bucket,
+                             name="heartbeat-write", daemon=True).start()
+        else:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
         # registry mirror (obs): a scraper that cannot reach the file —
         # Prometheus across hosts — still sees beat freshness and status
         self._c_beats = self._g_ts = None
@@ -59,6 +87,31 @@ class HeartbeatWriter:
                 "sparknet_heartbeat_timestamp_seconds",
                 "epoch seconds of the last beat (staleness = now - this)",
                 labels=("role",))
+
+    def _drain_bucket(self) -> None:
+        from .checkpoint import _bucket_ops
+        ops = _bucket_ops(self.path)
+        while True:
+            rec = self._q.get()
+            try:
+                ops.write(self.path, json.dumps(rec).encode())
+            except Exception as e:
+                # best-effort by contract: a store blip drops this beat,
+                # the next one overwrites anyway
+                warnings.warn(f"heartbeat bucket write failed: {e}",
+                              RuntimeWarning)
+            finally:
+                self._q.task_done()
+
+    def flush(self, timeout_s: float = 5.0) -> None:
+        """Bounded wait for the in-flight bucket PUT (exit paths: the
+        final 'done' beat should land before the process dies). Local
+        writes are synchronous — nothing to flush."""
+        if self._q is None:
+            return
+        deadline = time.monotonic() + timeout_s
+        while self._q.unfinished_tasks and time.monotonic() < deadline:
+            time.sleep(0.05)
 
     def beat(self, step: int, status: str = "ok", rollbacks: int = 0,
              force: bool = False, **extra: Any) -> bool:
@@ -74,6 +127,28 @@ class HeartbeatWriter:
                                "status": str(status),
                                "rollbacks": int(rollbacks)}
         rec.update(extra)
+        if self._q is not None:
+            # bucket path: hand the record to the writer thread, latest
+            # wins — if a PUT is still in flight, the queued (older)
+            # record is replaced rather than blocking the caller
+            try:
+                self._q.put_nowait(rec)
+            except queue.Full:
+                try:
+                    self._q.get_nowait()
+                    self._q.task_done()
+                except queue.Empty:
+                    pass
+                try:
+                    self._q.put_nowait(rec)
+                except queue.Full:
+                    pass  # raced a concurrent beater; their rec is newer
+            self._last_t = now
+            self._last_status = status
+            if self._c_beats is not None:
+                self._c_beats.inc(role=self.role)
+                self._g_ts.set(now, role=self.role)
+            return True
         d = os.path.dirname(os.path.abspath(self.path))
         fd, tmp = tempfile.mkstemp(dir=d, prefix=".hb-")
         try:
@@ -97,12 +172,18 @@ class HeartbeatWriter:
 def read_heartbeat(path: str) -> Optional[Dict[str, Any]]:
     """The current heartbeat dict, or None when the file is missing or
     torn (a torn read is impossible from HeartbeatWriter's atomic replace,
-    but a foreign/partial file must not crash the prober)."""
+    but a foreign/partial file must not crash the prober). Accepts
+    `gs://`/`s3://` URLs like the writer."""
     try:
+        if _is_bucket(path):
+            from .checkpoint import _bucket_ops
+            return json.loads(_bucket_ops(path).read(path))
         with open(path) as f:
             return json.load(f)
     except (OSError, ValueError):
         return None
+    except Exception:
+        return None  # bucket client errors degrade like a missing file
 
 
 def staleness_s(hb: Optional[Dict[str, Any]]) -> Optional[float]:

@@ -27,9 +27,16 @@ def _json_safe(v: Any) -> Any:
 
 class Logger:
     def __init__(self, path: Optional[str] = None, echo: bool = True,
-                 jsonl_path: Optional[str] = None):
+                 jsonl_path: Optional[str] = None,
+                 worker: Optional[int] = None):
         self.t0 = time.time()
         self.echo = echo
+        # worker id stamped on every JSONL record — the key that lets
+        # `sparknet-metrics` group N merged per-worker files into the pod
+        # view (per-worker breakdown, round skew, straggler audit). The
+        # train loop fills it in on multi-host runs when the caller
+        # didn't; single-process records stay byte-identical to before.
+        self.worker = worker
         self._f = open(path, "a", buffering=1) if path else None
         self._jsonl = open(jsonl_path, "a", buffering=1) if jsonl_path else None
 
@@ -57,6 +64,8 @@ class Logger:
             rec: Dict[str, Any] = {"step": step,
                                    "t": round(now - self.t0, 3),
                                    "ts": round(now, 3)}
+            if self.worker is not None:
+                rec["worker"] = int(self.worker)
             rec.update({k: _json_safe(float(v) if hasattr(v, "__float__")
                                       else v)
                         for k, v in kv.items()})

@@ -38,6 +38,8 @@ case "$*" in
   *"tpu-vm ssh"*)
     case "$*" in
       *"pip install"*) exit 0 ;;   # setup
+      *"curl "*) cat "$DIR/podstatus" 2>/dev/null; exit 0 ;;
+      *"worker-"*) cat "$DIR/podhb" 2>/dev/null; exit 0 ;;
       *"--command cat "*) cat "$DIR/heartbeat" 2>/dev/null; exit 0 ;;
     esac
     line=$(head -n 1 "$DIR/runplan" 2>/dev/null || echo ok)
@@ -155,6 +157,42 @@ def test_watch_reports_heartbeat_on_ready_failure(launcher):
     assert "nonfinite" in r.stderr
     # and without the knob no heartbeat ssh traffic happens at all
     assert launcher.calls().count("--command cat") == 2
+
+
+def test_watch_pod_status_probe_names_sick_worker(launcher):
+    """With TPU_POD_STATUS_PORT set, a READY-pod failure curls worker 0's
+    pod aggregation endpoint and echoes the MERGED pod JSON — a sick or
+    straggling worker != 0 is named by id, which the single worker-0
+    heartbeat probe could never do."""
+    launcher("create", "pod", "z", "v5e-32")
+    (launcher.stub_dir / "podstatus").write_text(
+        '{"n_workers": 4, "n_alive": 4, "stragglers": ["2"], '
+        '"workers": [{"worker": "2", "status": "nonfinite"}]}')
+    r = launcher("watch", "pod", "z", "v5e-32", "python -m app",
+                 plan=["fail", "fail"],
+                 env={"TPU_POD_STATUS_PORT": "9100"})
+    assert r.returncode == 1  # two READY failures: app error
+    assert "pod status from worker 0" in r.stderr
+    assert '"stragglers": ["2"]' in r.stderr
+    assert "curl" in launcher.calls()
+
+
+def test_watch_pod_file_fallback_names_sick_worker(launcher):
+    """Pod endpoint unreachable -> fall back to per-worker heartbeat
+    files on the shared TPU_POD_DIR prefix: every worker's beat is
+    echoed with its id, so the sick worker is still named."""
+    launcher("create", "pod", "z", "v5e-32")
+    (launcher.stub_dir / "podhb").write_text(
+        '{"t": 1.0, "worker": 0, "status": "ok"}\n'
+        '{"t": 1.0, "worker": 1, "status": "nonfinite"}')
+    r = launcher("watch", "pod", "z", "v5e-32", "python -m app",
+                 plan=["fail", "fail"],
+                 env={"TPU_POD_STATUS_PORT": "9100",
+                      "TPU_POD_DIR": "/data/pod"})
+    assert r.returncode == 1
+    assert "falling back" in r.stderr
+    assert "per-worker heartbeats" in r.stderr
+    assert '"worker": 1' in r.stderr and "nonfinite" in r.stderr
 
 
 def test_watch_creates_from_nothing(launcher):
