@@ -953,6 +953,217 @@ def obs_bench(out_path: str | None = "BENCH_OBS.json", rounds: int = 40,
     return out
 
 
+def elastic_bench(out_path: str | None = "BENCH_ELASTIC.json",
+                  rounds: int = 36, kill_round: int = 6,
+                  rejoin_rounds: int = 8, workers: int = 4,
+                  keep: str | None = None) -> dict:
+    """Elastic chaos soak (ROADMAP item 3's measure): the same training
+    run three ways on a virtual CPU pod of `workers` 1-device workers —
+
+      static  fixed membership, the baseline loss curve;
+      chaos   a worker's heartbeat goes silent at `kill_round` (backdated
+              beat — "preempted minutes ago"), the MembershipController
+              evicts it (stale -> full-jitter re-probes), the loop
+              resizes through the verified checkpoint store, and
+              `rejoin_rounds` rounds later the worker beats again and is
+              adopted back;
+      halt    min_workers == pod size, one worker dies -> the run must
+              checkpoint (verified) and raise TrainingHealthError, never
+              hang.
+
+    Headline: final-loss ratio chaos/static (target <= 1.05 — τ-interval
+    averaging should shrug off a membership change the way the paper says
+    it shrugs off stale averages), with zero hangs and every eviction/
+    rejoin visible in BOTH the JSONL audit trail and a live /pod/status
+    scrape. `keep` retains the chaos arm's JSONL + pod dir for CI
+    artifact upload."""
+    import json as _json
+    import os
+    import shutil
+    import tempfile
+    import urllib.request
+
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               f" --xla_force_host_platform_device_count="
+                               f"{max(8, workers)}").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from sparknet_tpu.apps.train_loop import train
+    from sparknet_tpu.data.dataset import ArrayDataset
+    from sparknet_tpu.obs import run_metadata
+    from sparknet_tpu.obs.pod import worker_heartbeat_path
+    from sparknet_tpu.utils import checkpoint as ck
+    from sparknet_tpu.utils.config import ElasticConfig, RunConfig
+    from sparknet_tpu.utils.health import TrainingHealthError
+    from sparknet_tpu.utils.heartbeat import HeartbeatWriter
+    from sparknet_tpu.utils.logger import Logger
+    from sparknet_tpu.zoo import lenet
+
+    b, tau = 16, 2
+    r = np.random.default_rng(0)
+    ds = ArrayDataset({
+        "data": r.standard_normal((2048, 1, 28, 28)).astype(np.float32),
+        "label": r.integers(0, 10, (2048, 1)).astype(np.int32)})
+
+    def run_arm(root: str, chaos: bool, min_workers: int = 1,
+                max_rounds: int = rounds) -> dict:
+        pod = os.path.join(root, "pod")
+        cfg = RunConfig(
+            model="lenet", n_devices=workers, local_batch=b, tau=tau,
+            max_rounds=max_rounds, eval_every=0, workdir=root,
+            checkpoint_dir=os.path.join(root, "ck"), checkpoint_every=4,
+            pod_dir=pod, pod_port=0, heartbeat_every_s=0.0,
+            elastic=ElasticConfig(
+                enabled=True, expected_workers=workers, stale_after_s=30.0,
+                reprobe_backoff_s=0.05, dead_probes=2, poll_interval_s=0.0,
+                min_workers=min_workers))
+        victim = workers - 2 if workers > 2 else 1
+        hbs = {i: HeartbeatWriter(worker_heartbeat_path(pod, i),
+                                  interval_s=0.0)
+               for i in range(1, workers)}
+        for i, hb in hbs.items():
+            hb.beat(0, status="ok", round_s=0.01, force=True)
+        state = {"killed": False, "rejoined": False, "kill_rnd": None,
+                 "pod_status": None, "shapes": set()}
+
+        def hook(rnd, st):
+            ndev = np.asarray(
+                st.params[list(st.params)[0]]["w"]).shape[0]
+            state["shapes"].add(ndev)
+            for i, hb in hbs.items():
+                if i == victim and state["killed"] and \
+                        not state["rejoined"]:
+                    continue
+                hb.beat(rnd, status="ok", round_s=0.01, data_wait_s=0.0,
+                        force=True)
+            if not chaos:
+                return
+            if not state["killed"] and rnd >= kill_round:
+                state["killed"] = True
+                state["kill_rnd"] = rnd
+                p = worker_heartbeat_path(pod, victim)
+                rec = _json.load(open(p))
+                rec["t"] -= 1e4  # "preempted minutes ago"
+                _json.dump(rec, open(p, "w"))
+            elif state["killed"] and not state["rejoined"] and \
+                    ndev < workers:
+                if state["pod_status"] is None and cfg.pod_address:
+                    # eviction visible on a LIVE scrape, mid-run
+                    host, port = cfg.pod_address
+                    state["pod_status"] = _json.loads(urllib.request.urlopen(
+                        f"http://{host}:{port}/pod/status",
+                        timeout=10).read())
+                if rnd >= state["kill_rnd"] + rejoin_rounds:
+                    state["rejoined"] = True
+                    hbs[victim].beat(rnd, status="ok", round_s=0.01,
+                                     force=True)
+
+        jsonl = os.path.join(root, "metrics.jsonl")
+        log = Logger(os.path.join(root, "log.txt"), echo=False,
+                     jsonl_path=jsonl)
+        err = None
+        try:
+            train(cfg, lenet(batch=b), ds, None, logger=log,
+                  round_hook=hook)
+        except TrainingHealthError as e:
+            err = str(e)
+        finally:
+            log.close()
+        recs = [_json.loads(l) for l in open(jsonl)]
+        losses = [rec["loss"] for rec in recs if "loss" in rec]
+        resizes = [rec for rec in recs if rec.get("event") == "resize"]
+        return {"cfg": cfg, "root": root, "losses": losses,
+                "resizes": resizes, "err": err,
+                "pod_status": state["pod_status"],
+                "shapes": sorted(state["shapes"])}
+
+    out_rows: dict = {}
+    arm_roots: dict = {}
+
+    def keep_artifacts() -> None:
+        # runs on EVERY exit path (finally): the soak's own asserts fire
+        # while the TemporaryDirectory is still alive, and CI's
+        # upload-on-failure step needs the JSONL + pod dirs precisely
+        # when an assert fails — copying only-on-success would delete
+        # the evidence with the tmpdir
+        if not keep:
+            return
+        os.makedirs(keep, exist_ok=True)
+        for name, root in arm_roots.items():
+            jsonl = os.path.join(root, "metrics.jsonl")
+            if os.path.exists(jsonl):
+                shutil.copy(jsonl,
+                            os.path.join(keep, f"{name}.metrics.jsonl"))
+            pod_src = os.path.join(root, "pod")
+            if os.path.isdir(pod_src):
+                shutil.copytree(pod_src, os.path.join(keep, f"{name}.pod"),
+                                dirs_exist_ok=True)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        try:
+            print("  arm: static", file=sys.stderr)
+            arm_roots["static"] = os.path.join(tmp, "static")
+            static = run_arm(arm_roots["static"], chaos=False)
+            assert not static["resizes"], "static arm must not resize"
+            print("  arm: chaos (kill + rejoin)", file=sys.stderr)
+            arm_roots["chaos"] = os.path.join(tmp, "chaos")
+            chaos = run_arm(arm_roots["chaos"], chaos=True)
+            evicts = [r_ for r_ in chaos["resizes"] if r_["dead"]]
+            rejoins = [r_ for r_ in chaos["resizes"] if r_["joined"]]
+            assert evicts, "chaos arm: eviction never happened"
+            assert rejoins, "chaos arm: rejoin never happened"
+            ps = chaos["pod_status"]
+            assert ps is not None and (
+                ps.get("membership_epoch") or ps.get("candidate_dead")), \
+                "/pod/status never showed the membership change"
+            print("  arm: halt (below min_workers)", file=sys.stderr)
+            arm_roots["halt"] = os.path.join(tmp, "halt")
+            halt = run_arm(arm_roots["halt"], chaos=True,
+                           min_workers=workers, max_rounds=rounds * 4)
+            assert halt["err"] and "min_workers" in halt["err"], \
+                "halt arm must raise TrainingHealthError"
+            halt_step = ck.newest_verified_step(halt["cfg"].checkpoint_dir)
+            assert halt_step is not None, \
+                "halt arm left no verified checkpoint"
+        finally:
+            keep_artifacts()
+        final = lambda ls: float(np.mean(ls[-3:]))  # noqa: E731
+        ratio = final(chaos["losses"]) / final(static["losses"])
+        out_rows = {
+            "static_final3": round(final(static["losses"]), 5),
+            "chaos_final3": round(final(chaos["losses"]), 5),
+            "chaos_shapes": chaos["shapes"],
+            "evictions": [{k: r_[k] for k in ("step", "dead", "n_workers")}
+                          for r_ in evicts],
+            "rejoins": [{k: r_[k] for k in ("step", "joined", "n_workers")}
+                        for r_ in rejoins],
+            "pod_status_mid_chaos": {
+                "membership_epoch": ps.get("membership_epoch"),
+                "candidate_dead": ps.get("candidate_dead"),
+                "n_alive": ps.get("n_alive")},
+            "halt": {"error": halt["err"][:160],
+                     "verified_checkpoint_step": halt_step},
+        }
+    out = {
+        "metric": "elastic_chaos_final_loss_ratio",
+        "value": round(ratio, 4),
+        "unit": "final-3-round mean loss, kill+rejoin soak vs static pod "
+                "(target <= 1.05; zero hangs, evictions/rejoins visible "
+                "in JSONL + /pod/status)",
+        "vs_baseline": round(1.05 / max(ratio, 1e-9), 3),
+        **out_rows,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({**out, "meta": run_metadata()}, f, indent=1)
+    print(json.dumps({k: out[k] for k in
+                      ("metric", "value", "unit", "vs_baseline")}))
+    return out
+
+
 def e2e_smoke() -> None:
     """Integrated proof on the REAL chip at tunnel-feasible scale: tar
     shards -> streaming source -> preprocessor -> ParallelTrainer rounds
@@ -1026,6 +1237,16 @@ def main() -> None:
                    help="telemetry overhead: per-round time with the obs "
                    "layer fully on (registry + breakdown + trace + "
                    "scraped /metrics) vs disabled; writes BENCH_OBS")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic chaos soak: kill + re-add a worker on a "
+                   "virtual pod, compare the loss curve to a static pod, "
+                   "verify the min_workers halt; writes BENCH_ELASTIC")
+    p.add_argument("--elastic-rounds", type=int, default=36,
+                   help="rounds per arm for --elastic (CI short config "
+                   "uses fewer)")
+    p.add_argument("--keep", metavar="DIR", default=None,
+                   help="retain --elastic JSONL + pod artifacts in DIR "
+                   "(CI uploads them on failure)")
     p.add_argument("--featurize", action="store_true",
                    help="batched forward(blob_names=['fc7']) img/s on both "
                    "backends (the FeaturizerApp inference path)")
@@ -1054,6 +1275,8 @@ def main() -> None:
                     max_batch=args.batch or 8)
     elif args.obs:
         obs_bench()
+    elif args.elastic:
+        elastic_bench(rounds=args.elastic_rounds, keep=args.keep)
     elif args.featurize:
         featurize_bench(batch=args.batch or 64)
     elif args.graph:

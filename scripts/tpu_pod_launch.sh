@@ -42,6 +42,21 @@
 # from another terminal mid-run — watch recreates and the training log shows
 # "resumed from checkpoint round N".
 #
+# ── Elastic membership (RunConfig.elastic + pod_dir) ──────────────────────
+# With cfg.elastic.enabled the app ITSELF tolerates losing/gaining workers:
+# the MembershipController watches the per-worker heartbeats under
+# cfg.pod_dir, evicts a silent worker (stale beat + full-jitter re-probes),
+# and resizes through the verified checkpoint store. When the change can't
+# be applied in-process (multi-host runtimes), the app exits 75
+# (EX_TEMPFAIL) — `watch` treats 75 as "relaunch me now, no strike": the
+# re-issued command resumes elastically from the newest checkpoint (the
+# boundary snapshot on single-host exits; the last periodic one on
+# multi-host, where a boundary save could hang on a split membership
+# view), and a previously killed worker that comes back is adopted as a
+# joiner instead of failing the pod. Below cfg.elastic.min_workers the
+# app checkpoints and exits loudly (TrainingHealthError) — that IS an
+# app error; watch stops.
+#
 # `create-queued` files a queued resource (the supported path for large pods
 # and the only way to wait for spot capacity) and blocks until it turns
 # ACTIVE; `delete` also cleans up the queued-resource wrapper if one exists.
@@ -296,8 +311,23 @@ case "$CMD" in
       fi
       [ -z "$RECREATED" ] || ready_fails=0
       run_began=$(date +%s)
-      if do_run "$ARG2"; then
+      rc=0; do_run "$ARG2" || rc=$?
+      if [ "$rc" -eq 0 ]; then
         echo "watch: command completed" >&2; break
+      fi
+      # exit 75 (EX_TEMPFAIL) is the app's ELASTIC relaunch request
+      # (sparknet_tpu.parallel.elastic.ElasticRelaunch): pod membership
+      # changed and the relaunched command resumes elastically at the
+      # new size from the checkpoint store (single-host cases write a
+      # boundary snapshot first; multi-host pods resume from the newest
+      # periodic checkpoint — see ElasticRelaunch's docstring). A killed
+      # worker comes back as a JOINER instead of failing the pod.
+      # Never a strike, no recreate, re-run now.
+      if [ "$rc" -eq 75 ]; then
+        echo "watch: run exited 75 (elastic membership change);" \
+             "relaunching — checkpoint resume rejoins the survivors" >&2
+        ready_fails=0
+        continue
       fi
       run_secs=$(( $(date +%s) - run_began ))
       s=$(vm_state)

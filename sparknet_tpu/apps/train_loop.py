@@ -38,6 +38,7 @@ from ..obs import (MetricsRegistry, StatusServer, register_build_info,
                    trace as obs_trace)
 from ..obs import device as obs_device
 from ..obs import pod as obs_pod
+from ..parallel.elastic import ElasticRelaunch, MembershipController
 from ..parallel.mesh import fetch_global, make_mesh
 from ..parallel.trainer import ParallelTrainer, TrainState
 from ..data.dataset import ArrayDataset, RoundSampler
@@ -129,10 +130,12 @@ def train(cfg: RunConfig, spec: NetSpec, train_ds: ArrayDataset,
     net = CompiledNet.compile(spec)
     mesh = make_mesh(cfg.n_devices)
     n_dev = int(np.prod(mesh.devices.shape))
+    compute_health = cfg.health is not None and cfg.health.enabled
+    elastic_tau = (cfg.elastic is not None and cfg.elastic.enabled
+                   and cfg.elastic.tau_adapt)
     trainer = ParallelTrainer(net, cfg.solver, mesh, tau=cfg.tau,
-                              mode=cfg.mode,
-                              compute_health=(cfg.health is not None
-                                              and cfg.health.enabled))
+                              mode=cfg.mode, compute_health=compute_health,
+                              elastic_tau=elastic_tau)
     log.log(f"mesh: {n_dev} devices; tau={cfg.tau} mode={cfg.mode} "
             f"local_batch={cfg.local_batch} precision={cfg.precision}")
     if batch_transform is None:
@@ -143,7 +146,11 @@ def train(cfg: RunConfig, spec: NetSpec, train_ds: ArrayDataset,
                     batch_transform=batch_transform,
                     eval_transform=eval_transform,
                     probe=lambda s: probe_value(s, net),
-                    round_hook=round_hook)
+                    round_hook=round_hook,
+                    # ParallelTrainer.resized carries the whole trainer
+                    # configuration (net/solver/τ/mode/health/elastic_tau)
+                    # to the new mesh — the one resize construction path
+                    trainer_factory=trainer.resized)
 
 
 def prepare_round_batches(source, rnd: int, tau: int, seed: int,
@@ -198,7 +205,7 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
              test_ds: Optional[ArrayDataset], log: Logger,
              batch_transform=None, eval_transform=None,
              probe: Optional[Callable[[Any], float]] = None,
-             round_hook=None):
+             round_hook=None, trainer_factory=None):
     """The reference app loop, generic over the trainer backend: any object
     with init_state/place/train_round/evaluate + n_devices (ParallelTrainer
     for the layer IR, GraphTrainer for serialized graphs — the same way
@@ -220,7 +227,18 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     + `batch_transform` preprocessing) for round R+1 is overlapped with
     round R's device compute via a one-deep prefetch thread — the reference
     prepared batches inline on each executor and stalled the GPU every
-    round."""
+    round.
+
+    `trainer_factory(n_devices)` builds a replacement trainer over a
+    resized mesh — the elastic-membership path (cfg.elastic +
+    cfg.pod_dir): when the MembershipController declares a worker dead or
+    adopts a joiner, the loop checkpoints at the τ boundary, rebuilds the
+    compiled round via the factory, restores through the newest verified
+    snapshot, and reshards the data. Without a factory (GraphTrainer
+    callers) a single-host membership change checkpoints then raises
+    ElasticRelaunch (exit 75) so the launcher relaunches at the new size;
+    multi-host loops raise without the boundary save (see
+    ElasticRelaunch) and resume from the newest periodic checkpoint."""
     n_dev = trainer.n_devices
     n_local = getattr(trainer, "n_local_devices", n_dev)
     if getattr(log, "worker", None) is None and jax.process_count() > 1:
@@ -269,7 +287,7 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     registry = (MetricsRegistry()
                 if cfg.telemetry or cfg.status_port is not None else None)
     g_round = g_loss = c_rounds = None
-    g_round_s = g_wait_s = dev_tel = None
+    g_round_s = g_wait_s = dev_tel = g_variants = None
     if registry is not None:
         register_build_info(registry)
         g_round = registry.gauge("sparknet_train_round",
@@ -293,11 +311,11 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         dev_tel = obs_device.DeviceTelemetry(registry)
         obs_device.attach_compile_metrics(registry)
         if hasattr(trainer, "compiled_variants"):
-            registry.gauge(
+            g_variants = registry.gauge(
                 "sparknet_train_round_compiled_variants",
                 "jit-cache entries for the compiled round (1 = steady "
-                "state; growth = recompiles)").set_fn(
-                    trainer.compiled_variants)
+                "state; growth = recompiles)")
+            g_variants.set_fn(trainer.compiled_variants)
     timers = PhaseTimers(registry=registry)
     if cfg.telemetry and hasattr(trainer, "phase_timers"):
         # h2d / dispatch split from inside train_round (ParallelTrainer).
@@ -344,6 +362,28 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         obs_pod.worker_heartbeat_path(cfg.pod_dir, jax.process_index()),
         role="train", interval_s=cfg.heartbeat_every_s)
         if cfg.pod_dir else None)
+    # elastic membership (parallel/elastic.py): watch the pod heartbeats,
+    # declare workers dead (stale + full-jitter re-probes, never one
+    # missed beat) or joined, and drive a resize at the τ boundary. The
+    # heartbeat prefix IS the liveness channel and the verified
+    # checkpoint store IS the recovery channel, so both are required.
+    elastic_cfg = (cfg.elastic
+                   if cfg.elastic is not None and cfg.elastic.enabled
+                   else None)
+    membership = None
+    if elastic_cfg is not None:
+        if not cfg.pod_dir:
+            raise ValueError(
+                "cfg.elastic.enabled requires cfg.pod_dir: the per-worker "
+                "heartbeats under it are how membership is observed")
+        if not cfg.checkpoint_dir:
+            raise ValueError(
+                "cfg.elastic.enabled requires cfg.checkpoint_dir: a "
+                "resize restores workers from the newest verified "
+                "checkpoint")
+        membership = MembershipController(
+            elastic_cfg, cfg.pod_dir, self_worker=jax.process_index(),
+            expected_workers=jax.process_count(), registry=registry)
     # host-side span capture (--trace-out): spans from the round loop,
     # the round-prep prefetch thread and the ckpt-write thread land on
     # per-thread lanes of ONE Chrome-trace timeline (obs/trace.py) —
@@ -395,7 +435,12 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     if cfg.pod_port is not None and cfg.pod_dir and \
             jax.process_index() == 0:
         try:
-            pod_srv = obs_pod.PodAggregator(pod_dir=cfg.pod_dir).serve(
+            # one staleness rule: the aggregator's down/stale verdicts use
+            # the SAME threshold the elastic controller evicts on
+            pod_srv = obs_pod.PodAggregator(
+                pod_dir=cfg.pod_dir,
+                stale_after_s=(elastic_cfg.stale_after_s
+                               if elastic_cfg is not None else 120.0)).serve(
                 cfg.pod_port, host=cfg.status_host)
         except OSError as e:
             warnings.warn(f"pod status server failed to bind port "
@@ -408,6 +453,11 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
 
     def beat(step: int, status: str, force: bool = False, **kv) -> None:
         rollbacks = monitor.rollbacks if monitor is not None else 0
+        if membership is not None:
+            # membership epoch rides every beat so the pod view (and a
+            # joiner reading the prefix) sees resizes without scraping
+            kv.setdefault("membership_epoch", membership.epoch)
+            kv.setdefault("n_members", len(membership.members))
         for hb, extra in ((heartbeat, kv),
                           (pod_hb, {**kv,
                                     "worker": jax.process_index(),
@@ -455,6 +505,42 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     # are retries/replays (fault injection only fires above it, so a
     # retried window is clean but later configured rounds still fire)
     high_water = start_round - 1
+    # elastic bootstrap: seed membership from the heartbeats already on
+    # the prefix (fresh ones only — leftovers of a previous incarnation
+    # never count) and pin the devices-per-worker ratio every resize
+    # preserves. An indivisible mesh disables LIVE resizing (membership
+    # changes then checkpoint-and-relaunch), it never disables watching.
+    devices_per_worker = None
+    if membership is not None:
+        membership.poll(start_round, force=True)
+        # the SEEDED membership, not expected_workers: an extra worker
+        # with a fresh beat at the first poll is a member from round 0,
+        # and the devices-per-worker ratio pinned here must match the
+        # membership the later resize events count against
+        n_members = max(1, len(membership.members))
+        if n_members < max(1, elastic_cfg.min_workers):
+            # guard the relaunch loop: a pod relaunched (exit 75) at a
+            # size already below min_workers must halt loudly HERE, not
+            # bounce between relaunches forever
+            raise TrainingHealthError(
+                f"elastic: launched with {n_members} worker(s), below "
+                f"min_workers={elastic_cfg.min_workers} — refusing to "
+                f"start; the newest verified checkpoint resumes once "
+                f"capacity returns.")
+        if n_dev % n_members == 0:
+            devices_per_worker = n_dev // n_members
+        else:
+            warnings.warn(
+                f"elastic: {n_dev} devices over {n_members} workers is "
+                f"not an integer devices-per-worker split — membership "
+                f"changes will relaunch instead of resizing live",
+                RuntimeWarning)
+        vitals["membership_epoch"] = membership.epoch
+        log.log(f"elastic membership: {sorted(membership.members)} "
+                f"({n_members} worker(s), "
+                f"{devices_per_worker or '?'} device(s)/worker; "
+                f"stale_after={elastic_cfg.stale_after_s}s "
+                f"min_workers={elastic_cfg.min_workers})")
 
     def prepare_round(rnd: int, retry_: int,
                       first_pass: bool) -> Dict[str, np.ndarray]:
@@ -622,6 +708,157 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         beat(ck_round, status="rollback", force=True, reason=reason)
         return state, ck_round
 
+    def apply_resize(state, ev, rnd):
+        """Membership changed: drive the safe resize at this τ boundary.
+
+        Order matters: (1) drain the pipeline (deferred fetches, the
+        prefetched next round, the in-flight checkpoint write), (2) write
+        the boundary snapshot — BOTH the resize restore and the
+        min_workers halt must leave a verified checkpoint behind, (3)
+        halt loudly if the pod is too small, (4) rebuild the compiled
+        round over the new worker set and restore every worker — survivor
+        or joiner alike — from the newest verified checkpoint (params
+        exact, momentum per the A/B-validated policy), (5) reshard the
+        data partitions. Single-host loops that cannot resize (no
+        factory / non-reshardable source) do (1)-(3) then raise
+        ElasticRelaunch (exit 75) for the launcher. MULTI-HOST loops
+        raise ElasticRelaunch before ANY of it: membership is observed
+        per process, so the boundary save's collective could hang on a
+        split membership view — the relaunch resumes from the newest
+        periodic checkpoint instead. Degrade loudly, never hang on a
+        collective a dead worker will not join. Returns (state, round)
+        like recover()."""
+        nonlocal trainer, trainer_factory, source, n_dev, n_local, pending
+        flush_deferred()
+        if pending is not None:
+            if not pending.cancel():
+                try:  # already running: wait it out (same rule recover
+                    pending.result()  # applies — never race the source)
+                except Exception:
+                    pass
+            pending = None
+        if jax.process_count() > 1:
+            # membership is observed PER PROCESS (jittered re-probes):
+            # processes reach this decision at different rounds, so
+            # entering a collective (the boundary checkpoint's
+            # allgather) here could hang — the exact failure mode this
+            # layer exists to prevent. Exit 75 instead; the launcher
+            # relaunches the whole pod at the new size and resume picks
+            # up the last periodic checkpoint.
+            log.event(rnd, "resize", epoch=ev.epoch, dead=list(ev.dead),
+                      joined=list(ev.joined), reasons=ev.reasons,
+                      n_workers=ev.n_workers, relaunch=True)
+            beat(rnd, status="resize", force=True,
+                 dead=list(ev.dead), joined=list(ev.joined))
+            if ev.n_workers < max(1, elastic_cfg.min_workers):
+                # below min_workers, exit 75 would BOUNCE: the launcher
+                # relaunches without a strike, the dead worker is still
+                # dead, and the relaunched pod re-evicts its way back
+                # here forever. Halt loudly instead — still no boundary
+                # save (its collective could hang on a split membership
+                # view); the newest periodic checkpoint is the resume
+                # point.
+                raise TrainingHealthError(
+                    f"elastic: pod fell to {ev.n_workers} worker(s) "
+                    f"(dead: {list(ev.dead)}), below min_workers="
+                    f"{elastic_cfg.min_workers}. Resume from the newest "
+                    f"periodic checkpoint under {cfg.checkpoint_dir!r} "
+                    f"once capacity returns.")
+            raise ElasticRelaunch(
+                f"membership epoch {ev.epoch}: {ev.n_workers} worker(s) "
+                f"(dead {list(ev.dead)}, joined {list(ev.joined)}); "
+                f"multi-host pod relaunches at the new size")
+        ckpt_barrier()
+        with timers.phase("checkpoint"):
+            _save_checkpoint(cfg, trainer, state, rnd, source=source,
+                             last_round=rnd - 1,
+                             anomalous=(monitor is not None and
+                                        monitor.recently_anomalous(rnd)),
+                             health_state=_health_state(retry, lr_scale,
+                                                        monitor))
+        log.event(rnd, "resize", epoch=ev.epoch, dead=list(ev.dead),
+                  joined=list(ev.joined), reasons=ev.reasons,
+                  n_workers=ev.n_workers)
+        vitals["membership_epoch"] = ev.epoch
+        beat(rnd, status="resize", force=True,
+             dead=list(ev.dead), joined=list(ev.joined))
+        if ev.n_workers < max(1, elastic_cfg.min_workers):
+            raise TrainingHealthError(
+                f"elastic: pod fell to {ev.n_workers} worker(s) "
+                f"(dead: {list(ev.dead)}), below min_workers="
+                f"{elastic_cfg.min_workers}. A verified checkpoint at "
+                f"round {rnd} is saved under {cfg.checkpoint_dir!r} — "
+                f"relaunch with capacity to continue.")
+        new_n_dev = (devices_per_worker or 0) * ev.n_workers
+        can_resize_live = (
+            jax.process_count() == 1 and trainer_factory is not None
+            and devices_per_worker is not None
+            # TP shard assignment changes with the mesh: resized() would
+            # raise — take the checkpoint-and-relaunch path instead
+            and getattr(trainer, "tp", 1) == 1
+            and 0 < new_n_dev <= len(jax.devices())
+            and hasattr(source, "reshard"))
+        if not can_resize_live:
+            raise ElasticRelaunch(
+                f"membership epoch {ev.epoch}: {ev.n_workers} worker(s) "
+                f"(dead {list(ev.dead)}, joined {list(ev.joined)}); "
+                f"checkpointed round {rnd}")
+        trainer = trainer_factory(new_n_dev)
+        if hasattr(trainer, "resized"):
+            # rebind the factory: the old one is a bound method of the
+            # PREVIOUS trainer and would pin it (and its compiled round
+            # executable) alive for the rest of the run
+            trainer_factory = trainer.resized
+        found = ckpt.restore_newest_verified(cfg.checkpoint_dir)
+        if found is None:
+            raise TrainingHealthError(
+                f"elastic: membership changed but no verified checkpoint "
+                f"exists under {cfg.checkpoint_dir!r} to resize from.")
+        flat, ck_round, extra = found
+        state = trainer.adapt_state(
+            flat, old_tp=int(extra.get("tp", 1)),
+            momentum_policy=elastic_cfg.momentum_policy)
+        source = source.reshard(trainer.n_local_devices)
+        n_dev = trainer.n_devices
+        n_local = trainer.n_local_devices
+        meter.n_chips = n_dev
+        if cfg.telemetry and hasattr(trainer, "phase_timers"):
+            trainer.phase_timers = timers
+        if g_variants is not None and hasattr(trainer, "compiled_variants"):
+            g_variants.set_fn(trainer.compiled_variants)
+        log.log(f"elastic resize: epoch {ev.epoch} -> {ev.n_workers} "
+                f"worker(s) on {n_dev} device(s); restored verified "
+                f"round {ck_round}"
+                + (f"; evicted {list(ev.dead)}" if ev.dead else "")
+                + (f"; joined {list(ev.joined)}" if ev.joined else ""))
+        return state, ck_round
+
+    def expand_tau(by_worker: Optional[Dict[str, int]]):
+        """Per-worker τ budgets -> the per-DATA-GROUP vector the trainer
+        takes (a worker may own several device groups). Multi-host: a
+        group's owner is the process owning its devices (mesh order,
+        model-minor under TP). Single process — the virtual-pod
+        simulation, where every device belongs to process 0 — members
+        own contiguous blocks of groups in sorted-id order, matching the
+        devices-per-worker resize math. Unknown owners run full τ."""
+        if not by_worker:
+            return None
+        from ..parallel.elastic import worker_sort_key
+        n_data = getattr(trainer, "n_data", n_dev)
+        tp = getattr(trainer, "tp", 1)
+        if jax.process_count() > 1:
+            flat = list(trainer.mesh.devices.flat)
+            return [by_worker.get(str(flat[g * tp].process_index), cfg.tau)
+                    for g in range(n_data)]
+        order = sorted(membership.members, key=worker_sort_key)
+        m = max(1, len(order))
+        # balanced contiguous blocks (sizes differ by <= 1): identical to
+        # the devices-per-worker split when n_data % m == 0, and never
+        # lumps every remainder group onto the LAST worker's budget when
+        # the mesh is indivisible
+        return [by_worker.get(order[min(g * m // n_data, m - 1)], cfg.tau)
+                for g in range(n_data)]
+
     # per-round phase deltas for the step-time breakdown rows: the phase
     # timers accumulate forever; this tracks the last-seen totals so each
     # round's record carries only its own share
@@ -643,6 +880,13 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
             if monitor is not None and monitor.rollback_needed:
                 state, rnd = recover(state)
                 continue
+            if membership is not None:
+                # the τ boundary: between rounds every worker's params
+                # are synchronized, so this is the one safe resize point
+                ev = membership.poll(rnd)
+                if ev is not None:
+                    state, rnd = apply_resize(state, ev, rnd)
+                    continue
             if test_ds is not None and cfg.eval_every and \
                     rnd % cfg.eval_every == 0:
                 # keep log/JSONL round-ordered: earlier loss rows must
@@ -675,11 +919,19 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
             with profiling.maybe_trace(cfg.profile_dir if profile_this
                                        else None):
                 with timers.phase("train_round"):
+                    tr_kw: Dict[str, Any] = {}
                     if supports_lr and lr_scale != 1.0:
-                        state, loss = trainer.train_round(
-                            state, batches, sub, lr_scale=lr_scale)
-                    else:
-                        state, loss = trainer.train_round(state, batches, sub)
+                        tr_kw["lr_scale"] = lr_scale
+                    if getattr(trainer, "elastic_tau", False) and \
+                            membership is not None:
+                        # heterogeneous pods: per-worker local-step
+                        # budgets from the heartbeat round times (a
+                        # traced input — adapting never recompiles),
+                        # expanded to one entry per data group
+                        tr_kw["tau_by_worker"] = expand_tau(
+                            membership.tau_by_worker(cfg.tau))
+                    state, loss = trainer.train_round(state, batches, sub,
+                                                      **tr_kw)
                     # async probe slice MUST precede the next dispatch
                     # (donation invalidates the old state buffers)
                     probe_val = probe(state) if probe else None

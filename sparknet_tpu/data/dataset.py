@@ -73,6 +73,15 @@ class RoundSampler:
         self.seed = seed
         self._rng = np.random.default_rng(seed)
 
+    def reshard(self, n_workers: int) -> "RoundSampler":
+        """A NEW sampler over the same dataset/seed with the partitions
+        re-cut for `n_workers` — the elastic-resize data path: survivors
+        (and joiners) re-partition the corpus instead of training on the
+        dead worker's orphaned shard forever. Round-keyed draws stay
+        deterministic in (seed, round_index) for the new layout."""
+        return RoundSampler(self.ds, n_workers, self.local_batch, self.tau,
+                            seed=self.seed)
+
     def next_round(self, round_index: Optional[int] = None
                    ) -> Dict[str, np.ndarray]:
         """[tau, n_workers*local_b, ...] arrays, batch axis blocked by worker.

@@ -54,11 +54,14 @@ def retry_delay(attempt: int, err: Optional[BaseException] = None) -> float:
     deterministic `BACKOFF_S * 2^attempt` synchronized every reader into a
     thundering herd: after a shared 429 all `ingest_sources` readers (and
     all hosts of a pod) slept the exact same time and re-arrived together,
-    earning the next 429. A 429's `Retry-After` header (seconds form), when
-    present, is honored as a FLOOR under the jittered delay — the server
-    knows when capacity returns; arriving earlier just burns an attempt."""
+    earning the next 429. A 429/503's `Retry-After` header (seconds form),
+    when present, is honored as a FLOOR under the jittered delay — the
+    server knows when capacity returns; arriving earlier just burns an
+    attempt. 503 matters for S3: AWS throttles with `503 SlowDown` (not
+    429) and often names its price in Retry-After — a preempted worker
+    rejoining a pod through a hot bucket prefix is exactly this path."""
     delay = random.uniform(0.0, BACKOFF_S * (2 ** attempt))
-    if isinstance(err, urllib.error.HTTPError) and err.code == 429:
+    if isinstance(err, urllib.error.HTTPError) and err.code in (429, 503):
         ra = (err.headers.get("Retry-After")
               if err.headers is not None else None)
         try:
@@ -86,17 +89,27 @@ def is_gs_path(path: str) -> bool:
 
 def http_get_with_retry(url: str, headers: Optional[dict] = None,
                         timeout: float = 60.0, method: str = "GET",
-                        data: Optional[bytes] = None):
+                        data: Optional[bytes] = None,
+                        headers_fn=None):
     """HTTP request with retry on 429/5xx and connection errors; returns
     the open response (caller reads/closes). 4xx other than 429 propagates
     immediately — retrying a 403/404 only hides it. Shared by the GCS and
     S3 clients (auth differs per caller; the transport does not). Bodies
     (`data`) are bytes held in memory, so retrying a PUT/POST re-sends the
-    identical payload."""
+    identical payload.
+
+    `headers_fn` (mutually additive with `headers`) is called PER ATTEMPT
+    to (re)build the request headers: SigV4 signatures embed `x-amz-date`,
+    and a retry that slept out a long Retry-After floor must present a
+    FRESH signature, not replay a stale one into AWS's 15-minute clock-
+    skew window (the S3 client signs per attempt through this hook)."""
     last: Optional[BaseException] = None
     for attempt in range(RETRIES):
-        req = urllib.request.Request(url, headers=headers or {},
-                                     data=data, method=method)
+        h = dict(headers or {})
+        if headers_fn is not None:
+            h.update(headers_fn())
+        req = urllib.request.Request(url, headers=h, data=data,
+                                     method=method)
         try:
             return urllib.request.urlopen(req, timeout=timeout)
         except urllib.error.HTTPError as e:

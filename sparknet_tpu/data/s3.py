@@ -139,13 +139,20 @@ class S3Client:
         base, host, path = self._url_parts(bucket, key)
         payload = (hashlib.sha256(data).hexdigest() if data is not None
                    else _EMPTY_SHA256)
-        headers = self._sign(method, host, path, query, headers or {},
-                             payload_hash=payload)
         url = base + urllib.parse.quote(path, safe="/-_.~")
         if query:
             url += "?" + query
-        return _gcs.http_get_with_retry(url, headers, self.timeout,
-                                        method=method, data=data)
+        # sign PER ATTEMPT (headers_fn): every multipart part PUT,
+        # CompleteMultipartUpload POST, and ranged-GET reconnect shares
+        # the transport's full-jitter backoff (Retry-After honored on
+        # 429 and S3's `503 SlowDown`), and each retry carries a fresh
+        # x-amz-date — a retry that slept out a long Retry-After floor
+        # must not replay a signature into the SigV4 clock-skew window
+        return _gcs.http_get_with_retry(
+            url, None, self.timeout, method=method, data=data,
+            headers_fn=lambda: self._sign(method, host, path, query,
+                                          dict(headers or {}),
+                                          payload_hash=payload))
 
     # -- API -----------------------------------------------------------------
 

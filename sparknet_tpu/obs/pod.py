@@ -54,8 +54,8 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
-from ..utils.health import _median, mad_classify
-from ..utils.heartbeat import read_heartbeat, staleness_s
+from ..utils.health import _median, liveness_classify, mad_classify
+from ..utils.heartbeat import read_heartbeat, staleness_s, worker_sort_key
 from .http import StatusServer
 from .registry import MetricsRegistry, _escape_label, _fmt
 
@@ -298,6 +298,9 @@ class WorkerView:
     staleness_s: Optional[float] = None
     rollbacks: int = 0
     straggler: bool = False
+    #: elastic membership epoch the worker's loop last reported (None
+    #: when the run is not elastic / pre-elastic heartbeat schema)
+    membership_epoch: Optional[int] = None
     #: parsed /metrics families (http mode only; file mode has heartbeats)
     metrics: Optional[Dict[str, Family]] = field(default=None, repr=False)
 
@@ -306,6 +309,8 @@ class WorkerView:
             "worker", "alive", "role", "round", "status", "loss",
             "round_s", "data_wait_s", "staleness_s", "rollbacks",
             "straggler")}
+        if self.membership_epoch is not None:
+            d["membership_epoch"] = self.membership_epoch
         if self.error:
             d["error"] = self.error
         return d
@@ -407,6 +412,13 @@ class PodAggregator:
             labels=("worker",))
         self._lock = threading.Lock()
         self._cached: Tuple[float, List[WorkerView]] = (0.0, [])
+        #: every file-mode worker EVER discovered on the prefix: a worker
+        #: whose heartbeat object vanishes between scrapes (deleted by an
+        #: operator, lost with its VM's disk) must be surfaced as
+        #: worker_up=0 / candidate-dead, not silently dropped from the
+        #: pod view and the straggler population (mid-run membership
+        #: change would otherwise be invisible exactly when it matters)
+        self._known_files: Dict[str, str] = {}
         self._last_flag_round: Dict[str, Any] = {}
         self._straggler_log: deque = deque(maxlen=256)
         self.server: Optional[StatusServer] = None
@@ -460,7 +472,6 @@ class PodAggregator:
         if hb is None:
             v.error = "heartbeat unreadable"
             return v
-        v.alive = True
         v.staleness_s = staleness_s(hb)
         v.role = hb.get("role", "train")
         v.round = hb.get("step")
@@ -469,9 +480,24 @@ class PodAggregator:
         v.round_s = hb.get("round_s")
         v.data_wait_s = hb.get("data_wait_s")
         v.rollbacks = int(hb.get("rollbacks") or 0)
-        if v.staleness_s is not None and v.staleness_s > self.stale_after_s:
-            v.alive = False
-            v.error = f"stale ({v.staleness_s:.0f}s since last beat)"
+        if hb.get("membership_epoch") is not None:
+            v.membership_epoch = int(hb["membership_epoch"])
+        # dead-vs-slow through the SHARED rule (utils.health.
+        # liveness_classify — the one the elastic controller evicts on):
+        # slow is a straggler verdict, never a liveness one
+        verdict = liveness_classify(hb, self.stale_after_s)
+        if verdict == "done":
+            # a graceful exit stays visible while its beat is fresh, then
+            # ages out like any other silence; a done record WITHOUT a
+            # timestamp can never age out, so it must not count as alive
+            v.alive = (v.staleness_s is not None
+                       and v.staleness_s <= self.stale_after_s)
+        else:
+            v.alive = verdict in ("ok", "sick")
+        if not v.alive:
+            v.error = (f"stale ({v.staleness_s:.0f}s since last beat)"
+                       if v.staleness_s is not None
+                       else "heartbeat carries no timestamp")
         return v
 
     def collect(self, force: bool = False) -> List[WorkerView]:
@@ -488,6 +514,11 @@ class PodAggregator:
             by_id: Dict[str, WorkerView] = {}
             file_targets = (discover_worker_heartbeats(self.pod_dir)
                             if self.pod_dir else {})
+            # sticky membership: a previously-seen worker whose file is
+            # gone still gets probed (the read fails -> candidate-dead
+            # view) instead of vanishing from the population
+            self._known_files.update(file_targets)
+            file_targets = dict(self._known_files)
             n_jobs = len(self.workers) + len(file_targets)
             if n_jobs:
                 with ThreadPoolExecutor(min(16, n_jobs)) as ex:
@@ -499,7 +530,7 @@ class PodAggregator:
                     for w, f in file_futs.items():
                         if w not in by_id or not by_id[w].alive:
                             by_id[w] = f.result()
-            views = [by_id[w] for w in sorted(by_id, key=_worker_sort_key)]
+            views = [by_id[w] for w in sorted(by_id, key=worker_sort_key)]
             self._attribute(views)
             self._cached = (time.monotonic(), views)
             self._c_collects.inc()
@@ -552,6 +583,8 @@ class PodAggregator:
         """The /pod/status JSON: per-worker vitals + the attribution."""
         views = self.collect()
         rounds = [v.round for v in views if v.round is not None]
+        epochs = [v.membership_epoch for v in views
+                  if v.membership_epoch is not None]
         return {
             "role": "pod",
             "ts": round(time.time(), 3),
@@ -560,6 +593,11 @@ class PodAggregator:
             "max_round": max(rounds) if rounds else None,
             "min_round": min(rounds) if rounds else None,
             "round_skew_s": self._g_skew.value(),
+            # elastic runs: the newest membership epoch any worker
+            # reported, plus the workers currently read as down — the
+            # controller's eviction candidates, named before they're gone
+            "membership_epoch": max(epochs) if epochs else None,
+            "candidate_dead": [v.worker for v in views if not v.alive],
             "stragglers": [v.worker for v in views if v.straggler],
             "straggler_rounds": {
                 v.worker: c for v in views
@@ -598,10 +636,6 @@ class PodAggregator:
         if self.server is not None:
             self.server.stop()
             self.server = None
-
-
-def _worker_sort_key(w: str):
-    return (0, int(w)) if w.isdigit() else (1, w)
 
 
 # ---------------------------------------------------------------------------

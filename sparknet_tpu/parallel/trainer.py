@@ -43,7 +43,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..model.layers import tp_shards_layer
 from ..model.net import CompiledNet, PyTree
 from ..solver import SgdSolver, SolverConfig, SolverState
-from .mesh import (DATA_AXIS, MODEL_AXIS, local_device_rows,
+from .mesh import (DATA_AXIS, MODEL_AXIS, local_device_rows, make_mesh,
                    place_global_state, put_device_axis, scan_unroll,
                    shard_map)
 
@@ -79,10 +79,13 @@ class ParallelTrainer:
     def __init__(self, net: CompiledNet, solver_cfg: SolverConfig, mesh: Mesh,
                  tau: int = 10, mode: str = "local_sgd",
                  loss_blob: str = "loss", acc_blob: Optional[str] = None,
-                 compute_health: bool = True):
+                 compute_health: bool = True, elastic_tau: bool = False):
         assert mode in ("local_sgd", "sync_sgd")
         if mode == "sync_sgd":
             assert tau == 1, "sync_sgd averages every step; tau must be 1"
+        if elastic_tau and mode != "local_sgd":
+            raise ValueError("elastic_tau (per-worker local steps) only "
+                             "makes sense in local_sgd mode")
         if solver_cfg.iter_size != 1:
             raise ValueError(
                 "iter_size > 1 is a single-net accumulation feature "
@@ -128,9 +131,23 @@ class ParallelTrainer:
         health_specs = ({"grad_norm": P(), "nonfinite": P(),
                          "nonfinite_by_worker": P()}
                         if self.compute_health else {})
+        # elastic_tau compiles the round with ONE extra traced input: a
+        # replicated [n_data] int32 vector of per-worker local-step
+        # budgets (heterogeneous pods — the elastic layer shortens a
+        # chronically slow worker's τ instead of stalling the barrier).
+        # Steps at index >= tau_i are masked no-ops for that worker, so
+        # changing the vector NEVER recompiles; a full-τ vector computes
+        # the legacy round (the selects pick the updated operand — any
+        # residual difference is XLA fusion reassociation at the last
+        # ulp, pinned by tests/test_elastic.py). Trainers built without
+        # the flag compile the byte-identical legacy round.
+        self.elastic_tau = bool(elastic_tau)
+        self._tau_vec_dev: Optional[Tuple[Tuple[int, ...], jax.Array]] = None
+        extra_specs = (P(),) if self.elastic_tau else ()
         self._round = jax.jit(
             shard_map(self._round_impl, mesh=mesh,
-                      in_specs=(state_specs, batch_spec, P(DATA_AXIS), P()),
+                      in_specs=(state_specs, batch_spec, P(DATA_AXIS), P())
+                      + extra_specs,
                       out_specs=(state_specs, P(), health_specs)),
             donate_argnums=(0,))
         #: device scalars from the LAST train_round (fetch with float()):
@@ -362,12 +379,17 @@ class ParallelTrainer:
 
     # -- one training round (runs INSIDE shard_map; axis = DATA_AXIS) --------
 
-    def _round_impl(self, state: TrainState, batches, rng, lr_scale):
+    def _round_impl(self, state: TrainState, batches, rng, lr_scale,
+                    tau_vec=None):
         # shapes here are per-device: params [1, ...]; batches [tau, local_b, ...]
         params = jax.tree.map(lambda x: x[0], state.params)
         momentum = jax.tree.map(lambda x: x[0], state.momentum)
         it = state.it[0]
         rng = rng[0]
+        # heterogeneous τ: THIS worker's local-step budget out of the
+        # replicated per-worker vector (elastic_tau trainers only)
+        my_tau = (tau_vec[lax.axis_index(DATA_AXIS)]
+                  if tau_vec is not None else None)
 
         loss_fn = self.net.loss_fn(self.loss_blob, tp_axis=self._tp_axis,
                                    tp_size=self.tp)
@@ -389,7 +411,10 @@ class ParallelTrainer:
 
         def local_step(carry, inputs):
             params, sstate = carry
-            batch, step_rng = inputs
+            if my_tau is None:
+                batch, step_rng = inputs
+            else:
+                batch, step_rng, step_idx = inputs
             (loss, _), grads = jax.value_and_grad(
                 lambda p: loss_fn(p, batch, step_rng),
                 has_aux=True)(params)
@@ -405,14 +430,37 @@ class ParallelTrainer:
             if self.mode == "sync_sgd":
                 grads = lax.pmean(grads, DATA_AXIS)
                 loss = lax.pmean(loss, DATA_AXIS)
-            params, sstate = self.solver.update(params, sstate, grads,
-                                                lr_scale=lr_scale)
-            return (params, sstate), (loss, grad_sq)
+            new_params, new_sstate = self.solver.update(
+                params, sstate, grads, lr_scale=lr_scale)
+            if my_tau is not None:
+                # heterogeneous τ: steps past THIS worker's budget are
+                # no-ops — params/momentum carry through unchanged and
+                # the step's loss/grad_sq leave the health statistics
+                # (a full-τ vector selects the updated operand on every
+                # step, reproducing the unmasked round to the last ulp
+                # of XLA's fusion choices). The `it` schedule clock
+                # still advances by the nominal τ on every worker: the
+                # LR policy must not diverge across the pod.
+                active = step_idx < my_tau
+
+                def keep(n, o):
+                    return jnp.where(active, n, o)
+
+                new_params = jax.tree.map(keep, new_params, params)
+                new_sstate = SolverState(
+                    momentum=jax.tree.map(keep, new_sstate.momentum,
+                                          sstate.momentum),
+                    it=new_sstate.it)
+                loss = jnp.where(active, loss, 0.0)
+                grad_sq = jnp.where(active, grad_sq, 0.0)
+            return (new_params, new_sstate), (loss, grad_sq)
 
         step_rngs = jax.random.split(rng, self.tau)
+        xs = ((batches, step_rngs) if my_tau is None
+              else (batches, step_rngs, jnp.arange(self.tau)))
         (params, sstate), (losses, grad_sqs) = lax.scan(
             local_step, (params, SolverState(momentum=momentum, it=it)),
-            (batches, step_rngs), unroll=scan_unroll(self.tau))
+            xs, unroll=scan_unroll(self.tau))
 
         # pre-average view: after the pmean one poisoned worker's NaN is
         # every worker's NaN, so ATTRIBUTION must read the worker-local
@@ -424,7 +472,16 @@ class ParallelTrainer:
             # column shard with its peers. Momentum is deliberately NOT
             # averaged (reference parity, SURVEY §7).
             params = lax.pmean(params, DATA_AXIS)
-        mean_loss = lax.pmean(jnp.mean(losses), DATA_AXIS)
+        if my_tau is None:
+            mean_loss = lax.pmean(jnp.mean(losses), DATA_AXIS)
+        else:
+            # masked steps contributed zero loss: average over the steps
+            # THIS worker actually ran, then equal-weight across workers
+            # (each worker's own-trajectory mean, the τ-averaging view)
+            mean_loss = lax.pmean(
+                jnp.sum(losses)
+                / jnp.maximum(my_tau.astype(jnp.float32), 1.0),
+                DATA_AXIS)
 
         # -- on-device health scalars (utils/health.py is the host half) --
         # global gradient norm: each worker's WORST-step squared norm,
@@ -515,8 +572,8 @@ class ParallelTrainer:
     supports_lr_scale = True
 
     def train_round(self, state: TrainState, batches: Dict[str, np.ndarray],
-                    rng: jax.Array, lr_scale: float = 1.0
-                    ) -> Tuple[TrainState, float]:
+                    rng: jax.Array, lr_scale: float = 1.0,
+                    tau_by_worker=None) -> Tuple[TrainState, float]:
         """One outer round: τ local steps per device + averaging.
 
         `batches[input]` has shape [tau, host_batch, ...] with host_batch =
@@ -529,6 +586,13 @@ class ParallelTrainer:
         supervisor backoff; a traced input, so changing it does not
         recompile). Health scalars from the round land in `last_health`
         as device scalars — see its comment.
+
+        `tau_by_worker` (elastic_tau trainers only): per-data-group
+        local-step budgets, clipped to [1, tau] — worker i executes its
+        first tau_i scan steps and carries its state unchanged through
+        the rest (heterogeneous pods; a traced input like lr_scale, so
+        adapting never recompiles). None = full τ everywhere, which is
+        numerically identical to a non-elastic trainer's round.
         """
         # one rng row per DATA group, same on every host; TP replicas in a
         # model group share the row (dropout masks must agree on the
@@ -539,19 +603,54 @@ class ParallelTrainer:
                 self._lr_scale_dev[0] != float(lr_scale):
             self._lr_scale_dev = (float(lr_scale),
                                   jnp.asarray(lr_scale, jnp.float32))
+        if self.elastic_tau:
+            vec = (tuple(int(min(self.tau, max(1, t)))
+                         for t in tau_by_worker)
+                   if tau_by_worker is not None
+                   else (self.tau,) * self.n_data)
+            assert len(vec) == self.n_data, (
+                f"tau_by_worker has {len(vec)} entries for "
+                f"{self.n_data} data groups")
+            if self._tau_vec_dev is None or self._tau_vec_dev[0] != vec:
+                self._tau_vec_dev = (vec, jnp.asarray(vec, jnp.int32))
+            extra = (self._tau_vec_dev[1],)
+        else:
+            if tau_by_worker is not None:
+                raise ValueError("tau_by_worker requires a trainer built "
+                                 "with elastic_tau=True")
+            extra = ()
         timers = self.phase_timers
         if timers is not None:
             with timers.phase("h2d"):
                 sharded = self._shard_batches(batches)
             with timers.phase("dispatch"):
                 new_state, loss, health = self._round(
-                    state, sharded, rngs, self._lr_scale_dev[1])
+                    state, sharded, rngs, self._lr_scale_dev[1], *extra)
         else:
             new_state, loss, health = self._round(
                 state, self._shard_batches(batches), rngs,
-                self._lr_scale_dev[1])
+                self._lr_scale_dev[1], *extra)
         self.last_health = health or None  # {} when compute_health=False
         return new_state, loss
+
+    def resized(self, n_devices: int) -> "ParallelTrainer":
+        """A NEW trainer over the first `n_devices` visible devices — the
+        elastic resize: same net, solver, τ, mode, and health layout,
+        fresh mesh and compiled round. The health psum's
+        `[n_data+1]`-vector layout follows the new worker count because
+        the round is rebuilt, so attribution indexes always match the
+        live membership. The old trainer's executables are dropped with
+        the old object. TP pods cannot resize live (the column-shard
+        assignment itself would change — relaunch instead)."""
+        if self.tp != 1:
+            raise NotImplementedError(
+                "elastic resize with tensor parallelism: the shard "
+                "assignment changes with the mesh — checkpoint and "
+                "relaunch at the new size instead")
+        return ParallelTrainer(
+            self.net, self.solver.cfg, make_mesh(n_devices), tau=self.tau,
+            mode=self.mode, loss_blob=self.loss_blob, acc_blob=self.acc_blob,
+            compute_health=self.compute_health, elastic_tau=self.elastic_tau)
 
     def evaluate(self, state: TrainState, batch: Dict[str, np.ndarray]) -> float:
         """Distributed accuracy over one global batch (psum of correct/count —

@@ -18,6 +18,85 @@ from .health import HealthConfig
 
 
 @dataclass
+class ElasticConfig:
+    """Knobs for elastic, preemption-tolerant pod membership
+    (RunConfig.elastic; driven by `parallel.elastic.MembershipController`).
+
+    Liveness is read from the per-worker heartbeats under
+    `RunConfig.pod_dir` (the pod observability surface — no new channel).
+    A worker whose beat ages past `stale_after_s` becomes SUSPECT, is
+    re-probed with full-jitter backoff, and is declared dead only after
+    `dead_probes` consecutive stale probes — never on a single missed
+    beat. The same `stale_after_s` threshold feeds the pod aggregator and
+    the launcher watch so "stale" means one thing everywhere.
+
+    On a membership change the train loop resizes at the τ boundary:
+    checkpoint, rebuild the compiled round over the survivors, restore
+    through the newest VERIFIED snapshot (params exact; momentum per
+    `momentum_policy` — norm_rescale won the r5 A/B,
+    scripts/elastic_momentum_ab.py / ELASTIC_AB_r05.json), reshard the
+    data partitions, and continue. Dropping below `min_workers`
+    checkpoints and raises TrainingHealthError — loud, never a hang.
+    """
+
+    enabled: bool = False
+    # how many workers the pod was LAUNCHED with (worker ids 0..N-1, the
+    # worker-heartbeat naming convention). None = jax.process_count().
+    # A launched-but-never-beating worker is a candidate-dead from the
+    # start — it goes through the normal suspect -> re-probe -> evict
+    # path instead of silently shrinking the pod's definition.
+    expected_workers: Optional[int] = None
+    # dead-vs-slow: heartbeat age that makes a worker suspect (shared
+    # with PodAggregator staleness and the launcher watch probe)
+    stale_after_s: float = 60.0
+    # full-jitter re-probe: suspect worker k is re-checked after
+    # uniform(0, reprobe_backoff_s * 2^k); declared dead after
+    # `dead_probes` consecutive stale probes (>= 1; the first stale
+    # sighting is never enough on its own)
+    reprobe_backoff_s: float = 2.0
+    dead_probes: int = 2
+    # membership checks are rate-limited to this interval (0 = every
+    # round; the check is a heartbeat-prefix listing, cheap but not free)
+    poll_interval_s: float = 5.0
+    # below this many live workers: verified checkpoint + loud
+    # TrainingHealthError (a 1-worker "pod" still trains by default)
+    min_workers: int = 1
+    # "adopt": a fresh heartbeat from an unknown/evicted worker id joins
+    # the pod at the next τ boundary (restored from the newest verified
+    # checkpoint); "deny": log-and-ignore (fixed membership after evict)
+    rejoin: str = "adopt"
+    # momentum reconstruction across a topology change
+    # (ParallelTrainer.adapt_state policy; A/B winner norm_rescale)
+    momentum_policy: str = "norm_rescale"
+    # heterogeneous pods: scale each worker's local steps by the pod's
+    # round-time skew — worker i runs tau_i = clip(round(tau * median_
+    # round_s / round_s_i), tau_min, tau) steps of the τ-scan (the rest
+    # are masked no-ops; a traced input, so adapting never recompiles)
+    tau_adapt: bool = False
+    tau_min: int = 1
+
+    def __post_init__(self) -> None:
+        # validated at CONSTRUCTION, not just from_dict: in-tree callers
+        # build ElasticConfig directly, and a typo'd rejoin policy must
+        # not silently behave as "adopt"
+        if self.rejoin not in ("adopt", "deny"):
+            raise ValueError(f"elastic.rejoin must be 'adopt' or 'deny', "
+                             f"got {self.rejoin!r}")
+        if self.dead_probes < 1:
+            raise ValueError("elastic.dead_probes must be >= 1 (a single "
+                             "missed beat must never evict)")
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ElasticConfig":
+        known = {f.name for f in dataclasses.fields(ElasticConfig)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown elastic config keys: {sorted(unknown)}")
+        return ElasticConfig(**d)
+
+
+@dataclass
 class RunConfig:
     # model
     model: str = "cifar10_quick"        # zoo name, or path to a .prototxt
@@ -105,6 +184,12 @@ class RunConfig:
     pod_dir: Optional[str] = None
     pod_port: Optional[int] = None
     pod_address: Optional[Tuple[str, int]] = None
+    # elastic pod membership (parallel/elastic.py): when enabled AND
+    # pod_dir is set, the loop watches the per-worker heartbeats, evicts
+    # dead workers (stale-then-reprobed, full jitter), adopts joiners,
+    # and resizes the compiled round at the τ boundary through the
+    # checkpoint store. None/disabled = the pre-elastic loop exactly.
+    elastic: Optional[ElasticConfig] = None
     # logging. None -> $SPARKNET_TPU_HOME, else "." (the reference logged
     # to $SPARKNET_HOME/training_log_<ms>.txt); tests set the env var to a
     # tmp dir so stray default-config runs never litter the repo root
@@ -134,6 +219,8 @@ class RunConfig:
             d["solver"] = SolverConfig.from_dict(d["solver"])
         if "health" in d and isinstance(d["health"], dict):
             d["health"] = HealthConfig.from_dict(d["health"])
+        if "elastic" in d and isinstance(d["elastic"], dict):
+            d["elastic"] = ElasticConfig.from_dict(d["elastic"])
         known = {f.name for f in dataclasses.fields(RunConfig)}
         unknown = set(d) - known
         if unknown:

@@ -27,6 +27,7 @@ flush fetches them alongside the deferred losses.
 from __future__ import annotations
 
 import math
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
@@ -259,6 +260,47 @@ def mad_classify(values, thresh_sigma: float = 5.0,
     mad = _median(sorted(abs(x - med) for x in s))
     sigma = max(1.4826 * mad, rel_floor * max(abs(med), 1e-12))
     return med, sigma, [x > med + thresh_sigma * sigma for x in xs]
+
+
+def liveness_classify(hb: Optional[Dict[str, Any]],
+                      stale_after_s: float) -> str:
+    """THE dead-vs-slow rule, shared by straggler naming (obs/pod.py), the
+    elastic MembershipController, and anything probing a heartbeat dict
+    (utils/heartbeat.read_heartbeat output — `age_s` is stamped at read
+    time). One threshold, one vocabulary:
+
+      "missing"  no readable heartbeat at all (file/object gone, torn,
+                 or carrying no timestamp) — a candidate-dead worker
+      "done"     the worker said goodbye (status "done"): a graceful
+                 leave, not a failure
+      "stale"    a beat exists but is older than `stale_after_s` — the
+                 writer stopped writing: candidate-dead, subject to the
+                 controller's re-probe policy (never evict on one look)
+      "sick"     fresh beat, anomalous status (spike/nonfinite/rollback/
+                 degraded): alive but unhealthy — a health-supervisor
+                 problem, NOT a membership problem
+      "ok"       fresh beat, healthy status — mere slowness shows up in
+                 round_s/straggler attribution, never here
+
+    A slow worker is "ok" here by construction: slowness is the straggler
+    attributor's verdict (median+MAD over round_s), deadness is this
+    one's, and conflating them is how pods evict their stragglers."""
+    if hb is None:
+        return "missing"
+    status = str(hb.get("status", "ok"))
+    if status == "done":
+        return "done"
+    age = hb.get("age_s")
+    if age is None:
+        try:
+            age = max(0.0, time.time() - float(hb["t"]))
+        except (KeyError, TypeError, ValueError):
+            return "missing"
+    if float(age) > float(stale_after_s):
+        return "stale"
+    if status in (SPIKE, NONFINITE, "rollback", "degraded"):
+        return "sick"
+    return "ok"
 
 
 def poison_batch(batches: Dict[str, Any], mode: str,

@@ -173,21 +173,53 @@ def read_heartbeat(path: str) -> Optional[Dict[str, Any]]:
     """The current heartbeat dict, or None when the file is missing or
     torn (a torn read is impossible from HeartbeatWriter's atomic replace,
     but a foreign/partial file must not crash the prober). Accepts
-    `gs://`/`s3://` URLs like the writer."""
+    `gs://`/`s3://` URLs like the writer.
+
+    The returned dict additionally carries `age_s` — seconds since the
+    beat was written, computed at READ time — so every consumer (the
+    launcher watch, the pod aggregator/podview, the elastic
+    MembershipController) applies one staleness rule to one number
+    instead of re-deriving it from `t` with its own clock arithmetic.
+    `age_s` is None when the record has no `t` (foreign file)."""
     try:
         if _is_bucket(path):
             from .checkpoint import _bucket_ops
-            return json.loads(_bucket_ops(path).read(path))
-        with open(path) as f:
-            return json.load(f)
+            hb = json.loads(_bucket_ops(path).read(path))
+        else:
+            with open(path) as f:
+                hb = json.load(f)
     except (OSError, ValueError):
         return None
     except Exception:
         return None  # bucket client errors degrade like a missing file
+    if not isinstance(hb, dict):
+        return None
+    try:
+        hb["age_s"] = round(max(0.0, time.time() - float(hb["t"])), 3)
+    except (KeyError, TypeError, ValueError):
+        hb["age_s"] = None
+    return hb
 
 
 def staleness_s(hb: Optional[Dict[str, Any]]) -> Optional[float]:
-    """Seconds since the beat was written, or None without a valid beat."""
-    if not hb or "t" not in hb:
+    """Seconds since the beat was written, or None without a valid beat.
+    Prefers the `age_s` read_heartbeat stamped (one clock read per probe);
+    falls back to `t` for records obtained some other way."""
+    if not hb:
         return None
-    return max(0.0, time.time() - float(hb["t"]))
+    if hb.get("age_s") is not None:
+        return float(hb["age_s"])
+    if "t" not in hb:
+        return None
+    try:
+        return max(0.0, time.time() - float(hb["t"]))
+    except (TypeError, ValueError):
+        return None
+
+
+def worker_sort_key(w: str):
+    """Numeric-first worker-id ordering ('2' < '10'; names after digits)
+    — THE ordering elastic membership, τ expansion, and the pod view all
+    share (one definition; divergence would silently misalign the
+    membership order against the pod table)."""
+    return (0, int(w)) if str(w).isdigit() else (1, str(w))

@@ -261,6 +261,8 @@ class FakeS3Handler(http.server.BaseHTTPRequestHandler):
     objects = {}       # "bucket/key" -> bytes
     uploads = {}       # uploadId -> {"key": "bucket/key", "parts": {n: b}}
     fail_once = set()
+    slowdown_once = set()  # keys whose next GET/PUT answers 503 SlowDown
+    slowdown_log = []      # x-amz-date header of each throttled request
     region = "us-east-1"
     secret = "testsecret"
     verify_auth = True
@@ -309,6 +311,20 @@ class FakeS3Handler(http.server.BaseHTTPRequestHandler):
         bucket, key = self._bucket_key(parsed.path)
         if not key:
             self.send_error(400)
+            return
+        if key in self.slowdown_once:
+            # same `503 SlowDown` injection as do_GET: a throttled part
+            # PUT must be retried by the transport with a FRESH
+            # per-attempt SigV4 signature, never fail the whole upload
+            self.slowdown_once.discard(key)
+            self.slowdown_log.append(self.headers.get("x-amz-date"))
+            err = (b'<?xml version="1.0"?><Error><Code>SlowDown</Code>'
+                   b"</Error>")
+            self.send_response(503)
+            self.send_header("Retry-After", "0")
+            self.send_header("Content-Length", str(len(err)))
+            self.end_headers()
+            self.wfile.write(err)
             return
         if "partNumber" in qs and "uploadId" in qs:  # UploadPart
             up = self.uploads.get(qs["uploadId"][0])
@@ -414,6 +430,20 @@ class FakeS3Handler(http.server.BaseHTTPRequestHandler):
                        f"</IsTruncated>{items}{nxt}</ListBucketResult>"
                        ).encode())
             return
+        if key in self.slowdown_once:
+            # AWS throttles with `503 SlowDown` (not 429), usually naming
+            # its price in Retry-After — the client must back off and
+            # retry with a FRESH SigV4 signature
+            self.slowdown_once.discard(key)
+            self.slowdown_log.append(self.headers.get("x-amz-date"))
+            body = (b'<?xml version="1.0"?><Error><Code>SlowDown</Code>'
+                    b"</Error>")
+            self.send_response(503)
+            self.send_header("Retry-After", "0")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         obj = self.objects.get(f"{bucket}/{key}")
         if obj is None:
             self.send_error(404)
@@ -454,8 +484,9 @@ def make_s3_handler(secret="testsecret", region="us-east-1",
                     verify_auth=True):
     """A fresh FakeS3Handler subclass with its OWN state (one per server)."""
     return type("FakeS3HandlerInstance", (FakeS3Handler,), dict(
-        objects={}, uploads={}, fail_once=set(), secret=secret,
-        region=region, verify_auth=verify_auth))
+        objects={}, uploads={}, fail_once=set(), slowdown_once=set(),
+        slowdown_log=[], secret=secret, region=region,
+        verify_auth=verify_auth))
 
 
 # -- servers ----------------------------------------------------------------

@@ -49,6 +49,7 @@ case "$*" in
       preempt) echo PREEMPTED > "$DIR/state"; exit 255 ;;
       vanish)  rm -f "$DIR/state"; exit 255 ;;
       fail)    exit 7 ;;
+      elastic) exit 75 ;;
       *)       exit 0 ;;
     esac ;;
 esac
@@ -301,3 +302,29 @@ def test_watch_recreate_resets_transient_fail_count(launcher):
                  plan=["fail", "preempt", "fail", "ok"])
     assert r.returncode == 0, r.stderr
     assert launcher.calls().count("tpu-vm create") == 2  # one recreate
+
+
+def test_watch_elastic_exit75_relaunches_without_strike(launcher):
+    """Exit 75 (ElasticRelaunch) is the app's "membership changed,
+    checkpointed, relaunch me" signal: watch re-runs immediately — no
+    strike, no recreate — and repeated 75s never trip the app-error
+    stop (each relaunch is a legitimate joiner rejoining the pod)."""
+    launcher("create", "pod", "z", "v5e-32")
+    r = launcher("watch", "pod", "z", "v5e-32", "python -m app",
+                 plan=["elastic", "elastic", "ok"])
+    assert r.returncode == 0, r.stderr
+    assert r.stderr.count("elastic membership change") == 2
+    assert "command completed" in r.stderr
+    assert "app error" not in r.stderr
+    assert launcher.calls().count("tpu-vm create") == 1  # no recreate
+
+
+def test_watch_elastic_exit75_then_real_failure_still_stops(launcher):
+    """A 75-relaunch resets nothing it shouldn't: two genuine failures
+    after an elastic relaunch still stop with the app-error verdict."""
+    launcher("create", "pod", "z", "v5e-32")
+    r = launcher("watch", "pod", "z", "v5e-32", "python -m app",
+                 plan=["elastic", "fail", "fail"])
+    assert r.returncode == 1
+    assert "elastic membership change" in r.stderr
+    assert "app error" in r.stderr

@@ -471,3 +471,42 @@ def test_podview_file_mode_cli(tmp_path, capsys):
     assert main(["--pod-dir", pod_dir, "--json"]) == 0
     s = json.loads(capsys.readouterr().out)
     assert s["n_workers"] == 3 and s["stragglers"] == ["2"]
+
+
+def test_aggregator_file_mode_vanished_worker_surfaced(tmp_path):
+    """Mid-run membership change: a worker whose heartbeat FILE vanishes
+    between scrapes must surface as worker_up=0 / candidate-dead, not
+    silently drop out of the pod view and the straggler population."""
+    pod_dir = str(tmp_path / "pod")
+    for i in range(3):
+        HeartbeatWriter(worker_heartbeat_path(pod_dir, i)).beat(
+            4, status="ok", round_s=0.1)
+    agg = PodAggregator(pod_dir=pod_dir, min_refresh_s=0.0)
+    assert agg.pod_status()["n_alive"] == 3
+    os.remove(worker_heartbeat_path(pod_dir, 1))  # vanishes, not stale
+    status = agg.pod_status()
+    assert status["n_workers"] == 3  # sticky: still in the population
+    assert status["n_alive"] == 2
+    assert status["candidate_dead"] == ["1"]
+    gone = [w for w in status["workers"] if w["worker"] == "1"][0]
+    assert not gone["alive"] and "unreadable" in gone["error"]
+    assert 'sparknet_pod_worker_up{worker="1"} 0' in agg.render()
+    # the survivors' straggler stats still work over the live population
+    assert status["stragglers"] == []
+
+
+def test_aggregator_surfaces_membership_epoch(tmp_path):
+    """Elastic runs stamp membership_epoch on their beats; /pod/status
+    reports the newest epoch any worker saw (resizes visible on a
+    scrape, no JSONL required)."""
+    pod_dir = str(tmp_path / "pod")
+    HeartbeatWriter(worker_heartbeat_path(pod_dir, 0)).beat(
+        7, status="ok", round_s=0.1, membership_epoch=2, n_members=3)
+    HeartbeatWriter(worker_heartbeat_path(pod_dir, 1)).beat(
+        6, status="ok", round_s=0.1, membership_epoch=1, n_members=4)
+    status = PodAggregator(pod_dir=pod_dir,
+                           min_refresh_s=0.0).pod_status()
+    assert status["membership_epoch"] == 2
+    by_id = {w["worker"]: w for w in status["workers"]}
+    assert by_id["0"]["membership_epoch"] == 2
+    assert by_id["1"]["membership_epoch"] == 1

@@ -185,3 +185,103 @@ def test_s3_second_epoch_carve_bit_identical(s3):
     np.testing.assert_array_equal(e1[0], e2[0])
     np.testing.assert_array_equal(e1[1], e2[1])
     assert s.skipped == 0
+
+
+# -- retry parity with the GCS client (full jitter + Retry-After) -----------
+
+def test_s3_503_slowdown_retried_with_fresh_signature(s3):
+    """AWS throttles with `503 SlowDown` (+ Retry-After), not 429: the
+    signed S3 path must ride the shared full-jitter backoff and present a
+    FRESH SigV4 signature on the retry (the fake server VERIFIES every
+    signature server-side, so a stale or missing re-sign would 403 and
+    403 is not retried)."""
+    from sparknet_tpu.data import s3 as s3_mod
+
+    url = s3_mod.s3_list_shards("s3://bkt/imagenet")[0]
+    _, key = s3_mod.parse_s3_url(url)
+    _FakeS3.slowdown_once.add(key)
+    data = s3_mod.s3_read(url)  # succeeds THROUGH the throttle
+    assert data[:4] and len(data) > 0
+    assert not _FakeS3.slowdown_once  # the 503 was actually served
+    # the throttled attempt was itself signed (x-amz-date present), and
+    # the signature-verified retry delivered the bytes
+    assert _FakeS3.slowdown_log and _FakeS3.slowdown_log[-1]
+
+
+def test_s3_multipart_part_put_retries_through_503(s3, monkeypatch):
+    """Multipart uploads (the checkpoint writer's path — exactly what a
+    preempted worker rejoining through a flaky bucket exercises) retry a
+    throttled part PUT instead of failing the whole upload."""
+    from sparknet_tpu.data import s3 as s3_mod
+
+    calls = {"n": 0}
+    orig = s3_mod._gcs.http_get_with_retry
+
+    def counting(url, headers=None, timeout=60.0, method="GET", data=None,
+                 headers_fn=None):
+        if method == "PUT" and "partNumber=" in url:
+            calls["n"] += 1
+        return orig(url, headers, timeout, method=method, data=data,
+                    headers_fn=headers_fn)
+
+    monkeypatch.setattr(s3_mod._gcs, "http_get_with_retry", counting)
+    monkeypatch.setattr(s3_mod, "S3_UPLOAD_PART", 1 << 10)
+    # one of the part PUTs gets a 503 SlowDown: the retry must happen
+    # INSIDE the transport (calls stay at one per part) and re-sign
+    _FakeS3.slowdown_once.add("imagenet/big.bin")
+    payload = bytes(range(256)) * 16  # 4 KiB -> 4 parts
+    s3_mod.s3_write_large("s3://bkt/imagenet/big.bin", payload,
+                          parallel=2, part_bytes=1 << 10)
+    assert _FakeS3.objects["bkt/imagenet/big.bin"] == payload
+    assert calls["n"] == 4  # the 503 retried inside http_get_with_retry
+    assert not _FakeS3.slowdown_once  # the throttle was actually served
+    # the throttled attempt itself carried a (verified) SigV4 signature
+    assert _FakeS3.slowdown_log and _FakeS3.slowdown_log[-1]
+
+
+def test_retry_delay_honors_retry_after_on_503():
+    """S3's SlowDown is a 503: its Retry-After must floor the jittered
+    delay exactly like a 429's (PR 1 only honored 429)."""
+    import io
+    import urllib.error
+    from email.message import Message
+
+    from sparknet_tpu.data.gcs import retry_delay
+
+    for code in (429, 503):
+        hdrs = Message()
+        hdrs["Retry-After"] = "7"
+        err = urllib.error.HTTPError("http://x", code, "slow", hdrs,
+                                     io.BytesIO(b""))
+        assert retry_delay(0, err) >= 7.0, code
+    # 500 carries no Retry-After contract: delay stays jittered-small
+    hdrs = Message()
+    hdrs["Retry-After"] = "7"
+    err = urllib.error.HTTPError("http://x", 500, "boom", hdrs,
+                                 io.BytesIO(b""))
+    assert retry_delay(0, err) < 7.0
+
+
+def test_http_retry_headers_fn_called_per_attempt(s3):
+    """`headers_fn` is the per-attempt re-sign hook: it must be invoked
+    once per ATTEMPT (fresh x-amz-date per retry), not once per call."""
+    from sparknet_tpu.data import gcs as gcs_mod
+    from sparknet_tpu.data import s3 as s3_mod
+
+    url = s3_mod.s3_list_shards("s3://bkt/imagenet")[0]
+    bucket, key = s3_mod.parse_s3_url(url)
+    _FakeS3.slowdown_once.add(key)
+    client = s3_mod._shared_client()
+    base, host, path = client._url_parts(bucket, key)
+    calls = {"n": 0}
+
+    def signing():
+        calls["n"] += 1
+        return client._sign("GET", host, path, "", {})
+
+    import urllib.parse
+    with gcs_mod.http_get_with_retry(
+            base + urllib.parse.quote(path, safe="/-_.~"), None,
+            headers_fn=signing) as r:
+        r.read()
+    assert calls["n"] == 2  # one throttled attempt + one success
