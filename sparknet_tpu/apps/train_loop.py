@@ -26,12 +26,14 @@ from __future__ import annotations
 import math
 import time
 import warnings
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional
 
 import jax
 import numpy as np
 
+from ..model.layers import OpsImpl
 from ..model.net import CompiledNet
 from ..model.spec import NetSpec
 from ..obs import (MetricsRegistry, StatusServer, register_build_info,
@@ -135,7 +137,11 @@ def train(cfg: RunConfig, spec: NetSpec, train_ds: ArrayDataset,
                    and cfg.elastic.tau_adapt)
     trainer = ParallelTrainer(net, cfg.solver, mesh, tau=cfg.tau,
                               mode=cfg.mode, compute_health=compute_health,
-                              elastic_tau=elastic_tau)
+                              elastic_tau=elastic_tau,
+                              donate_batches=cfg.donate_batches,
+                              ops=OpsImpl(lrn=cfg.lrn_impl,
+                                          pool=cfg.pool_impl,
+                                          interpret=cfg.ops_interpret))
     log.log(f"mesh: {n_dev} devices; tau={cfg.tau} mode={cfg.mode} "
             f"local_batch={cfg.local_batch} precision={cfg.precision}")
     if batch_transform is None:
@@ -542,16 +548,31 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
                 f"stale_after={elastic_cfg.stale_after_s}s "
                 f"min_workers={elastic_cfg.min_workers})")
 
+    # double-buffered H2D: the prefetch stage not only samples/preprocesses
+    # round R+1 but also PLACES it on device (same cast + sharding the
+    # dispatch-time path applies — trainer.place_batches' documented
+    # contract) while round R's XLA program runs, so train_round's `h2d`
+    # phase measures ~0 in steady state. Gated on the knob AND trainer
+    # capability (GraphTrainer places at dispatch, as before).
+    h2d_prefetch = bool(getattr(cfg, "h2d_prefetch", False)
+                        and hasattr(trainer, "place_batches"))
+
     def prepare_round(rnd: int, retry_: int,
-                      first_pass: bool) -> Dict[str, np.ndarray]:
+                      first_pass: bool) -> Dict[str, Any]:
         # span: host-side round prep runs on the `round-prep_0` prefetch
         # thread — its own lane in the trace timeline, visualizing the
         # overlap with the device round
         with obs_trace.span("round_prep", round=rnd):
-            return prepare_round_batches(source, rnd, cfg.tau, cfg.seed,
-                                         batch_transform, compute_dt,
-                                         retry=retry_, health=health_cfg,
-                                         first_pass=first_pass)
+            batches = prepare_round_batches(source, rnd, cfg.tau, cfg.seed,
+                                            batch_transform, compute_dt,
+                                            retry=retry_, health=health_cfg,
+                                            first_pass=first_pass)
+            if h2d_prefetch:
+                # compute_dt rides along: the precision policy is
+                # thread-local and this runs on the round-prep thread
+                with obs_trace.span("h2d_prefetch", round=rnd):
+                    batches = trainer.place_batches(batches, compute_dt)
+            return batches
 
     # step-time breakdown bookkeeping: per-round deltas of the phase
     # timers (data wait / H2D / compiled-round dispatch / checkpoint
@@ -651,12 +672,15 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     pending: Optional[Any] = None
     # pending (rnd, device_loss, device_probe, device_health) records,
     # flushed (= the loop's host sync) every cfg.log_every rounds —
-    # holding device scalars is free; fetching one costs a full round trip
-    deferred: list = []
+    # holding device scalars is free; fetching one costs a full round trip.
+    # A deque: list.pop(0) is O(n) per drain step, O(n^2) per flush — at
+    # log_every=1 it is noise, but a high-K flush (or the abort-path drain
+    # of a long deferred backlog) must not pay quadratic host time.
+    deferred: deque = deque()
 
     def flush_deferred() -> None:
         while deferred:
-            flush_round_log(deferred.pop(0))
+            flush_round_log(deferred.popleft())
 
     def recover(state):
         """Roll back to the newest VERIFIED non-anomalous checkpoint.

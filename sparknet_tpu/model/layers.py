@@ -44,6 +44,42 @@ def tp_shards_layer(layer: "LayerSpec", tp_size: int) -> bool:
             and layer.inner_product.num_output % tp_size == 0)
 
 
+@dataclasses.dataclass(frozen=True)
+class OpsImpl:
+    """Kernel-implementation selection for the ops the layer IR routes
+    through hand-written Pallas TPU kernels (RunConfig.lrn_impl /
+    pool_impl surface these as config knobs; ApplyCtx threads them to the
+    layer applications).
+
+    lrn:  "auto" (Pallas on TPU, fused-elementwise elsewhere), "pallas",
+          "fused", or "window" (the XLA reduce_window fallback).
+    pool: "auto" (Pallas MAX-pool backward on TPU when the shape gate
+          passes, XLA select-and-scatter elsewhere), "pallas", or "xla".
+          Default "xla": the last measured TPU A/B (r3) had the kernel
+          LOSING 10% end to end; "auto" is the opt-in re-tested by the
+          bench.py --mfu row pair — flip the default once BENCH_r06's
+          TPU rows justify it (PERF.md §r6 Status).
+    interpret: run the Pallas kernels under the Pallas INTERPRETER — the
+          CPU parity-test mode ("auto" then resolves to the kernels on
+          CPU too, so tier-1 pins the exact layer-path wiring TPU runs).
+    """
+
+    lrn: str = "auto"
+    pool: str = "xla"
+    interpret: bool = False
+
+    def __post_init__(self) -> None:
+        # fail at construction (config parse / trainer build), not at the
+        # first train_round's trace deep inside jit — same rule PR 6
+        # applied to ElasticConfig
+        if self.lrn not in ("auto", "pallas", "fused", "window"):
+            raise ValueError(f"unknown lrn impl {self.lrn!r}: expected "
+                             f"'auto', 'pallas', 'fused', or 'window'")
+        if self.pool not in ("auto", "pallas", "xla"):
+            raise ValueError(f"unknown pool impl {self.pool!r}: expected "
+                             f"'auto', 'pallas', or 'xla'")
+
+
 @dataclasses.dataclass
 class ApplyCtx:
     """Per-call context threaded through layer application.
@@ -53,12 +89,16 @@ class ApplyCtx:
     SHARDS of their weights ((in, out/tp_size), bias (out/tp_size,)) and
     all_gather the output features; other layers are replicated
     (`tp_shards_layer` is the single source of truth for the convention).
+
+    ops: kernel-implementation selection (OpsImpl) for LRN / pooling —
+    the Pallas-vs-XLA lever of the r6 MFU push.
     """
 
     train: bool = False
     rng: Optional[jax.Array] = None
     tp_axis: Optional[str] = None
     tp_size: int = 1
+    ops: OpsImpl = dataclasses.field(default_factory=OpsImpl)
 
     def tp_shards(self, layer: "LayerSpec") -> bool:
         return self.tp_axis is not None and tp_shards_layer(layer,
@@ -233,7 +273,8 @@ def apply_pooling(layer: LayerSpec, params, inputs, ctx: ApplyCtx):
     (x,) = inputs
     if p.global_pooling:
         return (global_pool2d(x, p.pool),)
-    return (pool2d(x, p.pool, p.kernel_size, p.stride, p.pad),)
+    return (pool2d(x, p.pool, p.kernel_size, p.stride, p.pad,
+                   impl=ctx.ops.pool, interpret=ctx.ops.interpret),)
 
 
 # ---------------------------------------------------------------------------
@@ -248,7 +289,8 @@ def infer_lrn(layer: LayerSpec, in_shapes):
 def apply_lrn(layer: LayerSpec, params, inputs, ctx: ApplyCtx):
     p = layer.lrn
     (x,) = inputs
-    return (lrn_op(x, p.local_size, alpha=p.alpha, beta=p.beta, k=p.k),)
+    return (lrn_op(x, p.local_size, alpha=p.alpha, beta=p.beta, k=p.k,
+                   impl=ctx.ops.lrn, interpret=ctx.ops.interpret),)
 
 
 # ---------------------------------------------------------------------------

@@ -18,7 +18,7 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from .layers import LAYER_IMPLS, ApplyCtx, Params
+from .layers import LAYER_IMPLS, ApplyCtx, OpsImpl, Params
 from .spec import InputSpec, NetSpec, validate
 
 PyTree = Dict[str, Params]
@@ -107,7 +107,8 @@ class CompiledNet:
     def apply(self, params: PyTree, batch: Dict[str, jnp.ndarray], *,
               train: bool = False, rng: Optional[jax.Array] = None,
               phase: Optional[str] = None, tp_axis: Optional[str] = None,
-              tp_size: int = 1) -> Dict[str, jnp.ndarray]:
+              tp_size: int = 1,
+              ops: Optional[OpsImpl] = None) -> Dict[str, jnp.ndarray]:
         """Run the net. `batch` maps input blob names to NHWC arrays.
 
         Returns every blob produced (inputs excluded), so callers can read
@@ -117,10 +118,14 @@ class CompiledNet:
 
         tp_axis/tp_size: run tensor-parallel (inside shard_map over that
         mesh axis) with column-sharded InnerProduct weights — see ApplyCtx.
+
+        ops: kernel-implementation selection for LRN/pooling (OpsImpl;
+        None = "auto" dispatch — Pallas kernels on TPU, portable paths
+        elsewhere).
         """
         phase = phase or ("TRAIN" if train else "TEST")
         ctx = ApplyCtx(train=train, rng=rng, tp_axis=tp_axis,
-                       tp_size=tp_size)
+                       tp_size=tp_size, ops=ops or OpsImpl())
         blobs: Dict[str, jnp.ndarray] = dict(batch)
         all_tops = set()
         for layer in self.spec.layers_for_phase(phase):
@@ -136,12 +141,13 @@ class CompiledNet:
         return blobs
 
     def loss_fn(self, loss_blob: str = "loss",
-                tp_axis: Optional[str] = None, tp_size: int = 1):
+                tp_axis: Optional[str] = None, tp_size: int = 1,
+                ops: Optional[OpsImpl] = None):
         """Returns `f(params, batch, rng) -> (loss, aux_blobs)` for jax.grad."""
 
         def f(params, batch, rng=None):
             blobs = self.apply(params, batch, train=True, rng=rng,
-                               tp_axis=tp_axis, tp_size=tp_size)
+                               tp_axis=tp_axis, tp_size=tp_size, ops=ops)
             return blobs[loss_blob], blobs
 
         return f

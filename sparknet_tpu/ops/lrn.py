@@ -24,8 +24,8 @@ from jax import lax
 # NOTE: deliberately not jit-decorated — always called inside an outer jit,
 # and grad-through-jit with static_argnames mis-linearizes in jax 0.9.
 def lrn(x: jnp.ndarray, local_size: int = 5, *, alpha: float = 1e-4,
-        beta: float = 0.75, k: float = 1.0, impl: str = "auto"
-        ) -> jnp.ndarray:
+        beta: float = 0.75, k: float = 1.0, impl: str = "auto",
+        interpret: bool = False) -> jnp.ndarray:
     """LRN across the channel (last) axis of an NHWC (or N...C) tensor.
 
     impl:
@@ -38,28 +38,36 @@ def lrn(x: jnp.ndarray, local_size: int = 5, *, alpha: float = 1e-4,
                CaffeNet round, PERF.md §LRN) — kept as the portable
                no-Pallas path and as the oracle for the kernel's VJP.
       "window" — reduce_window reference implementation (oracle tests).
+
+    interpret: run the Pallas kernel under the Pallas INTERPRETER — lets
+      "auto"/"pallas" resolve to the kernel on the CPU backend, so the
+      net-level parity tests pin the exact wiring TPU runs (see OpsImpl).
     """
     if impl not in ("auto", "pallas", "fused", "window"):
         raise ValueError(f"unknown LRN impl {impl!r}: expected "
                          f"'auto', 'pallas', 'fused', or 'window'")
-    if impl == "pallas" and not _can_pallas(x):
+    if impl == "pallas" and not _can_pallas(x, interpret):
         raise ValueError(
-            f"impl='pallas' requires a TPU backend and ndim >= 2 input "
-            f"(backend={jax.default_backend()!r}, ndim={x.ndim}; use "
-            f"'auto' for backend-dependent dispatch)")
-    if impl == "pallas" or (impl == "auto" and _can_pallas(x)):
+            f"impl='pallas' requires a TPU backend (or interpret=True) and "
+            f"ndim >= 2 input (backend={jax.default_backend()!r}, "
+            f"ndim={x.ndim}; use 'auto' for backend-dependent dispatch)")
+    if impl == "pallas" or (impl == "auto" and _can_pallas(x, interpret)):
         from .pallas_lrn import lrn_pallas
-        return lrn_pallas(x, local_size, alpha, beta, k)
+        return lrn_pallas(x, local_size, alpha, beta, k,
+                          interpret=interpret)
     if impl == "window":
         return _lrn_xla(x, local_size, alpha=alpha, beta=beta, k=k)
     return _lrn_fused(x, local_size, alpha, beta, k)
 
 
-def _can_pallas(x) -> bool:
+def _can_pallas(x, interpret: bool = False) -> bool:
     """Affirmative TPU check — an unknown future backend gets the portable
-    path, not the TPU Pallas kernel (the axon tunnel reports 'tpu')."""
+    path, not the TPU Pallas kernel (the axon tunnel reports 'tpu').
+    interpret=True substitutes the Pallas interpreter for the backend
+    requirement (CPU parity tests)."""
     try:
-        return jax.default_backend() == "tpu" and x.ndim >= 2
+        return ((interpret or jax.default_backend() == "tpu")
+                and x.ndim >= 2)
     except Exception:
         return False
 

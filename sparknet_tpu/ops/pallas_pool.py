@@ -169,6 +169,16 @@ def _from_nmin(x4):
     return jnp.transpose(x4, (3, 0, 1, 2))
 
 
+def kernel_api_available() -> bool:
+    """The backward kernel needs pl.Element/pl.BoundedSlice block specs
+    (jax >= 0.5-era Pallas). On older jax `pool2d`'s dispatch gate
+    (`_can_pallas_pool`) answers False so 'auto' degrades to the XLA
+    lowering instead of dying with an AttributeError at trace time.
+    Deliberately SEPARATE from `pallas_maxpool_supported`, which stays a
+    pure shape/geometry predicate."""
+    return hasattr(pl, "Element")
+
+
 def pallas_maxpool_supported(shape: Tuple[int, ...], dtype, kernel: int,
                              stride: int, pad: int) -> bool:
     """Static gate for the kernel path (see module docstring)."""

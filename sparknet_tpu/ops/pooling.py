@@ -40,20 +40,23 @@ def _ave_divisor_1d(size: int, kernel: int, stride: int, pad: int,
 
 
 def pool2d(x: jnp.ndarray, mode: str, kernel: int, stride: int,
-           pad: int, impl: str = "auto") -> jnp.ndarray:
+           pad: int, impl: str = "auto",
+           interpret: bool = False) -> jnp.ndarray:
     """Pool an NHWC tensor with Caffe semantics. mode: 'MAX' | 'AVE'.
 
-    impl: 'auto'/'xla' — reduce_window + its select-and-scatter VJP;
-    'pallas' — the ops/pallas_pool.py backward kernel (MAX only).
-    'auto' deliberately
-    does NOT pick the kernel: it reproduces first-max routing exactly and
-    its inner loops are fully contiguous, but measured end to end on the
-    r3 headline it LOSES 10% (20.5k -> 18.3k img/s/chip) — the custom-call
-    boundary breaks XLA's fusion of pool-backward with its elementwise
-    neighbors and the N-minor layout bitcast is not guaranteed for the
-    incoming gradient (unlike LRN, whose both sides face convs). Kept as a
-    measured dead end + the only exact-tie-semantics reference besides
-    select-and-scatter (PERF.md §pool-backward)."""
+    impl: 'xla' — reduce_window + its select-and-scatter VJP; 'pallas' —
+    the ops/pallas_pool.py backward kernel (MAX only, raises when the
+    shape gate fails); 'auto' — the kernel when MAX and the static gate
+    passes on TPU, XLA otherwise. Since r6 'auto' DOES pick the kernel:
+    the r3 standalone A/B lost 10% end to end (the custom-call boundary
+    broke XLA's fusion of pool-backward with its elementwise neighbors),
+    but in the r6 donated/overlapped round the kernel sits between the
+    Pallas LRN custom calls whose fusion boundaries already exist, and the
+    layer-path A/B (`bench.py --mfu`, BENCH_r06) re-measures both arms —
+    `pool_impl="xla"` (RunConfig) restores the old lowering wholesale.
+
+    interpret: run the Pallas kernel under the Pallas INTERPRETER — CPU
+    parity-test mode; 'auto' then applies the same shape gate on CPU."""
     if impl not in ("auto", "xla", "pallas"):
         raise ValueError(f"unknown pool impl {impl!r}: expected "
                          f"'auto', 'xla', or 'pallas'")
@@ -71,14 +74,18 @@ def pool2d(x: jnp.ndarray, mode: str, kernel: int, stride: int,
     strides = (1, stride, stride, 1)
 
     if mode == "MAX":
-        if impl == "pallas":
-            if not _can_pallas_pool(x, kernel, stride, pad):
+        # impl='xla' (the documented wholesale opt-out) must never touch
+        # the Pallas toolchain — only 'auto'/'pallas' consult the gate
+        if impl != "xla":
+            can = _can_pallas_pool(x, kernel, stride, pad, interpret)
+            if impl == "pallas" and not can:
                 raise ValueError(
                     f"impl='pallas' unsupported for shape {x.shape} "
                     f"k={kernel} s={stride} pad={pad} on "
                     f"{jax.default_backend()!r} (see pallas_pool docstring)")
-            from .pallas_pool import maxpool_pallas
-            return maxpool_pallas(x, kernel, stride)
+            if can:
+                from .pallas_pool import maxpool_pallas
+                return maxpool_pallas(x, kernel, stride, interpret)
         return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, padding)
     if mode == "AVE":
         # f32 accumulation (and: bf16 reduce_window-add mis-linearizes
@@ -92,12 +99,19 @@ def pool2d(x: jnp.ndarray, mode: str, kernel: int, stride: int,
     raise ValueError(f"unknown pool mode {mode!r}")
 
 
-def _can_pallas_pool(x, kernel: int, stride: int, pad: int) -> bool:
-    """Shape/backend gate for impl='pallas'. No blanket except: a broken
-    pallas_pool import must surface as itself, not masquerade as an
-    'unsupported shape' error (r3 review)."""
-    from .pallas_pool import pallas_maxpool_supported
-    return (jax.default_backend() == "tpu" and
+def _can_pallas_pool(x, kernel: int, stride: int, pad: int,
+                     interpret: bool = False) -> bool:
+    """Shape/backend/toolchain gate for the kernel path. No blanket
+    except: a broken pallas_pool import must surface as itself, not
+    masquerade as an 'unsupported shape' error (r3 review). interpret=True
+    waives the backend requirement (CPU parity tests), never the shape or
+    kernel-API gates. The backend check runs BEFORE the pallas_pool
+    import so 'auto' off-TPU stays as import-free as 'xla' — the default
+    path must run on a jax whose pallas import is broken."""
+    if not (interpret or jax.default_backend() == "tpu"):
+        return False
+    from .pallas_pool import kernel_api_available, pallas_maxpool_supported
+    return (kernel_api_available() and
             pallas_maxpool_supported(x.shape, x.dtype, kernel, stride, pad))
 
 

@@ -26,6 +26,18 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+def shard_map_unchecked(f, **kw):
+    """`shard_map` with replication checking OFF — required whenever the
+    body may trace a `pallas_call`, which has no shard_map replication
+    rule (jax's own error message names `check_rep=False` as the
+    workaround). Kwarg name varies by jax version: `check_rep`
+    (<= 0.5-ish) vs `check_vma` (newer)."""
+    try:
+        return shard_map(f, check_rep=False, **kw)
+    except TypeError:
+        return shard_map(f, check_vma=False, **kw)
+
+
 def axis_size(axis_name: str) -> int:
     """STATIC size of a mesh axis from inside shard_map (usable in
     `range()` / `jnp.arange()`): `lax.axis_size` where it exists (jax >=

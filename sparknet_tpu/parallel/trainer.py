@@ -40,12 +40,12 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..model.layers import tp_shards_layer
+from ..model.layers import OpsImpl, tp_shards_layer
 from ..model.net import CompiledNet, PyTree
 from ..solver import SgdSolver, SolverConfig, SolverState
 from .mesh import (DATA_AXIS, MODEL_AXIS, local_device_rows, make_mesh,
                    place_global_state, put_device_axis, scan_unroll,
-                   shard_map)
+                   shard_map, shard_map_unchecked)
 
 
 @jax.tree_util.register_dataclass
@@ -79,7 +79,9 @@ class ParallelTrainer:
     def __init__(self, net: CompiledNet, solver_cfg: SolverConfig, mesh: Mesh,
                  tau: int = 10, mode: str = "local_sgd",
                  loss_blob: str = "loss", acc_blob: Optional[str] = None,
-                 compute_health: bool = True, elastic_tau: bool = False):
+                 compute_health: bool = True, elastic_tau: bool = False,
+                 donate_batches: bool = False,
+                 ops: Optional[OpsImpl] = None):
         assert mode in ("local_sgd", "sync_sgd")
         if mode == "sync_sgd":
             assert tau == 1, "sync_sgd averages every step; tau must be 1"
@@ -144,12 +146,43 @@ class ParallelTrainer:
         self.elastic_tau = bool(elastic_tau)
         self._tau_vec_dev: Optional[Tuple[Tuple[int, ...], jax.Array]] = None
         extra_specs = (P(),) if self.elastic_tau else ()
+        #: kernel-implementation selection for LRN/pooling, threaded into
+        #: every loss/eval apply (the Pallas-vs-XLA config lever)
+        self.ops = ops or OpsImpl()
+        # donate_batches additionally donates the [tau, global_batch, ...]
+        # input buffers to the compiled round: XLA reuses their HBM for
+        # round intermediates instead of holding batch + intermediates
+        # live simultaneously (lower peak HBM, less allocator churn). The
+        # CONTRACT: the caller hands each round a FRESH batch pytree and
+        # never touches it again after train_round — device placement
+        # (put_device_axis) always allocates new buffers, so the two-slot
+        # rotation the train loop runs (round R donated to the executable
+        # while the prefetch thread places round R+1) can never write
+        # into a buffer the device still owns. Bench/test callers that
+        # re-feed one batches dict across rounds must leave this off.
+        self.donate_batches = bool(donate_batches)
+        # a pallas_call traced inside shard_map has no replication rule,
+        # so replication checking goes off exactly when the ops config can
+        # route LRN/pool to a Pallas kernel on this backend (explicit
+        # "pallas", or "auto" where it resolves to the kernel: TPU, or any
+        # backend under the interpreter)
+        may_pallas = any(
+            impl == "pallas"
+            or (impl == "auto" and (self.ops.interpret
+                                    or jax.default_backend() == "tpu"))
+            for impl in (self.ops.lrn, self.ops.pool))
+        smap = shard_map_unchecked if may_pallas else shard_map
         self._round = jax.jit(
-            shard_map(self._round_impl, mesh=mesh,
-                      in_specs=(state_specs, batch_spec, P(DATA_AXIS), P())
-                      + extra_specs,
-                      out_specs=(state_specs, P(), health_specs)),
-            donate_argnums=(0,))
+            smap(self._round_impl, mesh=mesh,
+                 in_specs=(state_specs, batch_spec, P(DATA_AXIS), P())
+                 + extra_specs,
+                 out_specs=(state_specs, P(), health_specs)),
+            donate_argnums=(0, 1) if self.donate_batches else (0,))
+        #: first-call-validated batch signatures: `_check_batch` asserts
+        #: the tau/divisibility invariants once per (input, shape, dtype,
+        #: placement) and steady-state rounds skip straight past them
+        self._batch_sigs: set = set()
+        self._local_data_groups = max(1, self.n_local_devices // self.tp)
         #: device scalars from the LAST train_round (fetch with float()):
         #: {"grad_norm": sqrt of the psum over workers of each worker's
         #: WORST-step squared grad norm (max-over-τ runs before the psum,
@@ -177,9 +210,9 @@ class ParallelTrainer:
         #: breakdown's two finest columns. None costs nothing.
         self.phase_timers = None
         self._eval = jax.jit(
-            shard_map(self._eval_impl, mesh=mesh,
-                      in_specs=(dev, P(DATA_AXIS)),
-                      out_specs=P()))
+            smap(self._eval_impl, mesh=mesh,
+                 in_specs=(dev, P(DATA_AXIS)),
+                 out_specs=P()))
 
     def compiled_variants(self) -> int:
         """Entries in the jitted round's executable cache — 1 in steady
@@ -392,7 +425,7 @@ class ParallelTrainer:
                   if tau_vec is not None else None)
 
         loss_fn = self.net.loss_fn(self.loss_blob, tp_axis=self._tp_axis,
-                                   tp_size=self.tp)
+                                   tp_size=self.tp, ops=self.ops)
         tp_layers = self._tp_sharded_layers()
 
         def fix_tp_grads(grads):
@@ -554,7 +587,8 @@ class ParallelTrainer:
     def _eval_impl(self, params, batch):
         params = jax.tree.map(lambda x: x[0], params)
         blobs = self.net.apply(params, batch, train=False,
-                               tp_axis=self._tp_axis, tp_size=self.tp)
+                               tp_axis=self._tp_axis, tp_size=self.tp,
+                               ops=self.ops)
         acc_blob = self.acc_blob or _find_accuracy_blob(self.net)
         n = next(iter(batch.values())).shape[0]
         correct = blobs[acc_blob] * n
@@ -580,7 +614,11 @@ class ParallelTrainer:
         (locally-addressable devices) × per-device batch; sharded over
         devices along axis 1. Single-process, host_batch == the global
         batch; multi-host, each process passes only its own hosts' examples
-        (disjoint data — the reference's per-executor partitions).
+        (disjoint data — the reference's per-executor partitions). Values
+        may instead be PRE-PLACED device arrays from `place_batches` (the
+        explicit contract documented there): the `h2d` phase then costs
+        nothing at dispatch. With `donate_batches`, this call CONSUMES the
+        batch buffers — feed fresh ones each round.
 
         `lr_scale` multiplies the lr-policy rate for this round (health
         supervisor backoff; a traced input, so changing it does not
@@ -650,7 +688,8 @@ class ParallelTrainer:
         return ParallelTrainer(
             self.net, self.solver.cfg, make_mesh(n_devices), tau=self.tau,
             mode=self.mode, loss_blob=self.loss_blob, acc_blob=self.acc_blob,
-            compute_health=self.compute_health, elastic_tau=self.elastic_tau)
+            compute_health=self.compute_health, elastic_tau=self.elastic_tau,
+            donate_batches=self.donate_batches, ops=self.ops)
 
     def evaluate(self, state: TrainState, batch: Dict[str, np.ndarray]) -> float:
         """Distributed accuracy over one global batch (psum of correct/count —
@@ -662,21 +701,89 @@ class ParallelTrainer:
             for k, v in precision.cast_host_inputs(batch).items()}
         return float(self._eval(state.params, sharded))
 
-    def _shard_batches(self, batches):
+    def place_batches(self, batches, compute_dt=None):
+        """Pre-place one round's batches on device — the H2D half of the
+        round, runnable OFF the dispatch path (the train loop's prefetch
+        thread calls this for round R+1 while round R computes, driving
+        train_round's `h2d` phase to ~0).
+
+        THE PLACEMENT CONTRACT (train_round / _shard_batches): a batch
+        value that is a `jax.Array` is treated as ALREADY PLACED — cast to
+        the compute dtype and sharded P(None, data) exactly as this method
+        produces — and passes through untouched; anything else is a host
+        array [tau, host_batch, ...] that gets cast + placed at dispatch.
+        Mixing is allowed per input. `compute_dt` must be passed when
+        calling from a worker thread: the precision policy is thread-local
+        (same rule as `precision.cast_host_inputs`).
+
+        With `donate_batches`, the returned arrays are CONSUMED by the
+        next train_round — place fresh ones each round (placement always
+        allocates new device buffers, so a pre-placed round R+1 can never
+        alias the donated round-R buffers the device still owns)."""
         from .. import precision
 
-        # the batch shards over the DATA axis only (TP replicas share rows)
-        local_data_groups = self.n_local_devices // self.tp
+        dt = (compute_dt if compute_dt is not None
+              else precision.compute_dtype())
         out = {}
-        for k, v in precision.cast_host_inputs(batches).items():
-            arr = v if hasattr(v, "devices") else np.asarray(v)
-            assert arr.shape[0] == self.tau, (
-                f"{k}: leading dim {arr.shape[0]} != tau {self.tau}")
-            assert arr.shape[1] % local_data_groups == 0, (
-                f"{k}: host batch {arr.shape[1]} not divisible by "
-                f"{local_data_groups} local data-parallel groups")
-            out[k] = put_device_axis(arr, self.mesh, P(None, DATA_AXIS))
+        for k, v in precision.cast_host_inputs(batches, dt).items():
+            if isinstance(v, jax.Array) and not isinstance(v, np.ndarray):
+                self._check_batch(k, v, placed=True, dt=dt)
+                out[k] = v
+            else:
+                arr = np.asarray(v)
+                self._check_batch(k, arr, placed=False)
+                # the batch shards over the DATA axis only (TP replicas
+                # share rows)
+                out[k] = put_device_axis(arr, self.mesh, P(None, DATA_AXIS))
         return out
+
+    def _check_batch(self, k: str, arr, placed: bool, dt=None) -> None:
+        """Batch invariants, hoisted to first sight of each (input, shape,
+        dtype, placement[, sharding]) signature — steady-state rounds take
+        one set lookup instead of re-asserting shapes and re-deriving the
+        local-group split every round."""
+        sig = (k, tuple(arr.shape), str(arr.dtype), placed,
+               str(dt) if placed else None,
+               arr.sharding if placed else None)
+        if sig in self._batch_sigs:
+            return
+        assert arr.shape[0] == self.tau, (
+            f"{k}: leading dim {arr.shape[0]} != tau {self.tau}")
+        if placed:
+            # pre-placed arrays carry the GLOBAL batch; they must split
+            # over every data group (their sharding was fixed at placement)
+            assert arr.shape[1] % max(1, self.n_data) == 0, (
+                f"{k}: global batch {arr.shape[1]} not divisible by "
+                f"{self.n_data} data-parallel groups")
+            # the dtype half of the placement contract, enforced: a float
+            # batch a caller placed WITHOUT the compute-dtype cast
+            # (cast_host_inputs skips device arrays) would otherwise
+            # silently diverge from the host-array path — a second jit
+            # executable and non-pinned numerics (same f32/bf16 rule as
+            # precision.cast_in)
+            if arr.dtype in (jnp.float32, jnp.bfloat16):
+                assert arr.dtype == dt, (
+                    f"{k}: pre-placed array has dtype {arr.dtype}, but the "
+                    f"compute dtype is {dt} — place via place_batches (it "
+                    f"casts), or cast before placing")
+            # the sharding half of the contract: a caller-placed array must
+            # already be P(None, data) over THIS mesh — a plain device_put'd
+            # array would pass the shape/dtype checks and then be silently
+            # resharded inside every dispatch, a real per-round copy hidden
+            # behind the t_h2d_ms ~ 0 the passthrough reports
+            want = NamedSharding(self.mesh, P(None, DATA_AXIS))
+            assert arr.sharding.is_equivalent_to(want, arr.ndim), (
+                f"{k}: pre-placed array sharding {arr.sharding} is not "
+                f"P(None, '{DATA_AXIS}') over the trainer mesh — place via "
+                f"place_batches")
+        else:
+            assert arr.shape[1] % self._local_data_groups == 0, (
+                f"{k}: host batch {arr.shape[1]} not divisible by "
+                f"{self._local_data_groups} local data-parallel groups")
+        self._batch_sigs.add(sig)
+
+    def _shard_batches(self, batches):
+        return self.place_batches(batches)
 
 
 def _find_accuracy_blob(net: CompiledNet) -> str:

@@ -124,6 +124,28 @@ class RunConfig:
     eval_batch: int = 1000
     # precision
     precision: str = "float32"          # or "bfloat16"
+    # round-pipeline overlap & fuse (the r6 MFU levers; each individually
+    # toggleable, each pinned bit-exact/parity by tests/test_round_pipeline):
+    # h2d_prefetch extends the one-deep host prefetch to also PLACE round
+    # R+1's batches on device (trainer.place_batches on the prefetch
+    # thread) while round R computes — t_h2d_ms in the step-time breakdown
+    # drops to ~0. donate_batches donates the [tau, global_batch, ...]
+    # buffers to the compiled round (two-slot rotation: R donated while
+    # R+1 places into fresh buffers), cutting peak HBM + allocator churn.
+    # lrn_impl / pool_impl pick the kernel implementation in the layer
+    # path: "auto" = the Pallas TPU kernels on TPU (XLA/fused elsewhere),
+    # lrn "window" / pool "xla" = the XLA reduce_window lowerings as the
+    # explicit fallback, "pallas" = force (raises where unsupported);
+    # validated at OpsImpl construction, i.e. trainer build. ops_interpret
+    # runs the Pallas kernels under the interpreter — CPU parity tests.
+    # pool_impl defaults to "xla" (the r3 TPU A/B measured the kernel
+    # losing 10% end to end); "auto" is the opt-in, re-measured by the
+    # bench.py --mfu row pair — flip here once BENCH_r06's TPU rows say so.
+    h2d_prefetch: bool = True
+    donate_batches: bool = True
+    lrn_impl: str = "auto"
+    pool_impl: str = "xla"
+    ops_interpret: bool = False
     # checkpoint. checkpoint_dir accepts a local path OR a gs://|s3://
     # prefix (native bucket checkpoints — no FUSE mount; utils/checkpoint
     # uploads through the data plane's HTTP clients). checkpoint_async

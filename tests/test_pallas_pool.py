@@ -32,6 +32,8 @@ def _xla_bwd(x, dy, k, s):
 @pytest.mark.parametrize("H,C,k,s", [(13, 8, 3, 2), (12, 8, 2, 2),
                                      (9, 16, 3, 1)])
 def test_kernel_matches_oracle_and_xla(rng, H, C, k, s):
+    if not pp.kernel_api_available():
+        pytest.skip("pallas pool kernel needs pl.Element (newer jax)")
     N = 128
     x = _tie_heavy(rng, (N, H, H, C))
     OH = (H - k) // s + 1
@@ -66,20 +68,72 @@ def test_pool2d_impl_pallas_rejects_unsupported(rng):
         pool2d(x, "MAX", 3, 2, 0, impl="pallas")  # CPU backend + N%128
 
 
-def test_pool2d_auto_is_xla_everywhere():
-    """`auto` must stay on reduce_window (the kernel measured -10% end to
-    end, PERF.md); this pins the dispatch so a refactor doesn't silently
-    flip it back on."""
+def test_pool2d_auto_consults_the_gate_and_degrades_to_xla():
+    """r6 made `auto` a real dispatch: it consults the full gate (backend/
+    kernel-API/shape) and takes the Pallas kernel where it passes —
+    `RunConfig.pool_impl="xla"` is the explicit opt-out. This pins both
+    halves: the gate IS consulted, and a False answer lands on the XLA
+    lowering (never a crash). The r3 'auto stays on select-and-scatter'
+    pin this replaces is now the per-deployment config decision, with the
+    bench.py --mfu A/B rows as the standing evidence (PERF.md §r6)."""
     import sparknet_tpu.ops.pooling as pooling
     called = []
     orig = pooling._can_pallas_pool
-    pooling._can_pallas_pool = lambda *a: called.append(a) or True
+    pooling._can_pallas_pool = lambda *a, **kw: called.append(a) or False
     try:
         x = jnp.zeros((128, 13, 13, 8), jnp.float32)
-        pool2d(x, "MAX", 3, 2, 0)          # auto
-        assert not called                   # never even consulted
+        y = pool2d(x, "MAX", 3, 2, 0)      # auto
+        assert called                       # the gate decides now
+        assert y.shape == (128, 6, 6, 8)    # gate said no -> XLA lowering
     finally:
         pooling._can_pallas_pool = orig
+    # on this backend/toolchain the real gate answers False (CPU without
+    # interpret, or a Pallas too old for the kernel API): auto == xla
+    if not pooling._can_pallas_pool(x, 3, 2, 0):
+        y_auto = pool2d(x, "MAX", 3, 2, 0)
+        y_xla = pool2d(x, "MAX", 3, 2, 0, impl="xla")
+        np.testing.assert_array_equal(np.asarray(y_auto), np.asarray(y_xla))
+
+
+def test_pool2d_impl_xla_never_consults_the_gate():
+    """impl='xla' is the documented wholesale opt-out: it must not consult
+    the Pallas gate at all (the gate imports the Pallas toolchain — the
+    explicit fallback has to work on a jax whose pallas import is
+    broken)."""
+    import sparknet_tpu.ops.pooling as pooling
+    orig = pooling._can_pallas_pool
+
+    def boom(*a, **kw):
+        raise AssertionError("gate consulted under impl='xla'")
+
+    pooling._can_pallas_pool = boom
+    try:
+        x = jnp.zeros((128, 13, 13, 8), jnp.float32)
+        y = pool2d(x, "MAX", 3, 2, 0, impl="xla")
+        assert y.shape == (128, 6, 6, 8)
+    finally:
+        pooling._can_pallas_pool = orig
+
+
+def test_pool2d_auto_off_tpu_never_imports_the_toolchain(monkeypatch):
+    """The DEFAULT impl='auto' off-TPU (no interpret) must be as
+    import-free as 'xla': the gate's backend check runs before the
+    pallas_pool import, so the default path also works on a jax whose
+    pallas import is broken."""
+    if jax.default_backend() == "tpu":
+        pytest.skip("off-TPU contract")
+    import builtins
+    real_import = builtins.__import__
+
+    def guarded(name, *a, **kw):
+        if "pallas_pool" in name:
+            raise AssertionError("pallas_pool imported under auto off-TPU")
+        return real_import(name, *a, **kw)
+
+    monkeypatch.setattr(builtins, "__import__", guarded)
+    x = jnp.zeros((128, 13, 13, 8), jnp.float32)
+    y = pool2d(x, "MAX", 3, 2, 0, impl="auto")
+    assert y.shape == (128, 6, 6, 8)
 
 
 def test_pool2d_impl_validation(rng):
