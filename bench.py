@@ -1362,6 +1362,190 @@ def mfu_bench(out_path: str | None = "BENCH_r06.json", batch: int = BATCH,
     return {"headline": out, "rows": rows}
 
 
+def sharding_bench(out_path: str | None = "BENCH_r07.json",
+                   trials: int = 8, n_devices: int = 8,
+                   small: bool | None = None) -> dict:
+    """The r7 NamedSharding audit trail (BENCH_r07): the CaffeNet round
+    through the host-fed path under three trainer arms on an n_devices
+    data mesh:
+
+      r6_prefetch_donate  the shard_map replica-layout ParallelTrainer
+                          with the r6 shipping levers (prefetch + donate)
+                          — the baseline the acceptance compares against
+      named_replicated    ShardedTrainer, state_sharding='replicated':
+                          exact reference semantics on NamedSharding-
+                          placed logical state (parity-pinned bitwise by
+                          tests/test_sharded.py); img/s must sit within
+                          2% of the r6 arm
+      named_momentum      ShardedTrainer, state_sharding='momentum'
+                          (ZeRO-1): ONE momentum stored sharded over the
+                          data axis — the per-device at-rest momentum
+                          bytes must drop by >= (n_data-1)/n_data of the
+                          shardable momentum bytes
+
+    Every arm reports the at-rest per-device state bytes from the
+    allocator's view (sharding.shard_shape per leaf — exact on every
+    backend, unlike memory_stats), plus HBM gauges where the backend has
+    them, plus `collect_stage1_ms`: the blocking cost of the checkpoint
+    stage-1 `fetch_global(state)`. The satellite's async-fetch A/B rides
+    along as fetch_async_ms vs fetch_sync_ms on the r6 arm's state (the
+    committed number is CPU-smoke structure; rerun on the pod for HBM
+    truth — PR 5's device gauges are the decision input this lever
+    serves)."""
+    import os
+
+    # the sharding arms need a real data axis: force a virtual mesh
+    # BEFORE jax initializes when no multi-chip backend is attached
+    # (same pattern as scaling(); the flag only affects the CPU backend)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{n_devices}").strip()
+    import jax
+
+    if small is None:
+        small = jax.default_backend() != "tpu"
+    import numpy as np
+
+    from sparknet_tpu import CompiledNet, precision
+    from sparknet_tpu.obs import run_metadata
+    from sparknet_tpu.parallel import (ParallelTrainer, ShardedTrainer,
+                                       make_mesh)
+    from sparknet_tpu.parallel.mesh import fetch_global
+    from sparknet_tpu.solver import SolverConfig
+    from sparknet_tpu.zoo import caffenet
+
+    n_dev = min(n_devices, len(jax.devices()))
+    batch, tau, crop, n_classes = ((2, 2, 35, 8) if small
+                                   else (64, 5, 227, 1000))
+    precision.set_policy("bfloat16")
+    compute_dt = precision.compute_dtype()
+    net = CompiledNet.compile(
+        caffenet(batch=batch * n_dev, crop=crop, n_classes=n_classes))
+    solver_cfg = SolverConfig(base_lr=0.01, momentum=0.9,
+                              weight_decay=5e-4, lr_policy="fixed")
+    r = np.random.default_rng(7)
+    host = {
+        "data": r.standard_normal(
+            (tau, batch * n_dev, crop, crop, 3)).astype(np.float32),
+        "label": r.integers(0, n_classes,
+                            (tau, batch * n_dev, 1)).astype(np.int32)}
+
+    from sparknet_tpu.parallel.mesh import \
+        per_device_state_bytes as per_device_bytes
+
+    def mem_row() -> dict:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return {k2: int(stats[k1]) for k1, k2 in
+                (("bytes_in_use", "hbm_bytes_in_use"),
+                 ("peak_bytes_in_use", "hbm_peak_bytes")) if k1 in stats}
+
+    fetch_ab = {}
+
+    def run_arm(name: str, cls, **kw) -> dict:
+        from concurrent.futures import ThreadPoolExecutor
+
+        trainer = cls(net, solver_cfg, make_mesh(n_dev), tau=tau,
+                      compute_health=False, donate_batches=True, **kw)
+        state = trainer.init_state(jax.random.PRNGKey(0))
+        key = jax.random.PRNGKey(1)
+        state, loss = trainer.train_round(
+            state, trainer.place_batches(host, compute_dt),
+            jax.random.fold_in(key, 999))
+        assert np.isfinite(float(loss))
+        exe = ThreadPoolExecutor(1, thread_name_prefix="shard-prep")
+        try:
+            pending = exe.submit(trainer.place_batches, host, compute_dt)
+            prev = None
+            t0 = time.perf_counter()
+            for i in range(trials):
+                batches = pending.result()
+                if i + 1 < trials:
+                    pending = exe.submit(trainer.place_batches, host,
+                                         compute_dt)
+                state, loss = trainer.train_round(
+                    state, batches, jax.random.fold_in(key, i))
+                if prev is not None:
+                    float(prev)
+                prev = loss
+            dt = time.perf_counter() - t0
+            float(prev)
+        finally:
+            exe.shutdown(wait=False, cancel_futures=True)
+        # checkpoint stage-1: the blocking host materialization of the
+        # full state (what _save_checkpoint pays on the round path).
+        # Measured on the FRESH post-window state — a jax.Array caches
+        # its host copy after the first materialization, so re-fetching
+        # the same state times the cache, not the transfer
+        jax.block_until_ready(jax.tree.leaves(state.params))
+        t1 = time.perf_counter()
+        fetch_global(state)
+        collect_ms = (time.perf_counter() - t1) * 1e3
+        if name == "r6_prefetch_donate":
+            # satellite A/B: fetch_global's async-first pre-pass
+            # (collect_ms above) vs the old serialized per-leaf blocking
+            # asarray — the sync arm needs its own fresh (never-
+            # materialized) state, hence one extra round
+            fetch_ab["fetch_async_ms"] = round(collect_ms, 3)
+            state, _ = trainer.train_round(
+                state, trainer.place_batches(host, compute_dt),
+                jax.random.fold_in(key, 10_000))
+            jax.block_until_ready(jax.tree.leaves(state.params))
+            t3 = time.perf_counter()
+            jax.tree.map(np.asarray, state)
+            fetch_ab["fetch_sync_ms"] = round(
+                (time.perf_counter() - t3) * 1e3, 3)
+        per_round = dt / trials
+        img_per_sec = batch * n_dev * tau / per_round
+        row = {
+            "arm": name, "trainer": cls.__name__,
+            "state_sharding": getattr(trainer, "state_sharding",
+                                      "replicated"),
+            "images_per_sec": round(img_per_sec, 2),
+            "round_ms": round(per_round * 1e3, 3),
+            "per_device_state_bytes": per_device_bytes(state),
+            "collect_stage1_ms": round(collect_ms, 3),
+            "compiled_variants": trainer.compiled_variants(),
+            **mem_row(),
+        }
+        print(f"  {name}: {img_per_sec:.1f} img/s, per-dev state "
+              f"{row['per_device_state_bytes']}, stage-1 "
+              f"{collect_ms:.1f} ms", file=sys.stderr)
+        return row
+
+    rows = [
+        run_arm("r6_prefetch_donate", ParallelTrainer),
+        run_arm("named_replicated", ShardedTrainer),
+        run_arm("named_momentum", ShardedTrainer,
+                state_sharding="momentum"),
+    ]
+    by = {r_["arm"]: r_ for r_ in rows}
+    base_m = by["r6_prefetch_donate"]["per_device_state_bytes"]["momentum"]
+    zm = by["named_momentum"]["per_device_state_bytes"]["momentum"]
+    out = {
+        "metric": "per_device_momentum_bytes_sharded_over_replicated",
+        "value": round(zm / max(base_m, 1), 4),
+        "unit": (f"at-rest momentum bytes per device, ZeRO-1 over "
+                 f"replicated on {n_dev} data groups (target <= "
+                 f"{1 - (n_dev - 1) / n_dev + 0.05:.3f}ish: 1/n_data "
+                 f"plus indivisible leaves)"),
+        "momentum_bytes_cut": base_m - zm,
+        "named_img_per_sec_vs_r6": round(
+            by["named_replicated"]["images_per_sec"]
+            / max(by["r6_prefetch_donate"]["images_per_sec"], 1e-9), 4),
+        "collect_stage1_ms": {a: by[a]["collect_stage1_ms"] for a in by},
+        **fetch_ab,
+        "n_data": n_dev, "batch_per_device": batch, "tau": tau,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"headline": out, "rows": rows,
+                       "meta": run_metadata()}, f, indent=1)
+    print(json.dumps(out))
+    return {"headline": out, "rows": rows}
+
+
 def e2e_smoke() -> None:
     """Integrated proof on the REAL chip at tunnel-feasible scale: tar
     shards -> streaming source -> preprocessor -> ParallelTrainer rounds
@@ -1439,6 +1623,11 @@ def main() -> None:
                    help="r6 overlap-and-fuse audit: host-fed rounds with "
                    "the prefetch/donation/Pallas levers toggled one at a "
                    "time + per-round breakdown; writes BENCH_r06")
+    p.add_argument("--sharding", action="store_true",
+                   help="r7 NamedSharding audit: replica vs logical vs "
+                   "ZeRO-1-momentum trainer arms — img/s, per-device "
+                   "state bytes, stage-1 collect blocking; writes "
+                   "BENCH_r07")
     p.add_argument("--elastic", action="store_true",
                    help="elastic chaos soak: kill + re-add a worker on a "
                    "virtual pod, compare the loss curve to a static pod, "
@@ -1481,6 +1670,8 @@ def main() -> None:
         import jax as _jax
         mfu_bench(batch=args.batch or BATCH, tau=args.tau,
                   small=_jax.default_backend() != "tpu")
+    elif args.sharding:
+        sharding_bench()
     elif args.elastic:
         elastic_bench(rounds=args.elastic_rounds, keep=args.keep)
     elif args.featurize:

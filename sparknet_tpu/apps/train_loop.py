@@ -42,6 +42,7 @@ from ..obs import device as obs_device
 from ..obs import pod as obs_pod
 from ..parallel.elastic import ElasticRelaunch, MembershipController
 from ..parallel.mesh import fetch_global, make_mesh
+from ..parallel.sharded import ShardedTrainer
 from ..parallel.trainer import ParallelTrainer, TrainState
 from ..data.dataset import ArrayDataset, RoundSampler
 from ..utils import checkpoint as ckpt
@@ -87,6 +88,27 @@ def resolve_spec(cfg: RunConfig, **input_shapes) -> NetSpec:
         raise ValueError(f"unknown model {cfg.model!r}: expected a .prototxt "
                          f"path or one of {sorted(builders)}")
     return builders[cfg.model]()
+
+
+def resolve_trainer_impl(cfg: RunConfig) -> str:
+    """cfg.trainer_impl -> the concrete layer-IR trainer implementation.
+    "auto" defers to $SPARKNET_TRAINER_IMPL (the CI matrix leg runs the
+    whole suite with it set to "named") and falls back to "shard_map",
+    today's default. Validated here — trainer BUILD time, the OpsImpl /
+    ElasticConfig rule — so a typo'd knob cannot silently train on the
+    wrong implementation."""
+    import os
+    impl = cfg.trainer_impl
+    if impl == "auto":
+        impl = os.environ.get("SPARKNET_TRAINER_IMPL", "shard_map")
+    if impl not in ("shard_map", "named"):
+        raise ValueError(f"unknown trainer_impl {impl!r}: expected "
+                         f"'auto', 'shard_map', or 'named'")
+    if impl != "named" and cfg.state_sharding != "replicated":
+        raise ValueError(
+            f"state_sharding={cfg.state_sharding!r} needs the NamedSharding "
+            f"trainer — set trainer_impl='named' (resolved: {impl!r})")
+    return impl
 
 
 def resolve_solver(cfg: RunConfig):
@@ -135,15 +157,25 @@ def train(cfg: RunConfig, spec: NetSpec, train_ds: ArrayDataset,
     compute_health = cfg.health is not None and cfg.health.enabled
     elastic_tau = (cfg.elastic is not None and cfg.elastic.enabled
                    and cfg.elastic.tau_adapt)
-    trainer = ParallelTrainer(net, cfg.solver, mesh, tau=cfg.tau,
-                              mode=cfg.mode, compute_health=compute_health,
-                              elastic_tau=elastic_tau,
-                              donate_batches=cfg.donate_batches,
-                              ops=OpsImpl(lrn=cfg.lrn_impl,
-                                          pool=cfg.pool_impl,
-                                          interpret=cfg.ops_interpret))
+    impl = resolve_trainer_impl(cfg)
+    trainer_kw: Dict[str, Any] = {}
+    trainer_cls = ParallelTrainer
+    if impl == "named":
+        trainer_cls = ShardedTrainer
+        trainer_kw["state_sharding"] = cfg.state_sharding
+    trainer = trainer_cls(net, cfg.solver, mesh, tau=cfg.tau,
+                          mode=cfg.mode, compute_health=compute_health,
+                          elastic_tau=elastic_tau,
+                          donate_batches=cfg.donate_batches,
+                          ops=OpsImpl(lrn=cfg.lrn_impl,
+                                      pool=cfg.pool_impl,
+                                      interpret=cfg.ops_interpret),
+                          **trainer_kw)
     log.log(f"mesh: {n_dev} devices; tau={cfg.tau} mode={cfg.mode} "
-            f"local_batch={cfg.local_batch} precision={cfg.precision}")
+            f"local_batch={cfg.local_batch} precision={cfg.precision} "
+            f"trainer={impl}"
+            + (f" state_sharding={cfg.state_sharding}"
+               if impl == "named" else ""))
     if batch_transform is None:
         train_ds = _to_device_layout(train_ds, net)
     if test_ds is not None and eval_transform is None:
@@ -827,21 +859,38 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
                 f"membership epoch {ev.epoch}: {ev.n_workers} worker(s) "
                 f"(dead {list(ev.dead)}, joined {list(ev.joined)}); "
                 f"checkpointed round {rnd}")
+        old_trainer, old_state = trainer, state
         trainer = trainer_factory(new_n_dev)
         if hasattr(trainer, "resized"):
             # rebind the factory: the old one is a bound method of the
             # PREVIOUS trainer and would pin it (and its compiled round
             # executable) alive for the rest of the run
             trainer_factory = trainer.resized
-        found = ckpt.restore_newest_verified(cfg.checkpoint_dir)
-        if found is None:
-            raise TrainingHealthError(
-                f"elastic: membership changed but no verified checkpoint "
-                f"exists under {cfg.checkpoint_dir!r} to resize from.")
-        flat, ck_round, extra = found
-        state = trainer.adapt_state(
-            flat, old_tp=int(extra.get("tp", 1)),
-            momentum_policy=elastic_cfg.momentum_policy)
+        replaced_live = (hasattr(trainer, "adapt_live") and
+                         getattr(old_trainer, "state_layout", "")
+                         == "logical")
+        if replaced_live:
+            # NamedSharding trainer: the resize is a RE-PLACEMENT — the
+            # live logical state (params topology-free, momentum rows
+            # policy-mapped) moves straight onto the new mesh; the
+            # boundary checkpoint just written stays the durable record
+            # but the store is never read back
+            state = trainer.adapt_live(
+                old_state, momentum_policy=elastic_cfg.momentum_policy)
+            ck_round = rnd
+        else:
+            found = ckpt.restore_newest_verified(cfg.checkpoint_dir)
+            if found is None:
+                raise TrainingHealthError(
+                    f"elastic: membership changed but no verified "
+                    f"checkpoint exists under {cfg.checkpoint_dir!r} to "
+                    f"resize from.")
+            flat, ck_round, extra = found
+            state = trainer.adapt_state(
+                flat, old_tp=int(extra.get("tp", 1)),
+                momentum_policy=elastic_cfg.momentum_policy,
+                old_layout=extra.get("layout", "replica"))
+        del old_trainer, old_state
         source = source.reshard(trainer.n_local_devices)
         n_dev = trainer.n_devices
         n_local = trainer.n_local_devices
@@ -851,8 +900,10 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         if g_variants is not None and hasattr(trainer, "compiled_variants"):
             g_variants.set_fn(trainer.compiled_variants)
         log.log(f"elastic resize: epoch {ev.epoch} -> {ev.n_workers} "
-                f"worker(s) on {n_dev} device(s); restored verified "
-                f"round {ck_round}"
+                f"worker(s) on {n_dev} device(s); "
+                + (f"re-placed live state at round {ck_round}"
+                   if replaced_live else
+                   f"restored verified round {ck_round}")
                 + (f"; evicted {list(ev.dead)}" if ev.dead else "")
                 + (f"; joined {list(ev.joined)}" if ev.joined else ""))
         return state, ck_round
@@ -1120,8 +1171,17 @@ def _restore_state(trainer, state, flat: Dict[str, np.ndarray],
         it_arr = np.asarray(flat["it"])
         if it_arr.ndim:
             saved_dev = it_arr.shape[0]
+    # the state LAYOUT is part of the topology: a logical (NamedSharding
+    # trainer) checkpoint under a replica-axis trainer — or the reverse,
+    # or a different state_sharding mode (momentum shape changes) — must
+    # take the adapt path, not unflatten_like
+    saved_layout = extra.get("layout", "replica")
+    t_layout = getattr(trainer, "state_layout", "replica")
     same_topo = (int(saved_dev or trainer.n_devices) == trainer.n_devices
-                 and int(extra.get("tp", tp_now)) == tp_now)
+                 and int(extra.get("tp", tp_now)) == tp_now
+                 and saved_layout == t_layout
+                 and (extra.get("state_sharding", "replicated")
+                      == getattr(trainer, "state_sharding", "replicated")))
     if same_topo:
         return trainer.place(ckpt.unflatten_like(state, flat)), True
     if not hasattr(trainer, "adapt_state"):
@@ -1129,9 +1189,20 @@ def _restore_state(trainer, state, flat: Dict[str, np.ndarray],
             f"checkpoint topology {extra} != current "
             f"({trainer.n_devices} devices, tp={tp_now}) and this trainer "
             f"cannot adapt — resume on the original topology")
-    # ELASTIC: params re-tiled exactly, momentum reconstructed
-    # (ParallelTrainer.adapt_state)
-    return trainer.adapt_state(flat, old_tp=int(extra.get("tp", 1))), False
+    # ELASTIC / cross-layout: params re-tiled exactly, momentum
+    # reconstructed (adapt_state; old_layout routes the parse). Only the
+    # layer-IR trainers declare state_layout and accept old_layout= —
+    # GraphTrainer.adapt_state(flat, old_tp) predates layouts, and a
+    # logical checkpoint has no graph-backend reading anyway.
+    kw = {"old_tp": int(extra.get("tp", 1))}
+    if hasattr(trainer, "state_layout"):
+        kw["old_layout"] = saved_layout
+    elif saved_layout != "replica":
+        raise ValueError(
+            f"checkpoint layout {saved_layout!r} needs a layer-IR trainer "
+            f"to adapt; {type(trainer).__name__} only reads replica "
+            f"checkpoints")
+    return trainer.adapt_state(flat, **kw), False
 
 
 def _stream_rows(source, last_round: Optional[int]) -> Optional[list]:
@@ -1245,6 +1316,16 @@ def _save_checkpoint(cfg: RunConfig, trainer, state, step: int,
     def persist() -> None:
         extra = {"n_devices": trainer.n_devices,
                  "tp": getattr(trainer, "tp", 1)}
+        layout = getattr(trainer, "state_layout", "replica")
+        if layout != "replica":
+            # NamedSharding trainer: logical leaves (no [n_devices] axis).
+            # Stamped so restore routes between the layouts; the momentum
+            # SHAPE additionally depends on the state_sharding mode
+            # ([n_data] worker rows vs one ZeRO-averaged tree). Replica
+            # checkpoints stay byte-identical to the pre-r7 format.
+            extra["layout"] = layout
+            extra["state_sharding"] = getattr(trainer, "state_sharding",
+                                              "replicated")
         if stream is not None:
             extra["stream"] = stream
         if anomalous:

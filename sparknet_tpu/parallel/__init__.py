@@ -1,6 +1,7 @@
 from .mesh import (DATA_AXIS, MODEL_AXIS, make_mesh,  # noqa: F401
                    initialize_multihost)
 from .trainer import ParallelTrainer, TrainState  # noqa: F401
+from .sharded import ShardedTrainer  # noqa: F401
 from .graph_trainer import GraphTrainer  # noqa: F401
 from .elastic import (ElasticRelaunch, MembershipController,  # noqa: F401
                       MembershipEvent)

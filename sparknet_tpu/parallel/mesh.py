@@ -184,11 +184,40 @@ def place_global_state(tree, mesh: Mesh, spec: P):
 def fetch_global(tree):
     """Materialize (possibly multi-host-sharded) arrays as host numpy on
     EVERY process — the collective the checkpoint writer needs (momentum is
-    worker-local state, so this is a real allgather, not a replica read)."""
+    worker-local state, so this is a real allgather, not a replica read).
+
+    Single-process, the device->host copies for ALL leaves are started
+    asynchronously FIRST (`copy_to_host_async`), then materialized: the
+    transfers overlap each other (and whatever the device is still
+    computing) instead of serializing one blocking `np.asarray` per leaf —
+    the checkpoint stage-1 fetch is the main beneficiary (BENCH_r07
+    non-blocking-collect arm)."""
     if jax.process_count() == 1:
+        for leaf in jax.tree.leaves(tree):
+            if isinstance(leaf, jax.Array):
+                try:
+                    leaf.copy_to_host_async()
+                except Exception:
+                    pass  # fetch still correct via the blocking asarray
         return jax.tree.map(np.asarray, tree)
     from jax.experimental import multihost_utils
     return multihost_utils.process_allgather(tree, tiled=True)
+
+
+def per_device_state_bytes(state) -> dict:
+    """At-rest bytes ONE device holds for this TrainState's params and
+    momentum — the HBM ledger the ZeRO state_sharding modes exist to
+    shrink (`sharding.shard_shape` is the allocator's view, exact on any
+    backend). One definition shared by the BENCH_r07 acceptance ledger
+    (bench.py --sharding) and the tier-1 byte pin (tests/test_sharded.py)
+    so the two cannot drift."""
+    out = {}
+    for name, tree in (("params", state.params),
+                       ("momentum", state.momentum)):
+        out[name] = sum(
+            int(np.prod(leaf.sharding.shard_shape(leaf.shape)))
+            * leaf.dtype.itemsize for leaf in jax.tree.leaves(tree))
+    return out
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

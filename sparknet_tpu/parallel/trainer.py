@@ -120,19 +120,12 @@ class ParallelTrainer:
         # — matches mesh.devices.flat for a ("data","model") mesh)
         dev = (P((DATA_AXIS, MODEL_AXIS)) if self.tp > 1 else P(DATA_AXIS))
         self._dev_spec = dev
-        # [tau, global_batch, ...]: batch sharded over data, replicated
-        # across the model group (TP replicas consume identical examples)
-        batch_spec = P(None, DATA_AXIS)
-        state_specs = TrainState(params=dev, momentum=dev, it=dev)
 
         # compute_health=False compiles the ORIGINAL round — no isfinite
         # passes over the state, no per-step grad-norm reduction, no extra
         # scalar collectives (for runs that disable the supervisor, e.g.
         # deliberate-divergence fixtures or wire-byte-pinned benchmarks)
         self.compute_health = bool(compute_health)
-        health_specs = ({"grad_norm": P(), "nonfinite": P(),
-                         "nonfinite_by_worker": P()}
-                        if self.compute_health else {})
         # elastic_tau compiles the round with ONE extra traced input: a
         # replicated [n_data] int32 vector of per-worker local-step
         # budgets (heterogeneous pods — the elastic layer shortens a
@@ -145,7 +138,6 @@ class ParallelTrainer:
         # the flag compile the byte-identical legacy round.
         self.elastic_tau = bool(elastic_tau)
         self._tau_vec_dev: Optional[Tuple[Tuple[int, ...], jax.Array]] = None
-        extra_specs = (P(),) if self.elastic_tau else ()
         #: kernel-implementation selection for LRN/pooling, threaded into
         #: every loss/eval apply (the Pallas-vs-XLA config lever)
         self.ops = ops or OpsImpl()
@@ -171,13 +163,7 @@ class ParallelTrainer:
             or (impl == "auto" and (self.ops.interpret
                                     or jax.default_backend() == "tpu"))
             for impl in (self.ops.lrn, self.ops.pool))
-        smap = shard_map_unchecked if may_pallas else shard_map
-        self._round = jax.jit(
-            smap(self._round_impl, mesh=mesh,
-                 in_specs=(state_specs, batch_spec, P(DATA_AXIS), P())
-                 + extra_specs,
-                 out_specs=(state_specs, P(), health_specs)),
-            donate_argnums=(0, 1) if self.donate_batches else (0,))
+        self._smap = shard_map_unchecked if may_pallas else shard_map
         #: first-call-validated batch signatures: `_check_batch` asserts
         #: the tau/divisibility invariants once per (input, shape, dtype,
         #: placement) and steady-state rounds skip straight past them
@@ -209,10 +195,40 @@ class ParallelTrainer:
         #: (the compiled round's enqueue) — the per-round step-time
         #: breakdown's two finest columns. None costs nothing.
         self.phase_timers = None
+        self._compile()
+
+    #: checkpoint/state-layout tag ("replica": every leaf carries the
+    #: leading [n_devices] axis; the NamedSharding trainer overrides with
+    #: "logical") — stamped into checkpoint `extra` so restore can route
+    #: between the layouts
+    state_layout = "replica"
+
+    def _health_specs(self):
+        return ({"grad_norm": P(), "nonfinite": P(),
+                 "nonfinite_by_worker": P()}
+                if self.compute_health else {})
+
+    def _compile(self) -> None:
+        """Build the jitted round + eval executables. The state lives on
+        the mesh as [n_devices]-leading-axis leaves sharded over the whole
+        device axis; batches are [tau, global_batch, ...] sharded over
+        data only (TP replicas consume identical examples). Subclasses
+        with a different state layout override this (and only this plus
+        the state-construction methods) — the round MATH is shared via
+        `_round_math`."""
+        dev = self._dev_spec
+        state_specs = TrainState(params=dev, momentum=dev, it=dev)
+        extra_specs = (P(),) if self.elastic_tau else ()
+        self._round = jax.jit(
+            self._smap(self._round_impl, mesh=self.mesh,
+                       in_specs=(state_specs, P(None, DATA_AXIS),
+                                 P(DATA_AXIS), P()) + extra_specs,
+                       out_specs=(state_specs, P(), self._health_specs())),
+            donate_argnums=(0, 1) if self.donate_batches else (0,))
         self._eval = jax.jit(
-            smap(self._eval_impl, mesh=mesh,
-                 in_specs=(dev, P(DATA_AXIS)),
-                 out_specs=P()))
+            self._smap(self._eval_impl, mesh=self.mesh,
+                       in_specs=(dev, P(DATA_AXIS)),
+                       out_specs=P()))
 
     def compiled_variants(self) -> int:
         """Entries in the jitted round's executable cache — 1 in steady
@@ -277,10 +293,17 @@ class ParallelTrainer:
 
     def adapt_state(self, flat: Dict[str, np.ndarray],
                     old_tp: int = 1,
-                    momentum_policy: str = "norm_rescale") -> TrainState:
+                    momentum_policy: str = "norm_rescale",
+                    old_layout: str = "replica") -> TrainState:
         """ELASTIC resume: rebuild a TrainState for THIS topology from a
         checkpoint taken on a different one (`checkpoint.restore_flat`
         output; keys 'params/<layer>/<blob>', 'momentum/...', 'it').
+
+        `old_layout="logical"` accepts a ShardedTrainer checkpoint
+        (logical full params, momentum as [n_data] worker rows or one
+        ZeRO-averaged tree): params re-tile exactly; worker momentum rows
+        map 1:1 onto devices when the data-group count matches (tp == 1),
+        else reconstruct per `momentum_policy`.
 
         Params are exact — post-round replicas are identical, so data
         group 0's (reassembled) copy IS the model. Momentum is worker-
@@ -313,26 +336,13 @@ class ParallelTrainer:
         through this path is exact."""
         assert momentum_policy in ("average", "zero", "norm_rescale"), (
             momentum_policy)
+        if old_layout == "logical":
+            return self._adapt_logical(flat, momentum_policy)
         old_tp_layers = {l.name for l in self.net.spec.layers
                          if tp_shards_layer(l, old_tp)}
 
         def reduce_momentum(rows: np.ndarray) -> np.ndarray:
-            # f32 accumulator: a bf16 velocity (SolverConfig.
-            # velocity_dtype) must not be averaged in bf16
-            avg = rows.mean(axis=0, dtype=np.float32)
-            if momentum_policy == "zero":
-                return np.zeros_like(avg).astype(rows.dtype)
-            if momentum_policy == "norm_rescale":
-                # averaging k partially-decorrelated velocities shrinks
-                # the norm ~1/sqrt(k); rescale the mean back to the
-                # average per-worker norm so the first post-resume steps
-                # keep their step size
-                target = float(np.mean([np.linalg.norm(
-                    r.astype(np.float32)) for r in rows]))
-                cur = float(np.linalg.norm(avg))
-                if cur > 0:
-                    avg = avg * (target / cur)
-            return avg.astype(rows.dtype)
+            return reduce_momentum_rows(rows, momentum_policy)
 
         def reassemble(kind: str, lname: str, pname: str,
                        x: np.ndarray) -> np.ndarray:
@@ -370,6 +380,42 @@ class ParallelTrainer:
                 it=jnp.full((self.n_devices,), it, jnp.int32)))
         return self.state_from_params(trees["params"],
                                       momentum=trees["momentum"], it=it)
+
+    def _adapt_logical(self, flat: Dict[str, np.ndarray],
+                       momentum_policy: str) -> TrainState:
+        """adapt_state's logical-layout branch (see its docstring)."""
+        params: PyTree = {}
+        mom_rows: PyTree = {}
+        it = 0
+        for key, arr in flat.items():
+            parts = key.split("/")
+            if parts[0] == "it":
+                it = int(np.asarray(arr).reshape(-1)[0])
+                continue
+            kind, lname, pname = parts
+            (params if kind == "params"
+             else mom_rows).setdefault(lname, {})[pname] = np.asarray(arr)
+        rows_exact = self.tp == 1 and mom_rows and all(
+            m.ndim == np.asarray(params[l][p]).ndim + 1
+            and m.shape[0] == self.n_devices
+            for l, lp in mom_rows.items() for p, m in ((p, lp[p])
+                                                       for p in lp))
+        if rows_exact:
+            # each logical worker row IS that device's momentum (tp == 1:
+            # data groups == devices) — the exact, policy-free mapping
+            return self.place(TrainState(
+                params={l: {p: jnp.broadcast_to(
+                    jnp.asarray(x)[None], (self.n_devices,) + x.shape)
+                    for p, x in lp.items()} for l, lp in params.items()},
+                momentum={l: {p: jnp.asarray(m) for p, m in lp.items()}
+                          for l, lp in mom_rows.items()},
+                it=jnp.full((self.n_devices,), it, jnp.int32)))
+        momentum = {l: {p: (reduce_momentum_rows(m, momentum_policy)
+                            if m.ndim == np.asarray(params[l][p]).ndim + 1
+                            else m)
+                        for p, m in lp.items()}
+                    for l, lp in mom_rows.items()} or None
+        return self.state_from_params(params, momentum=momentum, it=it)
 
     def place(self, state: TrainState) -> TrainState:
         """Re-place a (possibly host/numpy) TrainState onto the mesh sharding
@@ -423,7 +469,24 @@ class ParallelTrainer:
         # replicated per-worker vector (elastic_tau trainers only)
         my_tau = (tau_vec[lax.axis_index(DATA_AXIS)]
                   if tau_vec is not None else None)
+        params, sstate, mean_loss, health = self._round_math(
+            params, momentum, it, batches, rng, lr_scale, my_tau)
+        new_state = TrainState(
+            params=jax.tree.map(lambda x: x[None], params),
+            momentum=jax.tree.map(lambda x: x[None], sstate.momentum),
+            it=sstate.it[None],
+        )
+        return new_state, mean_loss, health
 
+    def _round_math(self, params, momentum, it, batches, rng, lr_scale,
+                    my_tau):
+        """The round's MATH on per-device logical views (params/momentum
+        without any device axis): τ local SGD steps, weight averaging over
+        the data axis, health scalars. Runs INSIDE shard_map; shared
+        verbatim by both state layouts (ParallelTrainer's [n_devices]
+        replica rows and ShardedTrainer's NamedSharding-placed logical
+        state) so the parity suite can pin them bitwise. Returns (params,
+        SolverState, mean_loss, health)."""
         loss_fn = self.net.loss_fn(self.loss_blob, tp_axis=self._tp_axis,
                                    tp_size=self.tp, ops=self.ops)
         tp_layers = self._tp_sharded_layers()
@@ -574,13 +637,7 @@ class ParallelTrainer:
             # numerically a no-op (TP replicas compute identical losses);
             # clears the model-axis vma so the P() out_spec typechecks
             mean_loss = lax.pmean(mean_loss, self._tp_axis)
-
-        new_state = TrainState(
-            params=jax.tree.map(lambda x: x[None], params),
-            momentum=jax.tree.map(lambda x: x[None], sstate.momentum),
-            it=sstate.it[None],
-        )
-        return new_state, mean_loss, health
+        return params, sstate, mean_loss, health
 
     # -- distributed eval ----------------------------------------------------
 
@@ -685,11 +742,17 @@ class ParallelTrainer:
                 "elastic resize with tensor parallelism: the shard "
                 "assignment changes with the mesh — checkpoint and "
                 "relaunch at the new size instead")
-        return ParallelTrainer(
+        return type(self)(
             self.net, self.solver.cfg, make_mesh(n_devices), tau=self.tau,
             mode=self.mode, loss_blob=self.loss_blob, acc_blob=self.acc_blob,
             compute_health=self.compute_health, elastic_tau=self.elastic_tau,
-            donate_batches=self.donate_batches, ops=self.ops)
+            donate_batches=self.donate_batches, ops=self.ops,
+            **self._ctor_extra())
+
+    def _ctor_extra(self) -> Dict[str, Any]:
+        """Subclass-specific constructor kwargs `resized()` must carry to
+        the replacement trainer (e.g. ShardedTrainer.state_sharding)."""
+        return {}
 
     def evaluate(self, state: TrainState, batch: Dict[str, np.ndarray]) -> float:
         """Distributed accuracy over one global batch (psum of correct/count —
@@ -784,6 +847,26 @@ class ParallelTrainer:
 
     def _shard_batches(self, batches):
         return self.place_batches(batches)
+
+
+def reduce_momentum_rows(rows: np.ndarray, policy: str) -> np.ndarray:
+    """Reconstruct ONE momentum from k per-worker velocity rows — the
+    elastic-resume reconstruction (see ParallelTrainer.adapt_state for the
+    r5 A/B evidence behind the policies). f32 accumulator: a bf16 velocity
+    (SolverConfig.velocity_dtype) must not be averaged in bf16."""
+    avg = rows.mean(axis=0, dtype=np.float32)
+    if policy == "zero":
+        return np.zeros_like(avg).astype(rows.dtype)
+    if policy == "norm_rescale":
+        # averaging k partially-decorrelated velocities shrinks the norm
+        # ~1/sqrt(k); rescale the mean back to the average per-worker norm
+        # so the first post-resume steps keep their step size
+        target = float(np.mean([np.linalg.norm(
+            r.astype(np.float32)) for r in rows]))
+        cur = float(np.linalg.norm(avg))
+        if cur > 0:
+            avg = avg * (target / cur)
+    return avg.astype(rows.dtype)
 
 
 def _find_accuracy_blob(net: CompiledNet) -> str:

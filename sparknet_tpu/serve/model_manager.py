@@ -42,23 +42,33 @@ from ..utils.logger import Logger
 
 
 class ServeModelError(RuntimeError):
-    """A checkpoint cannot be served (missing/mis-shaped leaves, or a
-    tensor-parallel checkpoint whose column shards this single-net server
-    cannot reassemble)."""
+    """A checkpoint cannot be served (missing or mis-shaped leaves that no
+    known layout — bare params, replica-axis TrainState, TP column shards,
+    logical NamedSharding state — explains)."""
 
 
 def params_from_checkpoint_flat(flat: Dict[str, np.ndarray],
-                                template: Dict[str, Dict[str, Any]]
+                                template: Dict[str, Dict[str, Any]],
+                                tp: int = 1
                                 ) -> Dict[str, Dict[str, jnp.ndarray]]:
     """Training-checkpoint flat keys -> a JaxNet params pytree.
 
-    Accepts both layouts the store holds: a full TrainState
-    (`params/<layer>/<param>` with the trainer's leading [n_devices]
-    replica axis — post-round replicas are identical, shard 0 is THE
-    value) and a bare params tree (`<layer>/<param>`, e.g. a checkpoint
-    of JaxNet.params). Momentum/it keys are ignored: serving wants
-    weights, not optimizer state. Missing or shape-mismatched leaves fail
-    loudly with the leaf path."""
+    Accepts every layout the store holds: a full replica-axis TrainState
+    (`params/<layer>/<param>` with the shard_map trainer's leading
+    [n_devices] axis — post-round replicas are identical, shard 0 is THE
+    value), the NamedSharding trainer's logical layout (full weights, no
+    leading axis), and a bare params tree (`<layer>/<param>`, e.g. a
+    checkpoint of JaxNet.params). Momentum/it keys are ignored: serving
+    wants weights, not optimizer state.
+
+    `tp` (from checkpoint `extra["tp"]`): a replica-axis TENSOR-PARALLEL
+    checkpoint stores each column-sharded layer as per-device shards
+    (device d = data d//tp, model d%tp — rows 0..tp-1 are data group 0's
+    model ranks); such leaves are reassembled by concatenating the tp
+    shards along the column dim (w: 1, b: 0). The NamedSharding trainer's
+    TP checkpoints are already full logical weights, so they need no
+    reassembly. Missing or shape-mismatched leaves fail loudly with the
+    leaf path."""
     out: Dict[str, Dict[str, jnp.ndarray]] = {}
     for lname, lp in template.items():
         out[lname] = {}
@@ -75,7 +85,21 @@ def params_from_checkpoint_flat(flat: Dict[str, np.ndarray],
             if tuple(arr.shape) != want:
                 if arr.ndim == len(want) + 1 and \
                         tuple(arr.shape[1:]) == want:
-                    arr = arr[0]  # leading replica axis
+                    arr = arr[0]  # leading replica axis, replicated leaf
+                elif tp > 1 and arr.ndim == len(want) + 1 \
+                        and arr.shape[0] >= tp:
+                    # replica-axis TP column shards: data group 0's model
+                    # ranks are rows 0..tp-1; the column dim is the one
+                    # whose concat restores the template shape
+                    axis = 1 if pname == "w" and len(want) > 1 else 0
+                    cand = np.concatenate([arr[j] for j in range(tp)],
+                                          axis=axis)
+                    if tuple(cand.shape) != want:
+                        raise ServeModelError(
+                            f"{lname}/{pname}: tp={tp} shards "
+                            f"{arr.shape} do not reassemble to net "
+                            f"{want}")
+                    arr = cand
                 else:
                     raise ServeModelError(
                         f"{lname}/{pname}: checkpoint shape {arr.shape} "
@@ -191,15 +215,14 @@ class ModelManager:
 
     def _install(self, flat: Dict[str, np.ndarray], step: int,
                  extra: Dict[str, Any], initial: bool = False) -> bool:
-        if int(extra.get("tp", 1)) != 1:
-            self._reject(step, f"tensor-parallel checkpoint (tp="
-                               f"{extra.get('tp')}) — column shards "
-                               f"cannot be served by a single net")
-            return False
         old_params = self.net.params
         try:
+            # tp>1 checkpoints serve fine since r7: replica-axis column
+            # shards reassemble inside params_from_checkpoint_flat, and
+            # the NamedSharding trainer's TP checkpoints are already full
+            # logical weights — the canary still vets the result
             self.net.params = params_from_checkpoint_flat(
-                flat, self.net.params)
+                flat, self.net.params, tp=int(extra.get("tp", 1)))
         except ServeModelError as e:
             self._reject(step, str(e))
             return False

@@ -118,6 +118,23 @@ class RunConfig:
     tau: int = 10                       # local steps per sync round
     mode: str = "local_sgd"             # or "sync_sgd"
     local_batch: int = 100
+    # trainer implementation for the layer-IR backend. "shard_map": the
+    # replica-axis ParallelTrainer (state leaves carry a leading
+    # [n_devices] axis). "named": the NamedSharding ShardedTrainer
+    # (parallel/sharded.py — logical state placed by spec; prerequisite
+    # for state_sharding below; parity-pinned against shard_map by
+    # tests/test_sharded.py). "auto" (default): $SPARKNET_TRAINER_IMPL if
+    # set (the CI matrix leg sets it to "named"), else "shard_map".
+    trainer_impl: str = "auto"
+    # ZeRO-1-style at-rest state sharding (trainer_impl="named" only;
+    # requires tp == 1): "replicated" = exact reference semantics
+    # (worker-local momentum); "momentum" = ONE momentum stored sharded
+    # over the data axis (per-device optimizer-state HBM / n_data;
+    # cross-worker averaged each round — the r5 A/B measured averaging
+    # within noise of norm_rescale); "full" = params also stored sharded
+    # at rest. PR 5's HBM gauges say when a net needs this; BENCH_r07
+    # carries the per-device before/after bytes.
+    state_sharding: str = "replicated"
     # loop
     max_rounds: int = 100
     eval_every: int = 5                 # rounds between evals (reference: 5/10)

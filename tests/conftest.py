@@ -29,3 +29,15 @@ def _logs_to_tmp(tmp_path, monkeypatch):
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session", params=["shard_map", "named"])
+def trainer_cls(request):
+    """Both layer-IR trainer implementations (r7): the shard_map replica-
+    layout ParallelTrainer and the NamedSharding logical-state
+    ShardedTrainer. Trainer-facing tests take this fixture so the parity
+    pin is the test MATRIX itself — every round-pipeline, elastic, and
+    health-layout behavior must hold under either implementation."""
+    from sparknet_tpu.parallel import ParallelTrainer, ShardedTrainer
+    return (ParallelTrainer if request.param == "shard_map"
+            else ShardedTrainer)

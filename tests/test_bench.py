@@ -156,6 +156,43 @@ def test_mfu_bench_smoke(tmp_path):
     assert art["meta"]["jax_version"]  # run_metadata stamp
 
 
+def test_sharding_bench_smoke(tmp_path):
+    """bench.sharding_bench runs the three r7 trainer arms and writes a
+    complete BENCH_r07-style artifact. The deterministic claims are
+    asserted here too (they do not depend on CPU timing): the ZeRO-1 arm
+    cuts the per-device at-rest momentum bytes by >= (n_data-1)/n_data of
+    the replicated arm's, params stay replicated in the momentum mode,
+    and the stage-1 collect number is recorded per arm. The 2%-img/s
+    acceptance is a committed-BENCH_r07 claim (timing on a shared-core
+    CPU mesh is noise), not a tier-1 assertion."""
+    import bench
+    out_path = str(tmp_path / "BENCH_r07.json")
+    out = bench.sharding_bench(out_path=out_path, trials=2, small=True)
+    rows = out["rows"]
+    assert [r["arm"] for r in rows] == [
+        "r6_prefetch_donate", "named_replicated", "named_momentum"]
+    by = {r["arm"]: r for r in rows}
+    for r in rows:
+        assert r["images_per_sec"] > 0
+        assert r["collect_stage1_ms"] >= 0
+    base = by["r6_prefetch_donate"]["per_device_state_bytes"]
+    rep = by["named_replicated"]["per_device_state_bytes"]
+    zm = by["named_momentum"]["per_device_state_bytes"]
+    assert rep == base  # logical replicated == replica layout, byte for byte
+    assert zm["params"] == base["params"]
+    n = out["headline"]["n_data"]
+    # >= (n_data-1)/n_data of the momentum bytes stays the conservative
+    # floor even counting indivisible leaves (CaffeNet's momentum mass is
+    # in divisible fc/conv weights)
+    assert base["momentum"] - zm["momentum"] >= \
+        base["momentum"] * (n - 1) / n * 0.95, (base, zm)
+    art = json.load(open(out_path))
+    assert art["headline"]["metric"] == \
+        "per_device_momentum_bytes_sharded_over_replicated"
+    assert art["meta"]["jax_version"]
+    assert "fetch_async_ms" in art["headline"]
+
+
 def test_profiler_trace_capture(tmp_path):
     """maybe_trace writes a TensorBoard-loadable capture; None is a no-op."""
     import jax

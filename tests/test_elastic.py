@@ -235,17 +235,18 @@ def test_tau_adapt_through_train_loop(tmp_path):
     st = train(cfg, net_from_prototxt(TINY_MLP), _tiny_ds(), None,
                logger=log, round_hook=hook)
     log.close()
-    assert np.asarray(st.params[list(st.params)[0]]["w"]).shape[0] == 4
+    # layout-neutral topology probe (momentum rows == data groups)
+    assert np.asarray(st.momentum[list(st.momentum)[0]]["w"]).shape[0] == 4
     # the loop ran with a 4-entry vector (or full-τ None) — no assert
     # fired, and training completed across heterogeneous budgets
 
 
 # -- per-worker τ masking (elastic_tau) --------------------------------------
 
-def _tiny(n_dev, tau=3, **kw):
+def _tiny(n_dev, tau=3, cls=ParallelTrainer, **kw):
     net = CompiledNet.compile(net_from_prototxt(TINY_MLP))
     scfg = SolverConfig(base_lr=0.05, momentum=0.9, lr_policy="fixed")
-    return ParallelTrainer(net, scfg, make_mesh(n_dev), tau=tau, **kw)
+    return cls(net, scfg, make_mesh(n_dev), tau=tau, **kw)
 
 
 def _tiny_batches(n_dev, tau=3, b=4, seed=0):
@@ -255,9 +256,10 @@ def _tiny_batches(n_dev, tau=3, b=4, seed=0):
     return {"data": data, "label": label}
 
 
-def test_elastic_tau_full_vector_matches_legacy():
+def test_elastic_tau_full_vector_matches_legacy(trainer_cls):
     import jax
-    t0, t1 = _tiny(4), _tiny(4, elastic_tau=True)
+    t0 = _tiny(4, cls=trainer_cls)
+    t1 = _tiny(4, elastic_tau=True, cls=trainer_cls)
     b = _tiny_batches(4)
     s0, l0 = t0.train_round(t0.init_state(jax.random.PRNGKey(0)), b,
                             jax.random.PRNGKey(1))
@@ -271,16 +273,16 @@ def test_elastic_tau_full_vector_matches_legacy():
                 rtol=1e-5, atol=1e-7, err_msg=f"{ln}/{pn}")
 
 
-def test_tau_by_worker_all_ones_equals_tau1_trainer():
+def test_tau_by_worker_all_ones_equals_tau1_trainer(trainer_cls):
     """Masking oracle: every worker budgeted 1 step == a τ=1 trainer on
     the first slice (same per-worker rng rows by construction)."""
     import jax
-    t_el = _tiny(4, elastic_tau=True)
+    t_el = _tiny(4, elastic_tau=True, cls=trainer_cls)
     b = _tiny_batches(4)
     sA, lA = t_el.train_round(t_el.init_state(jax.random.PRNGKey(0)), b,
                               jax.random.PRNGKey(1),
                               tau_by_worker=[1, 1, 1, 1])
-    t_ref = _tiny(4, tau=1)
+    t_ref = _tiny(4, tau=1, cls=trainer_cls)
     sB, lB = t_ref.train_round(t_ref.init_state(jax.random.PRNGKey(0)),
                                {k: v[:1] for k, v in b.items()},
                                jax.random.PRNGKey(1))
@@ -292,11 +294,15 @@ def test_tau_by_worker_all_ones_equals_tau1_trainer():
                 rtol=1e-5, atol=1e-7, err_msg=f"{ln}/{pn}")
 
 
-def test_tau_by_worker_changes_are_recompile_free():
+def test_tau_by_worker_changes_are_recompile_free(trainer_cls):
     import jax
-    t = _tiny(2, elastic_tau=True)
+    t = _tiny(2, elastic_tau=True, cls=trainer_cls)
     b = _tiny_batches(2)
     s = t.init_state(jax.random.PRNGKey(0))
+    # two priming rounds: steady state is ONE executable plus a fast-path
+    # key for its own output layout (the second round's input), which the
+    # two layouts reach one round apart
+    s, _ = t.train_round(s, b, jax.random.PRNGKey(1))
     s, _ = t.train_round(s, b, jax.random.PRNGKey(1))
     n0 = t.compiled_variants()
     for vec in ([2, 3], [1, 1], [3, 2]):
@@ -306,8 +312,10 @@ def test_tau_by_worker_changes_are_recompile_free():
     with pytest.raises(ValueError):
         _tiny(2).train_round(s, b, jax.random.PRNGKey(3),
                              tau_by_worker=[1, 1])
-    # resized() carries the whole configuration to the new mesh
+    # resized() carries the whole configuration (and the CLASS) to the
+    # new mesh
     t2 = t.resized(1)
+    assert type(t2) is trainer_cls
     assert (t2.n_devices, t2.tau, t2.elastic_tau) == (1, t.tau, True)
 
 
@@ -343,22 +351,29 @@ def _kill(pod_dir, worker):
 
 
 @pytest.mark.chaos
-def test_elastic_evict_and_rejoin_through_train_loop(tmp_path):
+@pytest.mark.parametrize("impl", ["shard_map", "named"])
+def test_elastic_evict_and_rejoin_through_train_loop(tmp_path, impl):
     """THE tentpole path: a worker's heartbeat goes stale mid-run -> the
-    loop evicts it at the τ boundary (resize 2 devices -> 1, restored
-    from the verified checkpoint), it comes back -> rejoin (1 -> 2).
-    Every eviction/rejoin lands in the JSONL audit trail and training
-    keeps descending across both resizes."""
+    loop evicts it at the τ boundary (resize 2 devices -> 1; restored
+    from the verified checkpoint under the replica layout, RE-PLACED
+    live under the NamedSharding layout), it comes back -> rejoin
+    (1 -> 2). Every eviction/rejoin lands in the JSONL audit trail and
+    training keeps descending across both resizes — under BOTH trainer
+    implementations."""
     pod = tmp_path / "pod"
     hb1 = HeartbeatWriter(worker_heartbeat_path(str(pod), 1),
                           interval_s=0.0)
     hb1.beat(0, status="ok", round_s=0.01, force=True)
-    cfg = _tiny_cfg(tmp_path, 2, max_rounds=12)
+    cfg = _tiny_cfg(tmp_path, 2, max_rounds=12, trainer_impl=impl)
     shapes, killed, rejoined = [], [False], [False]
 
     def hook(rnd, state):
+        # layout-neutral topology probe: replicated momentum rows count
+        # the data groups in BOTH layouts ([n_devices] replica rows vs
+        # [n_data] logical worker rows; tp == 1 here so they coincide)
         shapes.append(
-            np.asarray(state.params[list(state.params)[0]]["w"]).shape[0])
+            np.asarray(state.momentum[list(state.momentum)[0]]
+                       ["w"]).shape[0])
         if not killed[0] and rnd == 2:
             killed[0] = True
             _kill(pod, 1)
@@ -382,6 +397,9 @@ def test_elastic_evict_and_rejoin_through_train_loop(tmp_path):
     assert epochs == sorted(epochs) and epochs[-1] == 2
     losses = [r["loss"] for r in recs if "loss" in r]
     assert losses[-1] < losses[0]  # survived BOTH resizes and kept learning
+    if impl == "named":
+        # the logical layout resizes by RE-PLACEMENT, not store read-back
+        assert "re-placed live state" in open(str(tmp_path / "l.txt")).read()
 
 
 @pytest.mark.chaos
@@ -428,7 +446,7 @@ def test_elastic_resume_after_halt_continues(tmp_path):
     st = train(cfg, net_from_prototxt(TINY_MLP), _tiny_ds(), None,
                logger=log)
     log.close()
-    assert np.asarray(st.params[list(st.params)[0]]["w"]).shape[0] == 1
+    assert np.asarray(st.momentum[list(st.momentum)[0]]["w"]).shape[0] == 1
     assert "ELASTIC resume" in open(log_path).read()
 
 

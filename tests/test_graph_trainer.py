@@ -283,6 +283,37 @@ def test_graph_elastic_resume(tmp_path, rng):
     assert np.isfinite(float(loss))
 
 
+def test_graph_elastic_resume_through_restore_state(tmp_path, rng):
+    """The run_loop resume seam: _restore_state must route a graph
+    checkpoint from a different device count through adapt_state WITHOUT
+    layout kwargs (GraphTrainer predates state layouts — r7 regression:
+    an unconditional old_layout= was a TypeError here), and must refuse
+    a logical-layout checkpoint loudly rather than mis-parse it."""
+    from sparknet_tpu.apps.train_loop import _restore_state
+    from sparknet_tpu.parallel.mesh import fetch_global
+    from sparknet_tpu.utils import checkpoint as ck
+
+    t8 = GraphTrainer(GraphNet(build_mnist_graph(batch=LOCAL_B)),
+                      make_mesh(8), tau=2)
+    state = t8.init_state()
+    state, _ = t8.train_round(state, _mnist_batches(rng, tau=2))
+    d = str(tmp_path / "ck")
+    ck.save(d, fetch_global(state), step=1, extra={"n_devices": 8, "tp": 1})
+    flat, _, extra = ck.restore_flat(d)
+
+    t4 = GraphTrainer(GraphNet(build_mnist_graph(batch=LOCAL_B)),
+                      make_mesh(4), tau=2)
+    s4, same = _restore_state(t4, t4.init_state(), flat, extra)
+    assert not same
+    assert np.asarray(s4["it"]).shape == (4,)
+    _, loss = t4.train_round(s4, _mnist_batches(rng, tau=2, global_b=16))
+    assert np.isfinite(float(loss))
+    # a NamedSharding-layout checkpoint has no graph-backend reading
+    with pytest.raises(ValueError, match="layer-IR"):
+        _restore_state(t4, t4.init_state(), flat,
+                       dict(extra, layout="logical"))
+
+
 def test_graph_adapt_rejects_foreign_checkpoint(tmp_path):
     """A layer-backend (params/momentum) checkpoint must be rejected with a
     clear error, not adapted into an empty graph state."""

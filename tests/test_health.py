@@ -115,14 +115,18 @@ def test_health_config_round_trips_through_run_config():
 
 
 @pytest.fixture(scope="module")
-def tiny_trainer():
+def tiny_trainer(trainer_cls):
+    """Parametrized over BOTH trainer implementations (conftest
+    trainer_cls): the [n_data+1] health psum layout and its per-worker
+    attribution must hold identically under the shard_map replica layout
+    and the NamedSharding logical layout."""
     from sparknet_tpu import CompiledNet, net_from_prototxt
-    from sparknet_tpu.parallel import ParallelTrainer, make_mesh
+    from sparknet_tpu.parallel import make_mesh
     from sparknet_tpu.solver import SolverConfig
     from test_parallel import TINY_MLP
     net = CompiledNet.compile(net_from_prototxt(TINY_MLP))
     cfg = SolverConfig(base_lr=0.05, momentum=0.9, lr_policy="fixed")
-    return ParallelTrainer(net, cfg, make_mesh(), tau=3)
+    return trainer_cls(net, cfg, make_mesh(), tau=3)
 
 
 def _mlp_batches(seed):

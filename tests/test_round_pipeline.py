@@ -99,13 +99,14 @@ def assert_trees_bitwise(a, b, msg=""):
 # -- pin (a): pre-placed device batches == host batches ----------------------
 
 
-def test_preplaced_batches_bitwise_equal_host_batches(net, solver_cfg):
+def test_preplaced_batches_bitwise_equal_host_batches(net, solver_cfg,
+                                                      trainer_cls):
     """place_batches on the 'prefetch side' then train_round must produce
     the SAME post-round params as handing train_round the host arrays —
     pre-placement is the same cast + put_device_axis, just earlier."""
     mesh = make_mesh(N_DEV)
-    t_host = ParallelTrainer(net, solver_cfg, mesh, tau=TAU)
-    t_pre = ParallelTrainer(net, solver_cfg, mesh, tau=TAU)
+    t_host = trainer_cls(net, solver_cfg, mesh, tau=TAU)
+    t_pre = trainer_cls(net, solver_cfg, mesh, tau=TAU)
     s_host = t_host.init_state(jax.random.PRNGKey(3))
     s_pre = t_pre.init_state(jax.random.PRNGKey(3))
     for rnd in range(3):
@@ -119,14 +120,15 @@ def test_preplaced_batches_bitwise_equal_host_batches(net, solver_cfg):
     assert_trees_bitwise(params_np(s_host), params_np(s_pre), "preplaced")
 
 
-def test_preplaced_batches_thread_cast_matches_main_thread(net, solver_cfg):
+def test_preplaced_batches_thread_cast_matches_main_thread(net, solver_cfg,
+                                                           trainer_cls):
     """The prefetch thread passes compute_dt explicitly (the precision
     policy is thread-local): placement on a worker thread under the bf16
     policy must equal main-thread placement bit for bit."""
     from concurrent.futures import ThreadPoolExecutor
 
     mesh = make_mesh(N_DEV)
-    t = ParallelTrainer(net, solver_cfg, mesh, tau=TAU)
+    t = trainer_cls(net, solver_cfg, mesh, tau=TAU)
     with precision.policy("bfloat16"):
         dt = precision.compute_dtype()
         main = t.place_batches(make_round_batches(0), dt)
@@ -144,16 +146,17 @@ def test_preplaced_batches_thread_cast_matches_main_thread(net, solver_cfg):
 # -- pin (b): donated-batch rotation never aliases a live buffer -------------
 
 
-def test_donating_trainer_bitwise_equals_non_donating(net, solver_cfg):
+def test_donating_trainer_bitwise_equals_non_donating(net, solver_cfg,
+                                                      trainer_cls):
     """Hammer τ rounds through a donate_batches trainer fed freshly placed
     batches each round (the train loop's two-slot rotation) and through
     the legacy non-donating trainer: every round's loss and the final
     params must match BITWISE — donation may recycle buffers, never
     values."""
     mesh = make_mesh(N_DEV)
-    t_ref = ParallelTrainer(net, solver_cfg, mesh, tau=TAU)
-    t_don = ParallelTrainer(net, solver_cfg, mesh, tau=TAU,
-                            donate_batches=True)
+    t_ref = trainer_cls(net, solver_cfg, mesh, tau=TAU)
+    t_don = trainer_cls(net, solver_cfg, mesh, tau=TAU,
+                        donate_batches=True)
     assert t_don.donate_batches and not t_ref.donate_batches
     s_ref = t_ref.init_state(jax.random.PRNGKey(9))
     s_don = t_don.init_state(jax.random.PRNGKey(9))
@@ -175,12 +178,12 @@ def test_donating_trainer_bitwise_equals_non_donating(net, solver_cfg):
     assert_trees_bitwise(params_np(s_ref), params_np(s_don), "donate")
 
 
-def test_donated_batches_are_consumed(net, solver_cfg):
+def test_donated_batches_are_consumed(net, solver_cfg, trainer_cls):
     """The donation contract: train_round CONSUMES the batch buffers — a
     caller re-feeding the same placed dict must fail loudly (deleted
     arrays), not silently compute on recycled memory."""
     mesh = make_mesh(N_DEV)
-    t = ParallelTrainer(net, solver_cfg, mesh, tau=TAU, donate_batches=True)
+    t = trainer_cls(net, solver_cfg, mesh, tau=TAU, donate_batches=True)
     s = t.init_state(jax.random.PRNGKey(0))
     placed = t.place_batches(make_round_batches(0))
     s, loss = t.train_round(s, placed, jax.random.PRNGKey(1))
@@ -199,7 +202,8 @@ def test_donated_batches_are_consumed(net, solver_cfg):
 # -- satellite: jit-cache churn gauge ----------------------------------------
 
 
-def test_overlapped_round_holds_steady_jit_cache(net, solver_cfg):
+def test_overlapped_round_holds_steady_jit_cache(net, solver_cfg,
+                                                 trainer_cls):
     """The overlapped/donating round must hold a STEADY executable cache:
     pre-placement and donation may not introduce shape/layout churn. The
     vanilla trainer's cache plateaus after round 1 (the round-0 entry is
@@ -208,9 +212,9 @@ def test_overlapped_round_holds_steady_jit_cache(net, solver_cfg):
     jax); the levered trainer must plateau at the SAME count and never
     grow past it."""
     mesh = make_mesh(N_DEV)
-    t_ref = ParallelTrainer(net, solver_cfg, mesh, tau=TAU)
-    t_lev = ParallelTrainer(net, solver_cfg, mesh, tau=TAU,
-                            donate_batches=True)
+    t_ref = trainer_cls(net, solver_cfg, mesh, tau=TAU)
+    t_lev = trainer_cls(net, solver_cfg, mesh, tau=TAU,
+                        donate_batches=True)
     s_ref = t_ref.init_state(jax.random.PRNGKey(0))
     s_lev = t_lev.init_state(jax.random.PRNGKey(0))
     for rnd in range(2):  # reach steady state (round-0 key + output key)
@@ -230,36 +234,38 @@ def test_overlapped_round_holds_steady_jit_cache(net, solver_cfg):
         assert t_ref.compiled_variants() == steady_ref, rnd
 
 
-def test_preplaced_wrong_dtype_fails_loudly(net, solver_cfg):
+def test_preplaced_wrong_dtype_fails_loudly(net, solver_cfg, trainer_cls):
     """The dtype half of the placement contract is ENFORCED, not just
     documented: a float32 jax.Array fed under the bf16 policy (a caller
     that placed without the compute-dtype cast — cast_host_inputs skips
     device arrays) must fail at first sight, not silently train an f32
     second executable."""
-    t = ParallelTrainer(net, solver_cfg, make_mesh(N_DEV), tau=TAU)
+    t = trainer_cls(net, solver_cfg, make_mesh(N_DEV), tau=TAU)
     bad = {k: jnp.asarray(v) for k, v in make_round_batches(0).items()}
     with precision.policy("bfloat16"):
         with pytest.raises(AssertionError, match="compute dtype"):
             t.place_batches(bad)
 
 
-def test_preplaced_wrong_sharding_fails_loudly(net, solver_cfg):
+def test_preplaced_wrong_sharding_fails_loudly(net, solver_cfg,
+                                               trainer_cls):
     """The SHARDING half of the placement contract: a jax.Array placed
     without the P(None, data) spec (e.g. a plain single-device
     device_put) must fail at first sight — passing it through would make
     jit reshard it inside every dispatch, a real per-round copy hidden
     behind the passthrough's t_h2d_ms ~ 0."""
-    t = ParallelTrainer(net, solver_cfg, make_mesh(N_DEV), tau=TAU)
+    t = trainer_cls(net, solver_cfg, make_mesh(N_DEV), tau=TAU)
     bad = {k: jax.device_put(jnp.asarray(v), jax.devices()[0])
            for k, v in make_round_batches(0).items()}
     with pytest.raises(AssertionError, match="sharding"):
         t.place_batches(bad)
 
 
-def test_batch_invariants_still_enforced_on_first_call(net, solver_cfg):
+def test_batch_invariants_still_enforced_on_first_call(net, solver_cfg,
+                                                       trainer_cls):
     """Hoisting the shape checks to first sight must not lose them: a
     wrong tau or an indivisible batch still fails loudly."""
-    t = ParallelTrainer(net, solver_cfg, make_mesh(N_DEV), tau=TAU)
+    t = trainer_cls(net, solver_cfg, make_mesh(N_DEV), tau=TAU)
     good = make_round_batches(0)
     with pytest.raises(AssertionError, match="tau"):
         t.place_batches({k: v[:1] for k, v in good.items()})

@@ -248,17 +248,73 @@ def test_manager_initial_load_and_flat_extraction(net, tmp_path):
             assert params[lname][pname].shape == w.shape
 
 
-def test_manager_rejects_tp_and_missing_leaves(net, tmp_path):
+def test_manager_rejects_missing_leaves(net, tmp_path):
     d = tmp_path / "ck"
     _save_trainstate_like(net, d, step=1)
-    m = ModelManager(net, checkpoint_dir=str(d), poll_interval_s=0.0)
     flat, _, _ = ckpt.restore_flat(str(d))
-    assert not m._install(flat, 1, {"tp": 2})  # column shards: unservable
-    assert m.swap_failures == 1
     with pytest.raises(ServeModelError, match="conv1"):
         params_from_checkpoint_flat(
             {k: v for k, v in flat.items() if "conv1" not in k},
             net.params)
+    # a claimed-tp checkpoint whose shards do NOT reassemble to the net's
+    # shapes still fails loudly with the leaf path
+    bad = dict(flat)
+    bad["params/fc1/w"] = bad["params/fc1/w"][:, :, :100]
+    with pytest.raises(ServeModelError, match="fc1"):
+        params_from_checkpoint_flat(bad, net.params, tp=2)
+
+
+def _tp2_trainer_checkpoint(cls, d, step):
+    """A REAL tp=2 training checkpoint of the serve net's architecture,
+    written exactly as the train loop persists it (fetch_global ->
+    flatten, topology in extra)."""
+    import jax
+
+    from sparknet_tpu import CompiledNet
+    from sparknet_tpu.parallel import make_mesh
+    from sparknet_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS,
+                                            fetch_global)
+    from sparknet_tpu.solver import SolverConfig
+    from sparknet_tpu.zoo import lenet as lenet_spec
+
+    cnet = CompiledNet.compile(lenet_spec(batch=4))
+    mesh = make_mesh(4, axis_names=(DATA_AXIS, MODEL_AXIS), shape=(2, 2))
+    t = cls(cnet, SolverConfig(base_lr=0.01, momentum=0.9,
+                               lr_policy="fixed"), mesh, tau=1)
+    state = t.init_state(jax.random.PRNGKey(5))
+    flat = ckpt._flatten(fetch_global(state))
+    extra = {"n_devices": 4, "tp": 2}
+    if getattr(t, "state_layout", "replica") != "replica":
+        extra["layout"] = t.state_layout
+        extra["state_sharding"] = t.state_sharding
+    ckpt.save(str(d), flat, step=step, extra=extra)
+    return {l: {p: np.asarray(x) for p, x in lp.items()}
+            for l, lp in t.averaged_params(state).items()}
+
+
+def test_manager_serves_tp2_checkpoints_both_layouts(net, tmp_path):
+    """r7: tp=2 checkpoints are servable. The replica layout's per-device
+    column shards reassemble inside params_from_checkpoint_flat; the
+    NamedSharding layout stores full logical weights and needs no
+    reassembly. Either way the installed params equal the trainer's own
+    averaged_params BITWISE and the manager reports a healthy swap."""
+    from sparknet_tpu.parallel import ParallelTrainer, ShardedTrainer
+
+    for sub, cls in (("replica", ParallelTrainer),
+                     ("logical", ShardedTrainer)):
+        d = tmp_path / f"ck_{sub}"
+        want = _tp2_trainer_checkpoint(cls, d, step=2)
+        m = ModelManager(net, checkpoint_dir=str(d), poll_interval_s=0.0)
+        assert m.load_initial() == 2, sub
+        assert m.swap_failures == 0, sub
+        for lname, lp in want.items():
+            for pname, w in lp.items():
+                got = np.asarray(net.params[lname][pname])
+                assert got.shape == w.shape, (sub, lname, pname)
+                assert np.array_equal(got, w), (sub, lname, pname)
+        # and the served net actually answers from the TP weights
+        out = net.forward(zeros_batch(net, 4), blob_names=["prob"])
+        assert np.all(np.isfinite(np.asarray(out["prob"])))
 
 
 @pytest.mark.chaos
