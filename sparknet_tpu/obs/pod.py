@@ -301,6 +301,11 @@ class WorkerView:
     #: elastic membership epoch the worker's loop last reported (None
     #: when the run is not elastic / pre-elastic heartbeat schema)
     membership_epoch: Optional[int] = None
+    #: serve role: per-model vitals rows ({model: {step, queue_depth,
+    #: p99_ms, ...}} — InferenceServer.model_row schema) from the
+    #: worker's /status or heartbeat, so multi-model straggler
+    #: attribution works per model, not just per process
+    models: Optional[Dict[str, Any]] = None
     #: parsed /metrics families (http mode only; file mode has heartbeats)
     metrics: Optional[Dict[str, Family]] = field(default=None, repr=False)
 
@@ -311,6 +316,8 @@ class WorkerView:
             "straggler")}
         if self.membership_epoch is not None:
             d["membership_epoch"] = self.membership_epoch
+        if self.models is not None:
+            d["models"] = self.models
         if self.error:
             d["error"] = self.error
         return d
@@ -451,6 +458,8 @@ class PodAggregator:
             v.error = f"stale ({v.staleness_s:.0f}s since last flush)"
         v.role = st.get("role", "train")
         v.round = st.get("round", st.get("model_step"))
+        if isinstance(st.get("models"), dict):
+            v.models = st["models"]
         v.status = st.get("status")
         v.loss = st.get("loss")
         v.round_s = st.get("round_s")
@@ -482,6 +491,8 @@ class PodAggregator:
         v.rollbacks = int(hb.get("rollbacks") or 0)
         if hb.get("membership_epoch") is not None:
             v.membership_epoch = int(hb["membership_epoch"])
+        if isinstance(hb.get("models"), dict):
+            v.models = hb["models"]
         # dead-vs-slow through the SHARED rule (utils.health.
         # liveness_classify — the one the elastic controller evicts on):
         # slow is a straggler verdict, never a liveness one
@@ -672,6 +683,19 @@ def format_pod_table(status: Dict[str, Any]) -> str:
             f"{_n(w['round_s'], 1e3):>10}"
             f"{_n(w['data_wait_s'], 1e3):>9}"
             f"{_n(w['staleness_s']):>9}  {' '.join(flags)}".rstrip())
+        # serve role, multi-model: one sub-row per model so straggler
+        # attribution reads per model, not just per process
+        for name in sorted(w.get("models") or ()):
+            m = w["models"][name] or {}
+            parts = [f"model={name}"]
+            for k, fmt in (("step", "step={}"), ("queue_depth", "q={}"),
+                           ("p99_ms", "p99={}ms"),
+                           ("requests_ok", "ok={}"),
+                           ("requests_shed", "shed={}"),
+                           ("swaps", "swaps={}")):
+                if m.get(k) is not None:
+                    parts.append(fmt.format(m[k]))
+            lines.append(f"    └ {' '.join(parts)}")
     log = status.get("straggler_log") or []
     if log:
         lines.append("  straggler audit trail (last "

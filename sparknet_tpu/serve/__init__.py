@@ -3,25 +3,45 @@
 The training side of this framework ends where SparkNet's did: a
 checkpoint. This package is the serving side — the Clipper-style
 (Crankshaw et al., NSDI 2017) adaptive-batching layer that turns those
-checkpoints into a servable artifact:
+checkpoints into a servable artifact, plus the network data plane that
+makes it reachable:
 
   - `DynamicBatcher` (batcher.py): thread-safe request queue + the
-    max-batch / max-wait-deadline batching policy, futures per request.
+    max-batch / max-wait-deadline batching policy, wake-on-submit
+    (no polling quantum), deadline-aware shedding, futures per request.
   - `ModelManager` (model_manager.py): NetInterface lifecycle — initial
     load from zoo / prototxt / imported graph, checkpoint_dir watching
     (local, gs://, s3://), digest-verified hot swap between batches with
     canary + rollback.
   - `InferenceServer` (server.py): the serving loop — bucket-padded jit
-    forwards, de-padding, metrics (queue depth, batch fill, latency
-    quantiles, img/s), /healthz-style HTTP status, heartbeat.
+    forwards with pre-sized pad buffers, de-padding, metrics (queue
+    depth, batch fill, latency quantiles, img/s — all `model`-labeled),
+    /healthz-style HTTP status, heartbeat. Runs its own worker thread,
+    or as a LANE under the router's shared pool.
+  - `ModelRouter` (router.py): multi-model serving — one ModelManager +
+    forward lane per model over a shared worker pool, per-model
+    buckets/SLOs/metric labels, health-aware replica routing (drain on
+    stale heartbeat / hot-swap cooldown, zero dropped in-flight).
+  - `HttpFrontend` (http_frontend.py): the HTTP/1.1 inference endpoint —
+    keep-alive, JSON/npz decode on the accept threads, 429/503 +
+    Retry-After admission control and deadline shedding; `http_infer`
+    is the matching keep-alive client.
   - `sparknet-serve` (app.py): the console entry point.
 """
-from .batcher import DynamicBatcher, QueueFullError, ServeRequest
+from .batcher import (DeadlineExpiredError, DynamicBatcher,
+                      QueueFullError, ServeRequest)
+from .http_frontend import HttpFrontend, http_infer
 from .model_manager import ModelManager, ServeModelError
+from .router import (ModelRouter, NoReplicaError, Replica, RouterConfig,
+                     UnknownModelError, heartbeat_health)
 from .server import InferenceServer, ServeConfig, zeros_batch
 
 __all__ = [
-    "DynamicBatcher", "QueueFullError", "ServeRequest",
+    "DynamicBatcher", "QueueFullError", "DeadlineExpiredError",
+    "ServeRequest",
     "ModelManager", "ServeModelError",
     "InferenceServer", "ServeConfig", "zeros_batch",
+    "ModelRouter", "RouterConfig", "Replica", "NoReplicaError",
+    "UnknownModelError", "heartbeat_health",
+    "HttpFrontend", "http_infer",
 ]

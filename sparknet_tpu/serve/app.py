@@ -3,14 +3,21 @@
 Builds a net (zoo name, .prototxt path, or an imported serialized graph —
 the same three model sources the training apps accept), optionally loads a
 weights file, starts the dynamic-batching server with checkpoint
-hot-reload, and serves until interrupted. `--demo N` instead self-drives N
-synthetic requests through the full submit->batch->forward->depad path and
-prints the status JSON — the zero-infrastructure smoke ("does this model
-serve?") and what the tests exercise.
+hot-reload, and serves until interrupted. `--http-port` additionally opens
+the HTTP/1.1 inference data plane (`serve/http_frontend.py` wire format);
+`--models` switches to MULTI-MODEL mode — a `ModelRouter` serving several
+zoo/prototxt models over one shared worker pool, each hot-reloading its
+own checkpoint dir. `--demo N` instead self-drives N synthetic requests
+through the full submit->batch->forward->depad path and prints the status
+JSON — the zero-infrastructure smoke ("does this model serve?") and what
+the tests exercise.
 
 Examples:
     sparknet-serve --model lenet --checkpoint-dir gs://bkt/run1/ck \
-        --outputs prob --max-batch 32 --max-wait-ms 5 --status-port 8080
+        --outputs prob --max-batch 32 --max-wait-ms 5 --http-port 8000 \
+        --status-port 8080
+    sparknet-serve --models mnist=lenet,cifar=cifar10_quick \
+        --router-workers 4 --http-port 8000 --demo 16
     sparknet-serve --model net.prototxt --weights w.caffemodel \
         --crop 227 --demo 64
     sparknet-serve --graph model.pb --weights w.npz --outputs fc7 --demo 8
@@ -29,6 +36,8 @@ import numpy as np
 from ..net_api import JaxNet
 from ..utils.config import RunConfig
 from ..utils.logger import Logger, default_logger
+from .http_frontend import HttpFrontend
+from .router import ModelRouter, RouterConfig
 from .server import InferenceServer, ServeConfig, net_input_specs
 
 
@@ -55,38 +64,80 @@ def build_net(model: Optional[str], graph: Optional[str],
     return net
 
 
-def run_demo(server: InferenceServer, n: int, seed: int = 0) -> dict:
-    """Drive n synthetic requests (random pixels in the net's own input
-    schema) through the live server and return its status dict."""
+def _demo_payload(net, seed: int = 0) -> dict:
     r = np.random.default_rng(seed)
-    specs = net_input_specs(server.net)
+    specs = net_input_specs(net)
     name, (shape, dtype) = next(
         (k, v) for k, v in specs.items()
         if np.issubdtype(np.dtype(v[1]), np.floating))
-    futures = [server.submit(
-        {name: r.standard_normal(shape).astype(dtype)})
-        for _ in range(n)]
+    return {name: r.standard_normal(shape).astype(dtype)}
+
+
+def run_demo(server: InferenceServer, n: int, seed: int = 0) -> dict:
+    """Drive n synthetic requests (random pixels in the net's own input
+    schema) through the live server and return its status dict."""
+    futures = [server.submit(_demo_payload(server.net, seed + i))
+               for i in range(n)]
     for f in futures:
         f.result(timeout=60.0)
     return server.status()
+
+
+def run_router_demo(router: ModelRouter, n: int, seed: int = 0) -> dict:
+    """The multi-model smoke: n synthetic requests round-robined across
+    every local lane, then the router status."""
+    names = sorted(router.lanes)
+    futures = [router.submit(
+        names[i % len(names)],
+        _demo_payload(router.lanes[names[i % len(names)]].net, seed + i))
+        for i in range(n)]
+    for f in futures:
+        f.result(timeout=60.0)
+    return router.status()
+
+
+def parse_models_arg(spec: str):
+    """--models 'name=zoo_or_prototxt[,name=...]' -> [(name, source)]."""
+    out = []
+    for part in spec.split(","):
+        name, sep, src = part.partition("=")
+        if not sep or not name or not src:
+            raise SystemExit(f"--models entry {part!r} is not "
+                             f"name=model_source")
+        out.append((name.strip(), src.strip()))
+    return out
 
 
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model", default="lenet",
                    help="zoo builder name or .prototxt path")
+    p.add_argument("--model-name", default="default",
+                   help="serving name for --model (metric label + "
+                   "/v1/models/<name>/infer route)")
+    p.add_argument("--models", default=None, metavar="N=SRC[,N=SRC...]",
+                   help="multi-model mode: comma-separated name=source "
+                   "pairs served by one ModelRouter over a shared pool "
+                   "(sources are zoo names / .prototxt paths)")
+    p.add_argument("--router-workers", type=int, default=2,
+                   help="shared pool threads in --models mode")
     p.add_argument("--graph", help="serialized graph (.pb/.json) instead "
                    "of --model")
     p.add_argument("--weights", help="initial weights (.npz/.caffemodel)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="watch this train-checkpoint dir (local or "
-                   "gs://|s3://) and hot-swap verified new steps")
+                   "gs://|s3://) and hot-swap verified new steps. In "
+                   "--models mode: a template with {model} substituted, "
+                   "e.g. gs://bkt/runs/{model}/ck")
     p.add_argument("--poll-interval", type=float, default=2.0,
                    help="seconds between checkpoint-dir polls")
     p.add_argument("--n-classes", type=int, default=10)
     p.add_argument("--crop", type=int, default=None)
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--max-wait-ms", type=float, default=5.0)
+    p.add_argument("--slo-p99-ms", type=float, default=None,
+                   help="advisory per-model p99 objective (stamped into "
+                   "/status and BENCH_SERVE rows)")
     p.add_argument("--buckets", default=None,
                    help="comma-separated batch buckets (default: powers "
                    "of 2 up to max-batch)")
@@ -95,6 +146,13 @@ def main(argv=None) -> None:
                    "(default: the net's output schema)")
     p.add_argument("--no-canary", action="store_true",
                    help="skip the nonfinite canary forward on hot swaps")
+    p.add_argument("--http-port", type=int, default=None,
+                   help="serve the HTTP/1.1 inference data plane "
+                   "(/v1/infer, /v1/models/<m>/infer) on this port "
+                   "(0 = ephemeral)")
+    p.add_argument("--http-host", default="127.0.0.1",
+                   help='bind host for --http-port ("0.0.0.0" for '
+                   "cross-host clients)")
     p.add_argument("--status-port", type=int, default=None,
                    help="serve /healthz and /metrics on this port "
                    "(0 = ephemeral)")
@@ -112,34 +170,79 @@ def main(argv=None) -> None:
     args = p.parse_args(argv)
 
     log = default_logger(args.workdir, name="serving")
-    net = build_net(args.model, args.graph, args.weights, args.max_batch,
-                    args.n_classes, args.crop)
-    cfg = ServeConfig(
-        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
-        buckets=(tuple(int(b) for b in args.buckets.split(","))
-                 if args.buckets else None),
-        outputs=(tuple(args.outputs.split(",")) if args.outputs else None),
-        checkpoint_dir=args.checkpoint_dir,
-        poll_interval_s=args.poll_interval,
-        canary=not args.no_canary, status_port=args.status_port,
-        heartbeat_path=args.heartbeat)
-    server = InferenceServer(net, cfg, logger=log)
+    buckets = (tuple(int(b) for b in args.buckets.split(","))
+               if args.buckets else None)
+    outputs = tuple(args.outputs.split(",")) if args.outputs else None
+
+    def lane_cfg(name: str, checkpoint_dir: Optional[str]) -> ServeConfig:
+        return ServeConfig(
+            model_name=name, max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms, buckets=buckets,
+            slo_p99_ms=args.slo_p99_ms, outputs=outputs,
+            checkpoint_dir=checkpoint_dir,
+            poll_interval_s=args.poll_interval,
+            canary=not args.no_canary)
+
     from ..obs import trace as obs_trace
 
     with obs_trace.tracing(args.trace_out) if args.trace_out \
             else contextlib.nullcontext():
+        if args.models:
+            router = ModelRouter(
+                RouterConfig(workers=args.router_workers,
+                             status_port=args.status_port,
+                             heartbeat_path=args.heartbeat), logger=log)
+            for name, src in parse_models_arg(args.models):
+                ck = (args.checkpoint_dir.format(model=name)
+                      if args.checkpoint_dir else None)
+                router.add_model(
+                    name,
+                    build_net(src, None, None, args.max_batch,
+                              args.n_classes, args.crop),
+                    cfg=lane_cfg(name, ck))
+            with router:
+                frontend = (HttpFrontend(router, args.http_port,
+                                         args.http_host, logger=log)
+                            if args.http_port is not None else None)
+                try:
+                    _serve_until_done(router.status, args, log,
+                                      run_fn=lambda:
+                                      run_router_demo(router, args.demo))
+                finally:
+                    if frontend is not None:
+                        frontend.stop()
+            return
+
+        net = build_net(args.model, args.graph, args.weights,
+                        args.max_batch, args.n_classes, args.crop)
+        cfg = lane_cfg(args.model_name, args.checkpoint_dir)
+        cfg.status_port = args.status_port
+        cfg.heartbeat_path = args.heartbeat
+        server = InferenceServer(net, cfg, logger=log)
         with server:
-            if args.demo is not None:
-                status = run_demo(server, args.demo)
-                print(json.dumps(status))
-                return
-            log.log("serving; Ctrl-C to stop")
+            frontend = (HttpFrontend(server, args.http_port,
+                                     args.http_host, logger=log)
+                        if args.http_port is not None else None)
             try:
-                while True:
-                    time.sleep(3600)
-            except KeyboardInterrupt:
-                log.log("interrupted; draining")
-                print(json.dumps(server.status()), file=sys.stderr)
+                _serve_until_done(server.status, args, log,
+                                  run_fn=lambda:
+                                  run_demo(server, args.demo))
+            finally:
+                if frontend is not None:
+                    frontend.stop()
+
+
+def _serve_until_done(status_fn, args, log: Logger, run_fn) -> None:
+    if args.demo is not None:
+        print(json.dumps(run_fn()))
+        return
+    log.log("serving; Ctrl-C to stop")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        log.log("interrupted; draining")
+        print(json.dumps(status_fn()), file=sys.stderr)
 
 
 if __name__ == "__main__":

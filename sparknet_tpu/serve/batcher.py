@@ -9,8 +9,28 @@ deadline plus one forward (latency mode). The deadline is keyed on the
 oldest request, not the newest: a steady trickle cannot starve the head
 of the queue by perpetually resetting the timer.
 
-One consumer (the server's worker thread) calls `next_batch`; any number
-of producer threads call `submit` and block on the returned
+The consumer is WOKEN ON SUBMIT: `next_batch` parks on a condition
+variable with no polling quantum — an idle worker sleeps until the next
+`submit` notifies it (or until `wake_at`, the caller's periodic-duty
+alarm for hot-reload polls and heartbeats). The old `poll_s` idle tick
+put up to one poll interval of pure quantization into a lone request's
+latency; now a lone request's latency is bounded by `max_wait_s` plus
+one forward, full stop (pinned in tests).
+
+Requests may carry a client DEADLINE (`submit(deadline_s=...)`). Batch
+formation is deadline-aware twice over: the batch closes early when a
+queued request's deadline would expire before the oldest-request timer
+(serve it while the answer still matters), and a request whose deadline
+has ALREADY expired is shed at formation — its future fails with
+`DeadlineExpiredError` and it never pads into a bucket, so dead requests
+never occupy forward slots (Orca's lesson: schedule the queue into the
+accelerator's batch shape, and the batch shape is too precious for
+corpses). Shed demand is counted per reason on
+`sparknet_serve_shed_total{model,reason}`.
+
+One consumer (the server's worker thread, or one router pool thread at a
+time under the lane lock) calls `next_batch`; any number of producer
+threads call `submit` and block on the returned
 `concurrent.futures.Future`. Padding to shape buckets is the SERVER's
 concern — the batcher only promises len(batch) <= max_batch, so a batch
 never spans buckets.
@@ -23,7 +43,7 @@ import time
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -31,92 +51,199 @@ import numpy as np
 class QueueFullError(RuntimeError):
     """Backpressure signal: the request queue is at capacity. Callers
     (an RPC frontend, a bench client) should shed or retry — unbounded
-    queueing would just convert overload into unbounded latency."""
+    queueing would just convert overload into unbounded latency. The
+    HTTP frontend maps this to 429 + Retry-After."""
+
+
+class DeadlineExpiredError(RuntimeError):
+    """The request's client deadline passed before a forward could run;
+    it was shed instead of padded into a bucket. The HTTP frontend maps
+    this to 503 + Retry-After (the answer would have been dead on
+    arrival — better an immediate, honest shed than a late response)."""
 
 
 @dataclass
 class ServeRequest:
     """One queued inference request: per-example input arrays (no batch
-    dim), the future its response lands on, and its enqueue time (the
-    latency clock starts at submit, not at batch formation)."""
+    dim), the future its response lands on, its enqueue time (the
+    latency clock starts at submit, not at batch formation), and an
+    optional absolute client deadline on the same perf_counter clock."""
 
     payload: Dict[str, np.ndarray]
     future: Future = field(default_factory=Future)
     t_enqueue: float = field(default_factory=time.perf_counter)
     id: int = 0
+    deadline: Optional[float] = None
 
 
 class DynamicBatcher:
-    """Thread-safe queue + max-batch/max-wait batch former (one consumer)."""
+    """Thread-safe queue + max-batch/max-wait batch former (one consumer).
+
+    `model` labels every metric family this batcher registers (the
+    multi-model router shares ONE registry across lanes — per-model
+    labels are what keep the lanes' demand distinguishable). `on_submit`
+    is an optional callback fired after each accepted enqueue, OUTSIDE
+    the queue lock — the router's pool scheduler hangs its wake-up on
+    it."""
 
     def __init__(self, max_batch: int = 8, max_wait_s: float = 0.005,
-                 max_queue: int = 1024, registry=None):
+                 max_queue: int = 1024, registry=None,
+                 model: str = "default",
+                 on_submit: Optional[Callable[[], None]] = None):
         assert max_batch >= 1 and max_queue >= max_batch
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_s)
         self.max_queue = int(max_queue)
+        self.model = str(model)
+        self.on_submit = on_submit
         self._q: deque = deque()
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
         self._ids = itertools.count()
         self._closed = False
+        self.shed = 0  # lifetime shed count (all reasons)
         # shared-schema telemetry (obs.MetricsRegistry): accepted vs shed
         # demand, and the live queue depth as a scrape-time gauge
-        self._c_submitted = self._c_rejected = None
+        self._c_submitted = self._c_rejected = self._c_shed = None
         if registry is not None:
             self._c_submitted = registry.counter(
-                "sparknet_serve_submitted_total", "requests accepted")
+                "sparknet_serve_submitted_total", "requests accepted",
+                labels=("model",))
             self._c_rejected = registry.counter(
                 "sparknet_serve_queue_rejected_total",
-                "requests shed by backpressure (queue at capacity)")
+                "requests shed by backpressure (queue at capacity)",
+                labels=("model",))
+            self._c_shed = registry.counter(
+                "sparknet_serve_shed_total",
+                "requests shed before a forward, by reason (deadline = "
+                "client deadline expired before batch formation)",
+                labels=("model", "reason"))
             registry.gauge(
                 "sparknet_serve_queue_depth",
-                "requests queued, not yet formed into a batch"
-            ).set_fn(self.depth)
+                "requests queued, not yet formed into a batch",
+                labels=("model",)
+            ).set_fn(self.depth, model=self.model)
 
     def depth(self) -> int:
         return len(self._q)  # len(deque) is atomic; hot path, no lock
 
-    def submit(self, payload: Dict[str, Any]) -> Future:
+    def submit(self, payload: Dict[str, Any],
+               deadline_s: Optional[float] = None) -> Future:
         """Enqueue one request; returns its response future. Raises
-        QueueFullError at capacity and RuntimeError after close()."""
+        QueueFullError at capacity and RuntimeError after close().
+        `deadline_s` (relative seconds) is the client's answer-by bound:
+        a request that cannot be formed into a batch before it expires
+        is shed with DeadlineExpiredError instead of riding a bucket
+        slot. An ALREADY-expired deadline returns a pre-failed future
+        without touching the queue."""
         req = ServeRequest(payload={k: np.asarray(v)
                                     for k, v in payload.items()})
+        if deadline_s is not None:
+            req.deadline = req.t_enqueue + float(deadline_s)
+            if deadline_s <= 0:
+                self._shed([req], "deadline")
+                return req.future
         with self._nonempty:
             if self._closed:
                 raise RuntimeError("batcher is closed")
             if len(self._q) >= self.max_queue:
                 if self._c_rejected is not None:
-                    self._c_rejected.inc()
+                    self._c_rejected.inc(model=self.model)
                 raise QueueFullError(
                     f"request queue at capacity ({self.max_queue})")
             req.id = next(self._ids)
             self._q.append(req)
             self._nonempty.notify()
         if self._c_submitted is not None:
-            self._c_submitted.inc()
+            self._c_submitted.inc(model=self.model)
+        if self.on_submit is not None:
+            self.on_submit()
         return req.future
 
-    def next_batch(self, poll_s: float = 0.05
+    def _pop_expired_locked(self, now: float) -> List[ServeRequest]:
+        """Remove every queued request whose deadline has passed (caller
+        holds the lock; futures are resolved OUTSIDE it)."""
+        if not any(r.deadline is not None and r.deadline <= now
+                   for r in self._q):
+            return []
+        keep, dead = [], []
+        for r in self._q:
+            (dead if r.deadline is not None and r.deadline <= now
+             else keep).append(r)
+        self._q.clear()
+        self._q.extend(keep)
+        return dead
+
+    def _shed(self, reqs: List[ServeRequest], reason: str) -> None:
+        """Fail shed requests' futures + count them. Callers hold no
+        lock (set_exception may run waiter callbacks); the counter add
+        takes the queue lock once — submit() sheds pre-expired requests
+        on N producer threads concurrently with the consumer's
+        formation sheds, and a bare += would lose counts."""
+        if not reqs:
+            return
+        for r in reqs:
+            if not r.future.done():
+                waited = time.perf_counter() - r.t_enqueue
+                r.future.set_exception(DeadlineExpiredError(
+                    f"deadline expired before batch formation "
+                    f"(waited {waited * 1e3:.1f} ms)"))
+        with self._lock:
+            self.shed += len(reqs)
+        if self._c_shed is not None:
+            self._c_shed.inc(len(reqs), model=self.model, reason=reason)
+
+    def next_batch(self, wake_at: Optional[float] = None,
+                   poll_s: Optional[float] = None
                    ) -> Optional[List[ServeRequest]]:
-        """Form the next batch. Blocks up to `poll_s` for the FIRST
-        request (returning None on an idle tick — the server uses these
-        ticks for hot-reload polls and heartbeats), then holds the batch
-        open until max_batch is reached or the oldest request's deadline
-        (t_enqueue + max_wait_s) expires. Returns None after close()."""
+        """Form the next batch. Parks on the condition variable until a
+        submit arrives (wake-on-submit — no polling quantum); `wake_at`
+        (absolute perf_counter time) is the caller's periodic-duty alarm:
+        with an empty queue the call returns None at `wake_at` so the
+        worker can run hot-reload polls and heartbeats, then park again.
+        `wake_at=None` blocks until work or close(). `poll_s` is the
+        legacy relative form of the same alarm.
+
+        Once a first request exists, the batch is held open until
+        max_batch is reached, the OLDEST request's deadline
+        (t_enqueue + max_wait_s) expires, or a queued request's CLIENT
+        deadline would expire (close early and serve it while the answer
+        matters). Requests whose client deadline already passed are shed
+        here — before padding — and never returned. Returns None after
+        close()."""
+        if poll_s is not None and wake_at is None:
+            wake_at = time.perf_counter() + float(poll_s)
+        shed: List[ServeRequest] = []
+        batch: List[ServeRequest] = []
         with self._nonempty:
-            if not self._q:
-                self._nonempty.wait(timeout=poll_s)
-                if not self._q:
-                    return None
-            deadline = self._q[0].t_enqueue + self.max_wait_s
-            while len(self._q) < self.max_batch and not self._closed:
-                remaining = deadline - time.perf_counter()
-                if remaining <= 0:
+            while not self._q and not self._closed:
+                now = time.perf_counter()
+                if wake_at is not None and now >= wake_at:
                     break
-                self._nonempty.wait(timeout=remaining)
-            n = min(len(self._q), self.max_batch)
-            return [self._q.popleft() for _ in range(n)]
+                self._nonempty.wait(
+                    timeout=None if wake_at is None else wake_at - now)
+            if self._q:
+                close_at = self._q[0].t_enqueue + self.max_wait_s
+                while len(self._q) < self.max_batch and not self._closed:
+                    now = time.perf_counter()
+                    # deadline-aware close: only the first max_batch
+                    # requests can make THIS batch, so only their client
+                    # deadlines may close it early — a hair EARLY
+                    # (1 ms), so the request is served on the near side
+                    # of its deadline instead of shed exactly at it
+                    eff = min([close_at] + [
+                        r.deadline - 1e-3 for r in
+                        itertools.islice(self._q, self.max_batch)
+                        if r.deadline is not None])
+                    if eff - now <= 0:
+                        break
+                    self._nonempty.wait(timeout=eff - now)
+                # shed the dead BEFORE they pad into a bucket
+                shed = self._pop_expired_locked(time.perf_counter())
+                n = min(len(self._q), self.max_batch)
+                batch = [self._q.popleft() for _ in range(n)]
+        self._shed(shed, "deadline")
+        return batch or None
 
     def close(self) -> None:
         """Stop accepting requests and fail everything still queued (the

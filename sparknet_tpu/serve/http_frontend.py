@@ -1,0 +1,452 @@
+"""The HTTP/1.1 inference data plane: the network front door for
+`sparknet_tpu.serve`.
+
+Before this module requests entered through in-process
+`InferenceServer.submit` and the HTTP layer was status-only; this is the
+open-loop-measurable path — persistent connections, wire decode on the
+accept threads, admission control, deadline-aware shedding.
+
+Wire protocol (all under `/v1`):
+
+  POST /v1/models/<name>/infer      one inference request for <name>
+  POST /v1/infer                    same, for the sole/default model
+    Content-Type: application/json
+      {"inputs": {"<input>": <nested lists>}, "deadline_ms": <float?>}
+    Content-Type: application/x-npz
+      body = np.savez archive of per-example input arrays (exact-dtype
+      path; deadline via the X-Deadline-Ms header)
+    -> 200, JSON {"model":..., "step":..., "outputs": {...lists...}}
+       (or an npz archive of output arrays when the request was npz or
+       `Accept: application/x-npz`)
+  GET /v1/models                    {"models": {name: vitals-row}}
+  GET /healthz                      liveness (200/503)
+
+Error codes (every shed is ANSWERED — a client never hangs):
+  400  undecodable body / not a net input / wrong shape
+  404  unknown model or route
+  413  body over the size cap
+  429  queue at capacity (QueueFullError backpressure) + Retry-After
+  503  request shed: client deadline expired before a forward
+       (DeadlineExpiredError), no routable replica (NoReplicaError), or
+       response-wait timeout — all + Retry-After
+  500  anything else (the error text rides the JSON body)
+
+Design rules carried from the serving core:
+  - DECODE ON THE ACCEPT THREADS: JSON/npz parse and dtype coercion run
+    on the per-connection handler thread (ThreadingHTTPServer), never on
+    the forward worker — the worker's time is bucket forwards only.
+  - KEEP-ALIVE: HTTP/1.1 + Content-Length on every response keeps
+    connections persistent; the connection/request counters let tests
+    assert reuse (10k rps is unreachable through per-request TCP+TLS
+    handshakes).
+  - ADMISSION CONTROL: QueueFullError maps to 429 with Retry-After;
+    expired deadlines are rejected at the door (never enqueued) and shed
+    from the queue by the batcher before they pad into a bucket.
+
+`http_infer` at the bottom is the matching client (thread-cached
+keep-alive connections, npz wire format) — the router's remote-replica
+proxy and the bench's open-loop drivers both ride it.
+"""
+from __future__ import annotations
+
+import http.client
+import io
+import json
+import socket
+import threading
+import time
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import urlsplit
+
+import numpy as np
+
+from ..utils.logger import Logger
+from .batcher import DeadlineExpiredError, QueueFullError
+from .router import ModelRouter, NoReplicaError, UnknownModelError
+from .server import InferenceServer, net_input_specs
+
+NPZ_CONTENT_TYPE = "application/x-npz"
+
+
+def _encode_npz(arrays: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+    return buf.getvalue()
+
+
+def _decode_npz(body: bytes) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(body), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+class HttpFrontend:
+    """HTTP/1.1 inference endpoint over an InferenceServer or a
+    ModelRouter (the `backend`). Port 0 binds ephemeral; the bound
+    address is `.address`."""
+
+    def __init__(self, backend, port: int = 0, host: str = "127.0.0.1",
+                 default_deadline_s: Optional[float] = None,
+                 retry_after_s: float = 1.0,
+                 max_body_bytes: int = 64 << 20,
+                 logger: Optional[Logger] = None):
+        self.backend = backend
+        self.is_router = isinstance(backend, ModelRouter) or \
+            hasattr(backend, "lanes")
+        self.default_deadline_s = default_deadline_s
+        self.retry_after_s = float(retry_after_s)
+        self.max_body_bytes = int(max_body_bytes)
+        self.log = logger
+        self.registry = backend.registry
+        self._c_http = self.registry.counter(
+            "sparknet_serve_http_requests_total",
+            "HTTP data-plane requests by status code", labels=("code",))
+        self._c_conns = self.registry.counter(
+            "sparknet_serve_http_connections_total",
+            "HTTP connections accepted (requests/connections >> 1 means "
+            "keep-alive reuse is working)")
+        self.connections = 0
+        self.requests = 0
+        # per-model input dtype coercion table (JSON floats arrive as
+        # float64; coerce on the ACCEPT thread so the worker never pays)
+        self._specs: Dict[str, Dict[str, np.dtype]] = {}
+        for name, lane in self._lanes().items():
+            self._specs[name] = {
+                k: np.dtype(dt)
+                for k, (_, dt) in net_input_specs(lane.net).items()}
+        owner = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def setup(self):  # one Handler instance == one connection
+                super().setup()
+                owner.connections += 1
+                owner._c_conns.inc()
+
+            def do_POST(self):  # noqa: N802 (stdlib casing)
+                owner._handle_post(self)
+
+            def do_GET(self):  # noqa: N802
+                owner._handle_get(self)
+
+            def log_message(self, *a):  # data plane: no per-request logs
+                pass
+
+        self._http = ThreadingHTTPServer((host, port), Handler)
+        self._http.daemon_threads = True
+        self._thread = threading.Thread(target=self._http.serve_forever,
+                                        name="serve-http", daemon=True)
+        self._thread.start()
+        if logger is not None:
+            logger.log(f"serve: HTTP data plane at "
+                       f"http://{self.address[0]}:{self.address[1]}/v1")
+
+    # -- backend normalization ----------------------------------------------
+
+    def _lanes(self) -> Dict[str, InferenceServer]:
+        if self.is_router:
+            return self.backend.lanes
+        return {self.backend.model_name: self.backend}
+
+    def _model_names(self) -> Tuple[str, ...]:
+        if self.is_router:
+            return tuple(sorted(set(self.backend.lanes)
+                                | set(self.backend.replicas)))
+        return (self.backend.model_name,)
+
+    def _submit(self, model: Optional[str],
+                payload: Dict[str, np.ndarray],
+                deadline_s: Optional[float]):
+        names = self._model_names()
+        if model is None:
+            if len(names) != 1:
+                raise UnknownModelError(
+                    f"/v1/infer is ambiguous: this endpoint serves "
+                    f"{list(names)}; POST /v1/models/<name>/infer")
+            model = names[0]
+        if self.is_router:
+            return model, self.backend.submit(model, payload,
+                                              deadline_s=deadline_s)
+        if model != self.backend.model_name:
+            raise UnknownModelError(model)
+        return model, self.backend.submit(payload, deadline_s=deadline_s)
+
+    def _step(self, model: str) -> Optional[int]:
+        lane = self._lanes().get(model)
+        return None if lane is None else lane.manager.step
+
+    # -- request handling (accept threads) -----------------------------------
+
+    def _handle_post(self, h: BaseHTTPRequestHandler) -> None:
+        self.requests += 1
+        t0 = time.perf_counter()
+        try:
+            model = self._route_model(h.path)
+            if model is NOT_AN_INFER_ROUTE:
+                self._reply(h, 404, {"error": f"no route {h.path!r}",
+                                     "error_kind": "not_found"})
+                return
+            try:
+                length = int(h.headers.get("Content-Length") or -1)
+            except ValueError:
+                length = -1
+            if length < 0:
+                # no (or unparsable) Content-Length: any body the client
+                # sent (e.g. chunked) is still in the socket and would
+                # desync the keep-alive stream — close this connection
+                self._reply(h, 411, {"error": "Content-Length required",
+                                     "error_kind": "bad_request"},
+                            close=True)
+                return
+            if length > self.max_body_bytes:
+                # the body must still be drained for keep-alive to
+                # survive; over the cap we close instead
+                self._reply(h, 413, {"error": "body too large",
+                                     "error_kind": "bad_request"},
+                            close=True)
+                return
+            body = h.rfile.read(length)
+            ctype = (h.headers.get("Content-Type") or "").split(";")[0]
+            want_npz = ctype == NPZ_CONTENT_TYPE or \
+                NPZ_CONTENT_TYPE in (h.headers.get("Accept") or "")
+            payload, deadline_ms = self._decode(model, body, ctype, h)
+            deadline_s = (deadline_ms / 1e3 if deadline_ms is not None
+                          else self.default_deadline_s)
+            model, fut = self._submit(model, payload, deadline_s)
+            # shed-not-hang: the batcher fails the future at the deadline
+            # (DeadlineExpiredError); without one we still bound the wait
+            wait_s = deadline_s + 5.0 if deadline_s is not None else 30.0
+            out = fut.result(timeout=wait_s)
+            if want_npz:
+                step = self._step(model)
+                self._reply_bytes(h, 200, _encode_npz(out),
+                                  NPZ_CONTENT_TYPE,
+                                  extra={"X-Model": model,
+                                         "X-Model-Step":
+                                         str(-1 if step is None
+                                             else step)})
+            else:
+                self._reply(h, 200, {
+                    "model": model, "step": self._step(model),
+                    "latency_ms": round(
+                        (time.perf_counter() - t0) * 1e3, 3),
+                    "outputs": {k: np.asarray(v).tolist()
+                                for k, v in out.items()}})
+        except UnknownModelError as e:
+            self._reply(h, 404, {"error": str(e),
+                                 "error_kind": "unknown_model"})
+        except QueueFullError as e:
+            self._reply(h, 429, {"error": str(e),
+                                 "error_kind": "queue_full"},
+                        retry_after=True)
+        except DeadlineExpiredError as e:
+            self._reply(h, 503, {"error": str(e),
+                                 "error_kind": "deadline"},
+                        retry_after=True)
+        except NoReplicaError as e:
+            self._reply(h, 503, {"error": str(e),
+                                 "error_kind": "no_replica"},
+                        retry_after=True)
+        except FutureTimeoutError:
+            self._reply(h, 503, {"error": "response wait timed out",
+                                 "error_kind": "timeout"},
+                        retry_after=True)
+        except (ValueError, KeyError, TypeError) as e:
+            self._reply(h, 400, {"error": str(e),
+                                 "error_kind": "bad_request"})
+        except Exception as e:  # the data plane must answer, not die
+            self._reply(h, 500, {"error": f"{type(e).__name__}: {e}",
+                                 "error_kind": "internal"})
+
+    def _handle_get(self, h: BaseHTTPRequestHandler) -> None:
+        try:
+            if h.path.startswith("/v1/models"):
+                rows = {name: lane.model_row()
+                        for name, lane in self._lanes().items()}
+                for name in self._model_names():
+                    rows.setdefault(name, {"remote_only": True})
+                self._reply(h, 200, {"models": rows})
+            elif h.path.startswith("/healthz"):
+                ok = (self.backend.healthy()
+                      if hasattr(self.backend, "healthy") else True)
+                self._reply(h, 200 if ok else 503,
+                            {"status": "ok" if ok else "unhealthy"})
+            else:
+                self._reply(h, 404, {"error": f"no route {h.path!r}",
+                                     "error_kind": "not_found"})
+        except Exception as e:
+            self._reply(h, 500, {"error": str(e),
+                                 "error_kind": "internal"})
+
+    def _route_model(self, path: str):
+        """'/v1/infer' -> None (default model); '/v1/models/<m>/infer'
+        (or ':infer') -> '<m>'; anything else -> NOT_AN_INFER_ROUTE."""
+        path = urlsplit(path).path
+        if path == "/v1/infer":
+            return None
+        for sep in ("/infer", ":infer"):
+            if path.startswith("/v1/models/") and path.endswith(sep):
+                name = path[len("/v1/models/"):-len(sep)]
+                if name and "/" not in name:
+                    return name
+        return NOT_AN_INFER_ROUTE
+
+    def _decode(self, model: Optional[str], body: bytes, ctype: str,
+                h: BaseHTTPRequestHandler
+                ) -> Tuple[Dict[str, np.ndarray], Optional[float]]:
+        """Wire -> per-example arrays, ON THIS (accept) THREAD. Returns
+        (payload, deadline_ms)."""
+        hdr_deadline = h.headers.get("X-Deadline-Ms")
+        deadline_ms = float(hdr_deadline) if hdr_deadline else None
+        if ctype in (NPZ_CONTENT_TYPE, "application/octet-stream"):
+            payload = _decode_npz(body)
+        else:
+            d = json.loads(body)
+            if not isinstance(d, dict) or \
+                    not isinstance(d.get("inputs"), dict):
+                raise ValueError(
+                    'JSON body must be {"inputs": {<name>: array}, '
+                    '"deadline_ms"?: number}')
+            if d.get("deadline_ms") is not None:
+                deadline_ms = float(d["deadline_ms"])
+            payload = {str(k): np.asarray(v)
+                       for k, v in d["inputs"].items()}
+        # dtype coercion per the net's input schema (JSON numbers land
+        # float64/int64; the worker-side stack would cast anyway, but
+        # HERE the cast runs on the accept thread)
+        names = self._model_names()
+        specs = self._specs.get(
+            model if model is not None
+            else (names[0] if len(names) == 1 else ""), {})
+        for k, dt in specs.items():
+            if k in payload and payload[k].dtype != dt:
+                payload[k] = payload[k].astype(dt)
+        return payload, deadline_ms
+
+    # -- replies -------------------------------------------------------------
+
+    def _reply(self, h, code: int, obj: Dict[str, Any],
+               retry_after: bool = False, close: bool = False) -> None:
+        self._reply_bytes(h, code, json.dumps(obj).encode(),
+                          "application/json", retry_after=retry_after,
+                          close=close)
+
+    def _reply_bytes(self, h, code: int, data: bytes, ctype: str,
+                     retry_after: bool = False, close: bool = False,
+                     extra: Optional[Dict[str, str]] = None) -> None:
+        self._c_http.inc(code=str(code))
+        try:
+            h.send_response(code)
+            h.send_header("Content-Type", ctype)
+            h.send_header("Content-Length", str(len(data)))
+            if retry_after:
+                # RFC 9110 delta-seconds (integer); sub-second backpressure
+                # still says "1" — the body's error_kind carries the why
+                h.send_header("Retry-After",
+                              str(max(1, round(self.retry_after_s))))
+            if close:
+                h.send_header("Connection", "close")
+                h.close_connection = True
+            h.end_headers()
+            h.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client hung up first; nothing to answer
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._http.server_address[:2]
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+
+
+class _NotAnInferRoute:
+    pass
+
+
+NOT_AN_INFER_ROUTE = _NotAnInferRoute()
+
+
+# ---------------------------------------------------------------------------
+# the matching client
+# ---------------------------------------------------------------------------
+
+_conn_cache = threading.local()
+
+
+def _connection(host: str, port: int, timeout: float):
+    """Thread-cached keep-alive HTTPConnection (one per (host, port) per
+    thread — the open-loop bench and the router's proxy both need
+    connection reuse to mean anything)."""
+    cache = getattr(_conn_cache, "conns", None)
+    if cache is None:
+        cache = _conn_cache.conns = {}
+    key = (host, port)
+    conn = cache.get(key)
+    if conn is None:
+        conn = cache[key] = http.client.HTTPConnection(
+            host, port, timeout=timeout)
+    conn.timeout = timeout
+    return conn
+
+
+def _drop_connection(host: str, port: int) -> None:
+    cache = getattr(_conn_cache, "conns", {})
+    conn = cache.pop((host, port), None)
+    if conn is not None:
+        conn.close()
+
+
+def http_infer(base_url: str, model: str,
+               payload: Dict[str, np.ndarray],
+               deadline_s: Optional[float] = None,
+               timeout: float = 30.0) -> Dict[str, np.ndarray]:
+    """POST one inference request (npz wire format, keep-alive) and
+    return the output arrays. Maps the frontend's shed codes back to the
+    serve exceptions, so a remote replica behaves like a local lane."""
+    u = urlsplit(base_url if "//" in base_url else f"http://{base_url}")
+    host, port = u.hostname, u.port or 80
+    path = f"{u.path.rstrip('/')}/v1/models/{model}/infer"
+    headers = {"Content-Type": NPZ_CONTENT_TYPE,
+               "Accept": NPZ_CONTENT_TYPE}
+    if deadline_s is not None:
+        headers["X-Deadline-Ms"] = f"{deadline_s * 1e3:.3f}"
+    body = _encode_npz(payload)
+    for attempt in (0, 1):
+        conn = _connection(host, port, timeout)
+        try:
+            conn.request("POST", path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()  # full read keeps the connection reusable
+            break
+        except socket.timeout:
+            _drop_connection(host, port)
+            raise  # a slow server is not a stale socket: no retry
+        except (ConnectionError, http.client.HTTPException, OSError) as e:
+            # a server-closed cached connection surfaces here: retry once
+            # on a fresh socket, then give up loudly
+            _drop_connection(host, port)
+            if attempt:
+                raise ConnectionError(
+                    f"http_infer to {base_url}: {e}") from e
+    if resp.status == 200:
+        return _decode_npz(data)
+    try:
+        err = json.loads(data)
+    except Exception:
+        err = {"error": data[:200].decode("utf-8", "replace")}
+    kind, msg = err.get("error_kind"), err.get("error", "")
+    if resp.status == 429:
+        raise QueueFullError(msg)
+    if resp.status == 503 and kind == "deadline":
+        raise DeadlineExpiredError(msg)
+    if resp.status == 503:
+        raise NoReplicaError(msg or f"replica shed ({kind})")
+    if resp.status == 404:
+        raise UnknownModelError(msg or model)
+    raise RuntimeError(f"http_infer: {resp.status} {msg}")

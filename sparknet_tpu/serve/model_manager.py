@@ -120,7 +120,8 @@ class ModelManager:
                  canary_outputs: Optional[tuple] = None,
                  logger: Optional[Logger] = None,
                  heartbeat: Optional[HeartbeatWriter] = None,
-                 bad_step_retry_s: float = 30.0, registry=None):
+                 bad_step_retry_s: float = 30.0, registry=None,
+                 model: str = "default"):
         if checkpoint_dir and not hasattr(net, "params"):
             raise ServeModelError(
                 "checkpoint hot-reload needs a layer-IR JaxNet (exposes "
@@ -133,23 +134,32 @@ class ModelManager:
         self.log = logger
         self.heartbeat = heartbeat
         self.bad_step_retry_s = float(bad_step_retry_s)
+        self.model = str(model)
         self.step: Optional[int] = None   # served checkpoint step
         self.swaps = 0                    # successful hot swaps
         self.swap_failures = 0            # rejected or rolled-back swaps
         self.last_error: Optional[str] = None
+        #: monotonic time of the last REJECTED/rolled-back swap — the
+        #: router's hot-swap cooldown signal (route new load away from a
+        #: replica that just refused a checkpoint while it settles)
+        self.last_reject_t: float = 0.0
         self._next_poll = 0.0
         self._bad: Dict[int, float] = {}  # step -> retry-not-before time
         # shared-schema telemetry (obs.MetricsRegistry): swap outcomes and
-        # the step answering traffic right now
+        # the step answering traffic right now (model label: router lanes
+        # share one registry)
         self._c_swaps = None
         if registry is not None:
             self._c_swaps = registry.counter(
                 "sparknet_serve_swaps_total",
-                "weight-swap attempts by outcome", labels=("outcome",))
+                "weight-swap attempts by outcome",
+                labels=("model", "outcome"))
             registry.gauge(
                 "sparknet_serve_model_step",
-                "checkpoint step currently serving (-1 = initial weights)"
-            ).set_fn(lambda: -1 if self.step is None else self.step)
+                "checkpoint step currently serving (-1 = initial weights)",
+                labels=("model",)
+            ).set_fn(lambda: -1 if self.step is None else self.step,
+                     model=self.model)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -246,7 +256,8 @@ class ModelManager:
         if not initial:
             self.swaps += 1
         if self._c_swaps is not None:
-            self._c_swaps.inc(outcome="initial" if initial else "ok")
+            self._c_swaps.inc(model=self.model,
+                              outcome="initial" if initial else "ok")
         self.last_error = None
         self._log(f"serve: weights {'loaded' if initial else 'hot-swapped'}"
                   f" from checkpoint step {step}")
@@ -260,10 +271,18 @@ class ModelManager:
                                blob_names=list(self.canary_outputs or ()))
         return all(np.isfinite(np.asarray(v)).all() for v in out.values())
 
+    def swap_cooldown_active(self, cooldown_s: float) -> bool:
+        """True within `cooldown_s` of the last rejected/rolled-back
+        swap — the replica still answers, but a router should prefer
+        its peers while the bad-checkpoint dust settles."""
+        return (self.last_reject_t > 0.0 and
+                time.monotonic() - self.last_reject_t < cooldown_s)
+
     def _reject(self, step: int, why: str) -> None:
         self.swap_failures += 1
+        self.last_reject_t = time.monotonic()
         if self._c_swaps is not None:
-            self._c_swaps.inc(outcome="rejected")
+            self._c_swaps.inc(model=self.model, outcome="rejected")
         self.last_error = f"step {step}: {why}"
         self._bad[step] = time.monotonic() + self.bad_step_retry_s
         self._log(f"serve: REJECTED checkpoint step {step}: {why} — "

@@ -1,0 +1,475 @@
+"""Multi-model serving: one ModelManager + forward lane per model over a
+shared worker pool, with health-aware replica routing.
+
+The single-model `InferenceServer` owns one net and one worker thread.
+Serving a fleet of models that way costs one idle thread (and one idle
+accelerator context) per cold model; the router instead owns N LANES
+(each an `InferenceServer` started with `thread=False` — batcher +
+ModelManager + bucket-compiled forwards, but no thread) and K POOL
+threads that drive whichever lanes have queued work. Exactly one pool
+thread drives a lane at a time (`lane_lock`), preserving the lane's
+single-writer params contract: a hot swap still never interleaves with a
+forward. All lanes share ONE MetricsRegistry; the `model` label keeps
+their families apart, so `/metrics` is one exposition for the whole
+router and `sparknet-podview` can attribute per-model stragglers.
+
+REPLICAS: each model maps to a replica set — the local lane and/or
+remote replicas (other pod workers' HTTP frontends, discovered from the
+same /pod/status + heartbeat plumbing the pod aggregator runs on).
+Routing is round-robin over HEALTHY replicas, where healthy means: not
+draining (an operator `drain()` or a stale heartbeat — the shared
+`stale_after_s` rule), and not in hot-swap cooldown (a replica that just
+REJECTED a checkpoint gets `swap_cooldown_s` of reduced load while the
+bad-step dust settles). Draining only gates NEW routing: everything
+already queued on a replica is served to completion, so a drain drops
+zero in-flight responses (the chaos bar). When no replica is healthy the
+router degrades in order: any non-draining replica (serve stale rather
+than refuse), then `NoReplicaError` — which the HTTP frontend maps to
+503 + Retry-After, never a hang.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, replace
+from typing import Any, Callable, Dict, List, Optional
+
+from ..obs import MetricsRegistry, StatusServer, register_build_info
+from ..utils.heartbeat import HeartbeatWriter, read_heartbeat, staleness_s
+from ..utils.logger import Logger
+from .server import InferenceServer, ServeConfig
+
+
+class UnknownModelError(KeyError):
+    """Request names a model this router does not serve (HTTP 404)."""
+
+
+class NoReplicaError(RuntimeError):
+    """Every replica of the model is draining or dead (HTTP 503 +
+    Retry-After — load shedding, never a hang)."""
+
+
+def heartbeat_health(path: str, stale_after_s: float = 60.0,
+                     min_refresh_s: float = 1.0) -> Callable[[], bool]:
+    """A replica health probe over the pod heartbeat plumbing: fresh beat
+    with a non-terminal status == healthy. Reads are cached
+    `min_refresh_s` so a busy router doesn't hammer the file/bucket."""
+    state = {"t": 0.0, "ok": False}
+    lock = threading.Lock()
+
+    def probe() -> bool:
+        with lock:
+            now = time.monotonic()
+            if now - state["t"] >= min_refresh_s:
+                hb = read_heartbeat(path)
+                age = staleness_s(hb)
+                state["ok"] = bool(
+                    hb is not None and hb.get("status") != "done"
+                    and age is not None and age <= stale_after_s)
+                state["t"] = now
+            return state["ok"]
+    return probe
+
+
+class Replica:
+    """One serving copy of a model: the local lane, or a remote frontend
+    base URL. `health_fn` (remote) answers "is it alive" — typically
+    `heartbeat_health` over the replica's pod heartbeat."""
+
+    def __init__(self, name: str, lane: Optional[InferenceServer] = None,
+                 url: Optional[str] = None,
+                 health_fn: Optional[Callable[[], bool]] = None):
+        assert (lane is None) != (url is None), \
+            "a replica is exactly one of: local lane, remote url"
+        self.name = name
+        self.lane = lane
+        self.url = url.rstrip("/") if url else None
+        self.health_fn = health_fn
+        self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self) -> None:
+        """Stop routing NEW requests here; in-flight work still completes
+        (a drain must drop zero responses)."""
+        self._draining = True
+
+    def undrain(self) -> None:
+        self._draining = False
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"replica": self.name,
+                "kind": "local" if self.lane is not None else "remote",
+                "draining": self._draining,
+                **({"url": self.url} if self.url else {})}
+
+
+@dataclass
+class RouterConfig:
+    """Knobs for the multi-model router (the `sparknet-serve --models`
+    CLI mirrors these)."""
+
+    workers: int = 2                    # shared pool threads
+    # a replica that just REJECTED a checkpoint swap is deprioritized
+    # for this long (its peers absorb the load while it settles)
+    swap_cooldown_s: float = 3.0
+    # staleness rule for remote-replica heartbeats (the same threshold
+    # the pod aggregator and elastic controller use)
+    stale_after_s: float = 60.0
+    # observability (shared across all lanes)
+    status_port: Optional[int] = None   # None = no HTTP; 0 = ephemeral
+    status_host: str = "127.0.0.1"
+    heartbeat_path: Optional[str] = None
+    heartbeat_every_s: float = 10.0
+    registry: Optional[MetricsRegistry] = None
+
+
+class ModelRouter:
+    """N model lanes + replica sets over K shared worker threads."""
+
+    def __init__(self, cfg: Optional[RouterConfig] = None,
+                 logger: Optional[Logger] = None):
+        self.cfg = cfg = cfg if cfg is not None else RouterConfig()
+        assert cfg.workers >= 1
+        self.log = logger
+        self.registry = cfg.registry or MetricsRegistry()
+        register_build_info(self.registry)
+        self.lanes: Dict[str, InferenceServer] = {}
+        self.replicas: Dict[str, List[Replica]] = {}
+        self._rr: Dict[str, Any] = {}           # round-robin counters
+        self._order: List[str] = []             # lane rotation order
+        self._rot = 0
+        self._wakeup = threading.Condition()
+        self._pool: List[threading.Thread] = []
+        # remote proxying must not block router callers: a small executor
+        # carries the HTTP round-trips (bounded by pool size + margin)
+        self._proxy: Optional[ThreadPoolExecutor] = None
+        self._running = False
+        self._http = None
+        self.heartbeat = (HeartbeatWriter(cfg.heartbeat_path, role="serve",
+                                          interval_s=cfg.heartbeat_every_s,
+                                          registry=self.registry)
+                          if cfg.heartbeat_path else None)
+        self._c_routed = self.registry.counter(
+            "sparknet_serve_routed_total",
+            "requests routed, by model and chosen replica",
+            labels=("model", "replica"))
+        self._c_drains = self.registry.counter(
+            "sparknet_serve_replica_drains_total",
+            "replica drain events", labels=("model", "replica"))
+        self._g_healthy = self.registry.gauge(
+            "sparknet_serve_replica_healthy",
+            "1 = replica currently routable (not draining/stale/cooling)",
+            labels=("model", "replica"))
+
+    # -- assembly ------------------------------------------------------------
+
+    def add_model(self, name: str, net,
+                  cfg: Optional[ServeConfig] = None, preprocessor=None
+                  ) -> InferenceServer:
+        """Add a locally-served model: builds its lane (forced onto the
+        router's shared registry, named `name`) and registers it as the
+        model's first replica. Call before start()."""
+        assert name not in self.lanes, f"model {name!r} already added"
+        cfg = replace(cfg if cfg is not None else ServeConfig(),
+                      model_name=name, registry=self.registry,
+                      status_port=None, heartbeat_path=None)
+        lane = InferenceServer(net, cfg, preprocessor=preprocessor,
+                               logger=self.log)
+        lane.batcher.on_submit = self._wake
+        self.lanes[name] = lane
+        self._order.append(name)
+        self.replicas.setdefault(name, []).append(
+            Replica(f"local:{name}", lane=lane))
+        self._rr[name] = itertools.count()
+        return lane
+
+    def add_remote_replica(self, model: str, url: str,
+                           health_fn: Optional[Callable[[], bool]] = None,
+                           heartbeat_path: Optional[str] = None
+                           ) -> Replica:
+        """Register another pod worker's HTTP frontend as a replica of
+        `model`. Health comes from `health_fn`, or from `heartbeat_path`
+        through the shared staleness rule; with neither, the replica is
+        trusted until drained."""
+        if health_fn is None and heartbeat_path is not None:
+            health_fn = heartbeat_health(heartbeat_path,
+                                         self.cfg.stale_after_s)
+        rep = Replica(f"remote:{url}", url=url, health_fn=health_fn)
+        self.replicas.setdefault(model, []).append(rep)
+        self._rr.setdefault(model, itertools.count())
+        return rep
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ModelRouter":
+        assert not self._running, "already started"
+        assert self.lanes or any(self.replicas.values()), "no models"
+        self._running = True
+        for lane in self.lanes.values():
+            lane.start(thread=False)
+        self._proxy = ThreadPoolExecutor(
+            max_workers=max(4, 2 * self.cfg.workers),
+            thread_name_prefix="serve-proxy")
+        self._pool = [threading.Thread(target=self._pool_run,
+                                       name=f"serve-pool-{i}", daemon=True)
+                      for i in range(self.cfg.workers)]
+        for t in self._pool:
+            t.start()
+        if self.cfg.status_port is not None:
+            self._http = StatusServer(
+                self.cfg.status_port, self.registry,
+                host=self.cfg.status_host,
+                healthz=self._healthz, status=self.status)
+        return self
+
+    def stop(self, drain_s: float = 5.0) -> None:
+        """Drain queued work (bounded), then stop lanes and the pool."""
+        deadline = time.monotonic() + drain_s
+        while any(l.batcher.depth() for l in self.lanes.values()) and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        self._running = False
+        for lane in self.lanes.values():
+            lane._running = False
+            lane.batcher.close()
+        with self._wakeup:
+            self._wakeup.notify_all()
+        for t in self._pool:
+            t.join(timeout=max(drain_s, 1.0))
+        self._pool = []
+        if self._proxy is not None:
+            self._proxy.shutdown(wait=False)
+            self._proxy = None
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
+        if self.heartbeat is not None:
+            try:
+                self.heartbeat.beat(self._max_step(), status="done",
+                                    rollbacks=self._swap_failures(),
+                                    force=True,
+                                    models=self._model_rows())
+            except OSError:
+                pass
+
+    def __enter__(self) -> "ModelRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- routing -------------------------------------------------------------
+
+    def _replica_routable(self, rep: Replica) -> bool:
+        if rep.draining:
+            return False
+        if rep.lane is not None:
+            return rep.lane._running and not \
+                rep.lane.manager.swap_cooldown_active(
+                    self.cfg.swap_cooldown_s)
+        if rep.health_fn is not None:
+            try:
+                return bool(rep.health_fn())
+            except Exception:
+                return False  # a broken probe reads as unhealthy
+        return True
+
+    def _update_replica_gauges(self) -> None:
+        """Refresh sparknet_serve_replica_healthy for EVERY replica —
+        called from the pool's duty cadence, not per request: gauge
+        writes stay off the routing hot path, and idle models' replicas
+        still report fresh health. (Not a scrape-time set_fn: a remote
+        health probe may read a heartbeat file/bucket, which must never
+        run under the registry lock.)"""
+        for model, reps in self.replicas.items():
+            for r in reps:
+                self._g_healthy.set(
+                    1.0 if self._replica_routable(r) else 0.0,
+                    model=model, replica=r.name)
+
+    def _pick(self, model: str) -> Replica:
+        reps = self.replicas.get(model)
+        if not reps:
+            raise UnknownModelError(model)
+        healthy = [r for r in reps if self._replica_routable(r)]
+        if not healthy:
+            # degrade before refusing: a cooling-down or stale-beat
+            # replica that is NOT draining may still answer (freshness
+            # degrades, availability does not)
+            healthy = [r for r in reps if not r.draining
+                       and (r.lane is None or r.lane._running)]
+        if not healthy:
+            raise NoReplicaError(
+                f"model {model!r}: every replica is draining or down")
+        return healthy[next(self._rr[model]) % len(healthy)]
+
+    def submit(self, model: str, payload: Dict[str, Any],
+               deadline_s: Optional[float] = None) -> Future:
+        """Route one request; returns its response future. Raises
+        UnknownModelError / NoReplicaError synchronously; QueueFullError
+        propagates from the chosen local lane (backpressure is
+        per-replica — the caller may retry, which re-routes)."""
+        rep = self._pick(model)
+        self._c_routed.inc(model=model, replica=rep.name)
+        if rep.lane is not None:
+            return rep.lane.submit(payload, deadline_s=deadline_s)
+        proxy = self._proxy
+        if proxy is None or not self._running:
+            # racing stop() (or called before start): a typed shed, not
+            # an AttributeError surfacing as a 500
+            raise NoReplicaError(f"model {model!r}: router is not running")
+        fut: Future = Future()
+        proxy.submit(self._proxy_call, rep, model, payload,
+                     deadline_s, fut)
+        return fut
+
+    def infer(self, model: str, payload: Dict[str, Any],
+              timeout: float = 30.0) -> Dict[str, Any]:
+        """The timeout IS the request deadline (InferenceServer.infer
+        semantics): the wait gets a small grace past it so the shed
+        lands as its honest DeadlineExpiredError — the batcher (or a
+        remote replica's 503) resolves the future moments after expiry,
+        and a bare futures TimeoutError still bounds a wedged worker."""
+        fut = self.submit(model, payload, deadline_s=timeout)
+        return fut.result(timeout=timeout + 5.0)
+
+    def _proxy_call(self, rep: Replica, model: str,
+                    payload: Dict[str, Any],
+                    deadline_s: Optional[float], fut: Future) -> None:
+        from .http_frontend import http_infer  # import cycle guard
+        try:
+            fut.set_result(http_infer(
+                rep.url, model, payload, deadline_s=deadline_s))
+        except Exception as e:
+            fut.set_exception(e)
+
+    def drain(self, model: str, replica: str) -> Replica:
+        """Operator drain by replica name (or bare 'local:<model>' /
+        url). In-flight and already-queued work still completes."""
+        for r in self.replicas.get(model, []):
+            if r.name == replica or r.url == replica:
+                r.drain()
+                self._c_drains.inc(model=model, replica=r.name)
+                if self.log is not None:
+                    self.log.log(f"serve: draining {model}/{r.name}")
+                return r
+        raise UnknownModelError(f"{model}/{replica}")
+
+    # -- the shared worker pool ----------------------------------------------
+
+    def _wake(self) -> None:
+        with self._wakeup:
+            self._wakeup.notify_all()
+
+    def _rotation(self) -> List[str]:
+        """Lane order rotated per call: under contention every lane gets
+        first-look equally often (no fixed-priority starvation)."""
+        self._rot = (self._rot + 1) % max(len(self._order), 1)
+        return self._order[self._rot:] + self._order[:self._rot]
+
+    def _pool_run(self) -> None:
+        duty = min([l._duty_s for l in self.lanes.values()] or [1.0])
+        next_duty = 0.0
+        while self._running:
+            progressed = False
+            for name in self._rotation():
+                lane = self.lanes[name]
+                if not lane.batcher.depth():
+                    continue
+                if not lane.lane_lock.acquire(blocking=False):
+                    continue  # another pool thread is driving this lane
+                try:
+                    progressed |= bool(
+                        lane.serve_tick(wake_at=time.perf_counter()))
+                finally:
+                    lane.lane_lock.release()
+            # periodic duties run on their own TIME-GATED cadence, not
+            # only on idle sweeps: under sustained traffic to one lane
+            # the others must still hot-reload poll / tick liveness, and
+            # the router heartbeat must keep beating (a busy router that
+            # reads as dead gets drained by its peers — exactly wrong)
+            now = time.monotonic()
+            if now >= next_duty:
+                next_duty = now + duty
+                for name in self._rotation():
+                    lane = self.lanes[name]
+                    if lane.lane_lock.acquire(blocking=False):
+                        try:
+                            lane.duty_tick()
+                        finally:
+                            lane.lane_lock.release()
+                self._update_replica_gauges()
+                self._beat()
+            if progressed:
+                continue
+            # no progress this sweep: park until a submit notifies or
+            # the duty alarm. With queued work owned by ANOTHER pool
+            # thread (its lane_lock held through the batch-open park and
+            # forward), a short bounded nap paces the recheck — nothing
+            # notifies on lock release, and spinning on try-acquire
+            # would burn a core for the whole busy period
+            with self._wakeup:
+                if not self._running:
+                    break
+                busy = any(l.batcher.depth()
+                           for l in self.lanes.values())
+                self._wakeup.wait(timeout=0.002 if busy else duty)
+
+    # -- status / heartbeat --------------------------------------------------
+
+    def _max_step(self) -> int:
+        steps = [l.manager.step for l in self.lanes.values()
+                 if l.manager.step is not None]
+        return max(steps) if steps else 0
+
+    def _swap_failures(self) -> int:
+        return sum(l.manager.swap_failures for l in self.lanes.values())
+
+    def _model_rows(self) -> Dict[str, Any]:
+        return {name: lane.model_row()
+                for name, lane in self.lanes.items()}
+
+    def _beat(self) -> None:
+        if self.heartbeat is None:
+            return
+        degraded = any(l.manager.last_error for l in self.lanes.values())
+        try:
+            self.heartbeat.beat(self._max_step(),
+                                status="degraded" if degraded else "ok",
+                                rollbacks=self._swap_failures(),
+                                models=self._model_rows())
+        except OSError:
+            pass  # observability must not take serving down
+
+    def _healthz(self):
+        ok = self._running and all(l.healthy()
+                                   for l in self.lanes.values())
+        return ok, {"models": sorted(self.lanes),
+                    "queue_depth": {n: l.batcher.depth()
+                                    for n, l in self.lanes.items()}}
+
+    def healthy(self) -> bool:
+        return self._healthz()[0]
+
+    def status(self) -> Dict[str, Any]:
+        """/status JSON: per-model lane vitals + replica sets. The
+        `models` key is the same compact-row schema single-model servers
+        emit, so /pod/status renders per-model rows either way."""
+        return {
+            "role": "serve",
+            "router": True,
+            "pool_workers": self.cfg.workers,
+            "models": self._model_rows(),
+            "lanes": {n: lane.status() for n, lane in self.lanes.items()},
+            "replicas": {m: [r.as_dict() for r in reps]
+                         for m, reps in self.replicas.items()},
+        }
+
+    @property
+    def status_address(self):
+        return None if self._http is None else self._http.address

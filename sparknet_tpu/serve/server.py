@@ -13,10 +13,23 @@ Across DIFFERENT buckets XLA may re-associate reductions, so outputs are
 allclose-but-not-bitwise between e.g. the 1-bucket and 8-bucket of the
 same example — same contract training accepts for different batch shapes.
 
-One worker thread owns the net: batch forwards, weight swaps (between
-batches, via ModelManager), and the canary all run on it, so no lock
-guards the params. Request futures are resolved from the same thread;
-client threads only enqueue and wait.
+Pad/de-pad is PRE-SIZED: each bucket owns one cached host buffer per net
+input (allocated on first use, reused every batch), and request rows are
+stacked straight into it — the per-batch Python cost is one buffer fill
+per input, not an alloc-stack-alloc-pad-alloc-concat chain per request.
+Safe because `net.forward` copies host->device synchronously before
+returning, and exactly one thread drives a lane at a time (below).
+
+One worker owns the net: batch forwards, weight swaps (between batches,
+via ModelManager), and the canary all run on it, so no lock guards the
+params. In the classic single-model deployment that worker is the lane's
+own thread (`start()`); under the multi-model router the lane has NO
+thread of its own — router pool threads call `serve_tick()` one at a
+time under `lane_lock` (same single-writer guarantee, pooled across
+models). The worker parks in the batcher's wake-on-submit wait; periodic
+duties (hot-reload poll, heartbeat) run on their own cadence via the
+`wake_at` alarm, not a fixed idle poll. Request futures are resolved from
+the serving thread; client threads only enqueue and wait.
 
 Requests are dicts of PER-EXAMPLE arrays (no batch dim). Missing net
 inputs are zero-filled (nets from the zoo carry label-consuming loss/
@@ -80,11 +93,19 @@ class ServeConfig:
     """Knobs for the inference server (the `sparknet-serve` CLI mirrors
     these 1:1)."""
 
+    # identity: labels every serve metric family this lane registers
+    # (the router shares one registry across models) and names the model
+    # in /status, heartbeats, and the HTTP data plane's URL space
+    model_name: str = "default"
     # batching policy
     max_batch: int = 8
     max_wait_ms: float = 5.0            # oldest-request deadline
     buckets: Optional[Tuple[int, ...]] = None  # None -> powers of 2
     max_queue: int = 1024               # backpressure threshold
+    # per-model latency objective (ms). Advisory: stamped into /status
+    # and BENCH_SERVE rows (p99 <= slo at the sustainable rate is the
+    # open-loop acceptance); nothing enforces it at runtime.
+    slo_p99_ms: Optional[float] = None
     # response content: blob names to return (None -> the net's output
     # schema, e.g. prob/accuracy/loss for zoo nets — pass ("prob",) to
     # skip the label-dependent heads)
@@ -103,7 +124,12 @@ class ServeConfig:
     heartbeat_path: Optional[str] = None
     heartbeat_every_s: float = 10.0
     metrics_every_batches: int = 50     # JSONL cadence (0 = off)
-    idle_poll_s: float = 0.05           # worker tick when the queue is idle
+    # DEPRECATED (wake-on-submit): the worker no longer idle-polls; it
+    # parks in the batcher's condition wait and wakes on submit, with
+    # periodic duties (reload poll, heartbeat) alarmed at their own
+    # cadence. Kept so old configs still construct; only healthz's
+    # freshness bound still glances at it.
+    idle_poll_s: float = 0.05
     registry: Optional[MetricsRegistry] = None
 
 
@@ -114,6 +140,7 @@ class InferenceServer:
                  preprocessor=None, logger: Optional[Logger] = None):
         self.net = net
         self.cfg = cfg = cfg if cfg is not None else ServeConfig()
+        self.model_name = cfg.model_name
         self.preprocessor = preprocessor
         self.log = logger
         self.buckets = tuple(sorted(cfg.buckets or
@@ -122,28 +149,33 @@ class InferenceServer:
             f"largest bucket {self.buckets[-1]} < max_batch "
             f"{cfg.max_batch}: a full batch would have no bucket")
         # the shared-schema registry: every serve component registers into
-        # it and /metrics renders it (one exporter for train AND serve)
+        # it and /metrics renders it (one exporter for train AND serve);
+        # under the router ALL lanes share one registry and the `model`
+        # label keeps their families apart
         self.registry = cfg.registry or MetricsRegistry()
         register_build_info(self.registry)
         self._c_requests = self.registry.counter(
             "sparknet_serve_requests_total", "served requests by outcome",
-            labels=("outcome",))
+            labels=("model", "outcome"))
         # jit-cache churn as a first-class metric: the FIRST forward of
         # each batch bucket is the one that builds that bucket's compiled
-        # executable — count and time it. Steady state == len(buckets);
-        # growth past that means compile cliffs are back in the tail.
+        # executable — count and time it. Steady state == len(buckets)
+        # per model; growth past that means compile cliffs are back in
+        # the tail.
         self._c_bucket_compiles = self.registry.counter(
             "sparknet_serve_bucket_compiles_total",
-            "first forward per batch bucket (jit-cache entries built)")
+            "first forward per batch bucket (jit-cache entries built)",
+            labels=("model",))
         self._h_bucket_compile = self.registry.histogram(
             "sparknet_serve_bucket_compile_seconds",
             "wall time of each bucket's first (compiling) forward",
-            buckets=obs_device.COMPILE_BUCKETS)
+            labels=("model",), buckets=obs_device.COMPILE_BUCKETS)
         self._compiled_buckets: set = set()
         self.batcher = DynamicBatcher(cfg.max_batch,
                                       max_wait_s=cfg.max_wait_ms / 1e3,
                                       max_queue=cfg.max_queue,
-                                      registry=self.registry)
+                                      registry=self.registry,
+                                      model=cfg.model_name)
         hb = (HeartbeatWriter(cfg.heartbeat_path, role="serve",
                               interval_s=cfg.heartbeat_every_s,
                               registry=self.registry)
@@ -155,16 +187,35 @@ class InferenceServer:
             canary_batch=(zeros_batch(net, self.buckets[0])
                           if cfg.canary else None),
             canary_outputs=cfg.outputs, logger=logger, heartbeat=hb,
-            registry=self.registry)
+            registry=self.registry, model=cfg.model_name)
         # meters: worker-thread-written, internally locked — status() and
         # the HTTP scrape read consistent snapshots, never torn state
-        self.latency = LatencyStats(registry=self.registry)
-        self.fill = FillMeter(registry=self.registry)
+        self.latency = LatencyStats(registry=self.registry,
+                                    model=cfg.model_name)
+        self.fill = FillMeter(registry=self.registry,
+                              model=cfg.model_name)
         self.requests_ok = 0
         self.requests_failed = 0
         self.batch_log: List[Tuple[int, int]] = []  # (n_real, bucket)
         self._t0 = time.time()
         self._images = 0
+        # pre-sized pad buffers: {bucket: {input: zeros host array}} plus
+        # the set of inputs a previous batch wrote real rows into (those
+        # must be re-zeroed before a batch that doesn't carry them)
+        self._bucket_buf: Dict[int, Dict[str, np.ndarray]] = {}
+        self._bucket_dirty: Dict[int, set] = {}
+        # router integration: exactly one thread may drive serve_tick at
+        # a time (the lane's own worker, or one pool thread)
+        self.lane_lock = threading.Lock()
+        # periodic-duty cadence: the worker must surface at least this
+        # often for reload polls / heartbeats / liveness ticks even with
+        # an empty queue. Bounded by 1 s so /healthz freshness works.
+        duties = [1.0]
+        if cfg.checkpoint_dir:
+            duties.append(cfg.poll_interval_s)
+        if hb is not None:
+            duties.append(cfg.heartbeat_every_s)
+        self._duty_s = max(min(duties), 1e-3)
         self._worker: Optional[threading.Thread] = None
         self._http = None
         self._running = False
@@ -172,25 +223,42 @@ class InferenceServer:
 
     # -- client API ----------------------------------------------------------
 
-    def submit(self, payload: Dict[str, Any]):
+    def submit(self, payload: Dict[str, Any],
+               deadline_s: Optional[float] = None):
         """Enqueue one example (dict of per-example arrays); returns a
-        Future resolving to {blob name: per-example array}."""
-        return self.batcher.submit(payload)
+        Future resolving to {blob name: per-example array}. `deadline_s`
+        threads the client's answer-by bound into batch formation: an
+        expired request is shed (DeadlineExpiredError) instead of
+        occupying a bucket slot."""
+        return self.batcher.submit(payload, deadline_s=deadline_s)
 
     def infer(self, payload: Dict[str, Any], timeout: float = 30.0
               ) -> Dict[str, np.ndarray]:
-        """Synchronous convenience wrapper over submit()."""
-        return self.submit(payload).result(timeout=timeout)
+        """Synchronous convenience wrapper over submit(). The timeout IS
+        the request deadline: a request this client will have abandoned
+        is shed from the queue (DeadlineExpiredError) rather than riding
+        a bucket slot to produce an answer nobody reads. The wait itself
+        gets a small grace past the deadline so the shed lands as its
+        honest exception — worker truly wedged, a bare futures
+        TimeoutError still bounds the hang."""
+        fut = self.submit(payload, deadline_s=timeout)
+        return fut.result(timeout=timeout + 5.0)
 
     # -- lifecycle -----------------------------------------------------------
 
-    def start(self) -> "InferenceServer":
-        assert self._worker is None, "already started"
+    def start(self, thread: bool = True) -> "InferenceServer":
+        """Load initial weights and begin serving. `thread=False` skips
+        spawning the lane's own worker (router mode: the ModelRouter's
+        shared pool drives `serve_tick` instead)."""
+        assert self._worker is None and not self._running, "already started"
         self.manager.load_initial()
         self._running = True
-        self._worker = threading.Thread(target=self._run,
-                                        name="serve-worker", daemon=True)
-        self._worker.start()
+        self._last_tick = time.monotonic()
+        if thread:
+            self._worker = threading.Thread(target=self._run,
+                                            name="serve-worker",
+                                            daemon=True)
+            self._worker.start()
         if self.cfg.status_port is not None:
             self._start_http(self.cfg.status_port)
         return self
@@ -235,10 +303,12 @@ class InferenceServer:
         real, padded, batches = self.fill.snapshot()
         out = {
             "role": "serve",
+            "model": self.model_name,
             "uptime_s": round(dt, 1),
             "queue_depth": self.batcher.depth(),
             "requests_ok": self.requests_ok,
             "requests_failed": self.requests_failed,
+            "requests_shed": self.batcher.shed,
             "images_per_sec": round(self._images / dt, 2),
             "batches": batches,
             "batch_fill_ratio": round(real / padded if padded else 0.0, 4),
@@ -249,7 +319,13 @@ class InferenceServer:
             "swap_failures": m.swap_failures,
             "last_error": m.last_error,
         }
+        if self.cfg.slo_p99_ms is not None:
+            out["slo_p99_ms"] = self.cfg.slo_p99_ms
         out.update(self.latency.summary())
+        # per-model rows for the pod view (PodAggregator._collect_http
+        # lifts this into WorkerView.models; the router emits one row per
+        # lane here, a single-model server exactly one)
+        out["models"] = {self.model_name: self.model_row()}
         return out
 
     def reset_counters(self) -> None:
@@ -263,36 +339,55 @@ class InferenceServer:
         self._t0 = time.time()
 
     def healthy(self) -> bool:
-        """Liveness: the worker thread exists and ticked recently (a wedged
-        forward or a dead thread must flip /healthz to 503, not hang it)."""
-        alive = self._worker is not None and self._worker.is_alive()
+        """Liveness: the serving thread (own worker, or the router pool)
+        ticked recently (a wedged forward or a dead thread must flip
+        /healthz to 503, not hang it)."""
+        alive = (self._worker.is_alive() if self._worker is not None
+                 else self._running)
         fresh = (time.monotonic() - self._last_tick) < max(
-            10 * self.cfg.idle_poll_s, 2.0)
+            3 * self._duty_s, 10 * self.cfg.idle_poll_s, 2.0)
         return alive and fresh
 
     # -- worker loop ---------------------------------------------------------
 
     def _run(self) -> None:
         while self._running:
-            self._last_tick = time.monotonic()
-            reqs = self.batcher.next_batch(poll_s=self.cfg.idle_poll_s)
-            if reqs:
-                # a formed batch has already waited out its deadline:
-                # serve it FIRST — a multi-second checkpoint download
-                # must never sit between batch formation and its forward
-                self._serve_batch(reqs)
-            # hot-reload + heartbeat ride the gaps AFTER serving / on
-            # idle ticks: a swap never interleaves with a forward
-            # (single worker thread), and NOTHING the poll raises may
-            # kill this thread — a dead worker strands every queued
-            # future while submit() keeps accepting work
-            try:
-                self.manager.poll()
-            except Exception as e:
-                self.manager.last_error = f"poll: {e}"
-                self._log(f"serve: reload poll crashed ({e}); serving "
-                          f"continues on step {self.manager.step}")
-            self._beat()
+            with self.lane_lock:
+                self.serve_tick()
+
+    def serve_tick(self, wake_at: Optional[float] = None) -> bool:
+        """One worker iteration: park for a batch (wake-on-submit; surface
+        at `wake_at` — default: now + the duty cadence — for periodic
+        duties), serve it, then run duties. Callers other than the lane's
+        own thread MUST hold `lane_lock`. Returns True when a batch was
+        served (the router's pool uses this to distinguish progress from
+        an idle tick)."""
+        self._last_tick = time.monotonic()
+        if wake_at is None:
+            wake_at = time.perf_counter() + self._duty_s
+        reqs = self.batcher.next_batch(wake_at=wake_at)
+        if reqs:
+            # a formed batch has already waited out its deadline:
+            # serve it FIRST — a multi-second checkpoint download
+            # must never sit between batch formation and its forward
+            self._serve_batch(reqs)
+        self.duty_tick()
+        return bool(reqs)
+
+    def duty_tick(self) -> None:
+        """Hot-reload + heartbeat: ride the gaps AFTER serving / on idle
+        ticks — a swap never interleaves with a forward (single driving
+        thread per lane), and NOTHING the poll raises may kill that
+        thread: a dead worker strands every queued future while submit()
+        keeps accepting work."""
+        self._last_tick = time.monotonic()
+        try:
+            self.manager.poll()
+        except Exception as e:
+            self.manager.last_error = f"poll: {e}"
+            self._log(f"serve: reload poll crashed ({e}); serving "
+                      f"continues on step {self.manager.step}")
+        self._beat()
 
     def _beat(self) -> None:
         if self.heartbeat is None:
@@ -303,9 +398,25 @@ class InferenceServer:
                 status="degraded" if self.manager.last_error else "ok",
                 rollbacks=self.manager.swap_failures,
                 queue_depth=self.batcher.depth(),
-                batch_fill=round(self.fill.ratio(), 4))
+                batch_fill=round(self.fill.ratio(), 4),
+                models={self.model_name: self.model_row()})
         except OSError:
             pass  # observability must not take serving down
+
+    def model_row(self) -> Dict[str, Any]:
+        """The compact per-model vitals row (heartbeats, /pod/status):
+        enough for `sparknet-podview` to attribute per-model stragglers
+        without shipping the whole status dict."""
+        lat = self.latency.summary()
+        return {"step": self.manager.step,
+                "queue_depth": self.batcher.depth(),
+                "requests_ok": self.requests_ok,
+                "requests_failed": self.requests_failed,
+                "requests_shed": self.batcher.shed,
+                "p50_ms": lat["p50_ms"], "p99_ms": lat["p99_ms"],
+                "batch_fill": round(self.fill.ratio(), 4),
+                "swaps": self.manager.swaps,
+                "swap_failures": self.manager.swap_failures}
 
     def _serve_batch(self, reqs: List[ServeRequest]) -> None:
         # heterogeneous traffic: group by input signature so one
@@ -323,25 +434,55 @@ class InferenceServer:
         with obs_trace.span("forward", n=len(reqs)):
             self._forward_group_inner(reqs)
 
+    def _bucket_batch(self, reqs: List[ServeRequest], bucket: int
+                      ) -> Dict[str, np.ndarray]:
+        """Fill this bucket's cached buffers with the group's rows: one
+        pre-sized buffer per input, request rows stacked straight into
+        it, the pad tail re-zeroed. Inputs absent from the request stay
+        zero (re-zeroed only when a previous batch dirtied them)."""
+        n = len(reqs)
+        buf = self._bucket_buf.get(bucket)
+        if buf is None:
+            buf = self._bucket_buf[bucket] = zeros_batch(self.net, bucket)
+            self._bucket_dirty[bucket] = set()
+        payload = reqs[0].payload
+        if self.preprocessor is not None:
+            # batch-level decode, eval semantics: center crop + mean
+            # subtract are deterministic, so per-request and batched
+            # decode agree (the parity test's precondition)
+            payload = self.preprocessor.convert_batch(
+                {k: np.stack([r.payload[k] for r in reqs])
+                 for k in payload}, train=False)
+        dirty = self._bucket_dirty[bucket]
+        for k in dirty - set(payload):
+            buf[k][:] = 0  # stale rows from a batch that carried k
+        dirty.intersection_update(payload)
+        for k in payload:
+            dst = buf.get(k)
+            if dst is None:
+                raise ValueError(
+                    f"request field {k!r} is not a net input "
+                    f"(net has {sorted(buf)})")
+            if self.preprocessor is not None:
+                dst[:n] = payload[k]
+            else:
+                rows = [r.payload[k] for r in reqs]
+                try:
+                    np.stack(rows, out=dst[:n])
+                except TypeError:
+                    # unusual-dtype payload (e.g. int rows for a float
+                    # input): stack on the side, let assignment cast —
+                    # the slow path the old concat always paid
+                    dst[:n] = np.stack(rows)
+            dst[n:] = 0
+            dirty.add(k)
+        return buf
+
     def _forward_group_inner(self, reqs: List[ServeRequest]) -> None:
         n = len(reqs)
         bucket = next(b for b in self.buckets if b >= n)
         try:
-            batch = {k: np.stack([r.payload[k] for r in reqs])
-                     for k in reqs[0].payload}
-            if self.preprocessor is not None:
-                # batch-level decode, eval semantics: center crop + mean
-                # subtract are deterministic, so per-request and batched
-                # decode agree (the parity test's precondition)
-                batch = self.preprocessor.convert_batch(batch, train=False)
-            full = zeros_batch(self.net, bucket)
-            for k, v in batch.items():
-                if k not in full:
-                    raise ValueError(
-                        f"request field {k!r} is not a net input "
-                        f"(net has {sorted(full)})")
-                pad = np.zeros((bucket - n,) + v.shape[1:], v.dtype)
-                full[k] = np.concatenate([v, pad]) if bucket > n else v
+            full = self._bucket_batch(reqs, bucket)
             t_fwd0 = time.perf_counter()
             out = self.net.forward(
                 full, blob_names=list(self.cfg.outputs or ()))
@@ -349,8 +490,8 @@ class InferenceServer:
                 # this forward traced+compiled the bucket's executable
                 self._compiled_buckets.add(bucket)
                 dt = time.perf_counter() - t_fwd0
-                self._c_bucket_compiles.inc()
-                self._h_bucket_compile.observe(dt)
+                self._c_bucket_compiles.inc(model=self.model_name)
+                self._h_bucket_compile.observe(dt, model=self.model_name)
                 obs_device.note_compile("serve_bucket", dt)
             # de-pad: slice each request's own row out of per-row blobs;
             # batch-AGGREGATE blobs (the zoo heads' scalar loss/accuracy
@@ -369,13 +510,14 @@ class InferenceServer:
                                      for k, v, per_row in fields})
                 self.latency.add(now - r.t_enqueue)
             self.requests_ok += n
-            self._c_requests.inc(n, outcome="ok")
+            self._c_requests.inc(n, model=self.model_name, outcome="ok")
         except Exception as e:
             for r in reqs:
                 if not r.future.done():
                     r.future.set_exception(e)
             self.requests_failed += n
-            self._c_requests.inc(n, outcome="failed")
+            self._c_requests.inc(n, model=self.model_name,
+                                 outcome="failed")
             self._log(f"serve: batch of {n} failed: {e}")
         self._images += n
         self.fill.add(n, bucket)

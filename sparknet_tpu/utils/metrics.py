@@ -144,21 +144,27 @@ class LatencyStats:
 
     def __init__(self, window: int = 4096,
                  registry: Optional[MetricsRegistry] = None,
-                 name: str = "sparknet_serve_request_latency_seconds"):
+                 name: str = "sparknet_serve_request_latency_seconds",
+                 model: Optional[str] = None):
+        """`model` labels the registry histogram (serve lanes sharing one
+        registry across models); None keeps the unlabeled family — but
+        the two modes must not mix within one registry/name."""
         self._obs: deque = deque(maxlen=max(2, window))
         self._lock = threading.Lock()
         self.count = 0
         self._hist = None
+        self._labels = {} if model is None else {"model": str(model)}
         if registry is not None:
             self._hist = registry.histogram(
-                name, "request latency, submit to response")
+                name, "request latency, submit to response",
+                labels=tuple(self._labels))
 
     def add(self, seconds: float) -> None:
         with self._lock:
             self._obs.append(float(seconds))
             self.count += 1
         if self._hist is not None:
-            self._hist.observe(seconds)
+            self._hist.observe(seconds, **self._labels)
 
     def quantile(self, q: float) -> Optional[float]:
         """Exact order statistic over the window (nearest-rank), or None
@@ -192,22 +198,30 @@ class FillMeter:
     batcher is flushing early (deadline too tight or buckets too big)."""
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 prefix: str = "sparknet_serve_batch"):
+                 prefix: str = "sparknet_serve_batch",
+                 model: Optional[str] = None):
+        """`model` labels the registry families (multi-model routers share
+        one registry); None keeps them unlabeled — don't mix modes within
+        one registry/prefix."""
         self.real = 0
         self.padded = 0
         self.batches = 0
         self._lock = threading.Lock()
+        self._labels = {} if model is None else {"model": str(model)}
         self._c_rows = self._c_batches = self._g_fill = None
         if registry is not None:
+            lnames = tuple(self._labels)
             self._c_rows = registry.counter(
                 f"{prefix}_rows_total",
                 "batch rows by kind (real examples vs padding slots)",
-                labels=("kind",))
+                labels=lnames + ("kind",))
             self._c_batches = registry.counter(
-                f"{prefix}es_total", "compiled forwards run")
+                f"{prefix}es_total", "compiled forwards run",
+                labels=lnames)
             self._g_fill = registry.gauge(
                 f"{prefix}_fill_ratio",
-                "real rows / padded bucket slots, cumulative")
+                "real rows / padded bucket slots, cumulative",
+                labels=lnames)
 
     def add(self, n_real: int, bucket: int) -> None:
         with self._lock:
@@ -215,10 +229,11 @@ class FillMeter:
             self.padded += int(bucket)
             self.batches += 1
         if self._c_rows is not None:
-            self._c_rows.inc(int(n_real), kind="real")
-            self._c_rows.inc(int(bucket) - int(n_real), kind="padding")
-            self._c_batches.inc()
-            self._g_fill.set(self.ratio())
+            self._c_rows.inc(int(n_real), kind="real", **self._labels)
+            self._c_rows.inc(int(bucket) - int(n_real), kind="padding",
+                             **self._labels)
+            self._c_batches.inc(**self._labels)
+            self._g_fill.set(self.ratio(), **self._labels)
 
     def ratio(self) -> float:
         with self._lock:

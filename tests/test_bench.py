@@ -78,26 +78,52 @@ def test_checkpoint_stall_bench_core(tmp_path):
 
 
 def test_serve_bench_smoke(tmp_path):
-    """bench.serve_bench drives the REAL dynamic-batching server through
-    all three load regimes and writes a complete BENCH_SERVE artifact.
-    The committed BENCH_SERVE.json pins the acceptance numbers (fill >=
-    0.8 saturated, p99 bounded at trickle); this smoke asserts the
-    harness itself — rows present, counters sane, saturation actually
-    batching — at a CI-noise-tolerant threshold."""
+    """bench.serve_bench drives the REAL server through every load
+    regime — in-process trickle/open/saturate, the OPEN-LOOP HTTP rows
+    through the real data plane, and the hot-swap + replica-drain chaos
+    arm — and writes a complete BENCH_SERVE artifact. The committed
+    BENCH_SERVE.json pins the acceptance numbers; this smoke asserts the
+    harness itself — rows present, counters sane, zero dropped/hung HTTP
+    clients, jit cache steady — at CI-noise-tolerant thresholds."""
     import bench
     out = bench.serve_bench(out_path=str(tmp_path / "BENCH_SERVE.json"),
-                            duration_s=0.4, max_batch=4)
+                            duration_s=0.4, max_batch=4,
+                            http_rps=(200.0,),
+                            keep=str(tmp_path / "keep"))
     rows = out["rows"]
     assert [r["load"] for r in rows] == [
-        "trickle", "open_50rps", "open_200rps", "saturate"]
-    for r in rows:
+        "trickle", "open_50rps", "open_200rps", "saturate",
+        "http_open_200rps", "http_chaos_swap_drain"]
+    for r in rows[:4]:
         assert r["requests_failed"] == 0
         assert r["requests_ok"] > 0
         assert r["p99_ms"] is not None
     assert rows[0]["batch_fill_ratio"] == 1.0  # closed-loop single client
-    assert rows[-1]["batch_fill_ratio"] > 0.5  # saturation batches up
+    assert rows[3]["batch_fill_ratio"] > 0.5   # saturation batches up
+    # trickle carries the wake-on-submit stamp (the pin itself is
+    # test_serve's lone-request bound; here: the artifact records it)
+    assert rows[0]["old_poll_quantum_ms"] == 50.0
+    assert "p99_below_old_quantum" in rows[0]
+    # the HTTP open-loop row: every request answered, none dropped,
+    # silently timed out, or hung
+    http = rows[4]
+    assert http["ok"] > 0
+    assert http["dropped"] == 0 and http["hung_clients"] == 0
+    assert http["timed_out"] == 0
+    assert http["answered"] == http["ok"] + http["shed_429"] + \
+        http["shed_503"] + http["errors_other"]
+    assert http["errors_other"] == 0
+    # chaos: mid-traffic swap + drain with zero dropped/corrupted
+    chaos = rows[5]
+    assert chaos["zero_dropped"] and chaos["swap_ok"]
+    assert chaos["bad"] == 0
     art = json.load(open(tmp_path / "BENCH_SERVE.json"))
     assert art["headline"]["metric"] == "serve_saturated_batch_fill_ratio"
+    assert art["headline"]["jit_cache_ok"] is True
+    assert art["headline"]["http_zero_dropped"] is True
+    assert art["headline"]["chaos_zero_dropped"] is True
+    # the serve JSONL artifact landed for CI upload-on-failure
+    assert (tmp_path / "keep" / "serve_bench.jsonl").exists()
 
 
 def test_obs_bench_smoke(tmp_path, monkeypatch):

@@ -1,0 +1,458 @@
+"""The serve network data plane (serve/http_frontend.py + serve/router.py):
+keep-alive connection reuse, JSON/npz wire decode, 429-with-Retry-After
+admission control, deadline shedding that answers instead of hanging,
+multi-model routing over the shared worker pool, and a replica draining
+mid-traffic with zero dropped responses (the chaos bar PR 3 set).
+
+Tier-1: CPU backend, lenet shapes, ephemeral ports.
+"""
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.net_api import JaxNet
+from sparknet_tpu.serve import (DeadlineExpiredError, HttpFrontend,
+                                InferenceServer, ModelRouter,
+                                NoReplicaError, QueueFullError,
+                                RouterConfig, ServeConfig, http_infer,
+                                zeros_batch)
+from sparknet_tpu.zoo import lenet
+
+
+def _example(i: int) -> dict:
+    r = np.random.default_rng(2000 + i)
+    return {"data": r.standard_normal((28, 28, 1)).astype(np.float32)}
+
+
+class SlowNet:
+    """Facade that makes every forward take `delay_s` — the knob that
+    turns a CPU lenet into an overloadable server for backpressure and
+    shed tests."""
+
+    def __init__(self, inner, delay_s: float):
+        self._inner, self.delay_s = inner, delay_s
+
+    def __getattr__(self, k):
+        return getattr(self._inner, k)
+
+    def forward(self, *a, **kw):
+        time.sleep(self.delay_s)
+        return self._inner.forward(*a, **kw)
+
+
+@pytest.fixture(scope="module")
+def net():
+    return JaxNet(lenet(batch=4))
+
+
+def _post(conn: http.client.HTTPConnection, path: str, body: bytes,
+          ctype: str = "application/json", headers: dict = None):
+    h = {"Content-Type": ctype, **(headers or {})}
+    conn.request("POST", path, body=body, headers=h)
+    resp = conn.getresponse()
+    return resp, resp.read()
+
+
+# -- wire format + keep-alive ------------------------------------------------
+
+def test_json_roundtrip_on_one_keepalive_connection(net):
+    """Five sequential requests over ONE HTTP/1.1 connection: all
+    answered, outputs match a direct forward, and the server saw exactly
+    one connection (keep-alive reuse asserted, not assumed)."""
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      metrics_every_batches=0)
+    with InferenceServer(net, cfg) as srv:
+        fe = HttpFrontend(srv, port=0)
+        try:
+            host, port = fe.address
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            for i in range(5):
+                x = _example(i)
+                body = json.dumps(
+                    {"inputs": {"data": x["data"].tolist()}}).encode()
+                resp, data = _post(conn, "/v1/infer", body)
+                assert resp.status == 200, data
+                out = json.loads(data)
+                assert out["model"] == "default"
+                direct = net.forward(
+                    {**zeros_batch(net, 1), "data": x["data"][None]},
+                    blob_names=["prob"])
+                np.testing.assert_allclose(
+                    np.asarray(out["outputs"]["prob"]),
+                    direct["prob"][0], rtol=1e-4, atol=1e-4)
+            conn.close()
+            assert fe.requests == 5
+            assert fe.connections == 1, (
+                f"{fe.connections} connections for 5 requests — "
+                f"keep-alive reuse is broken")
+        finally:
+            fe.stop()
+
+
+def test_npz_roundtrip_exact_dtype(net):
+    """The raw-tensor wire format: npz in, npz out, float32 end to end,
+    bitwise-equal to the in-process submit path at the same bucket."""
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, buckets=(4,),
+                      outputs=("fc2",), metrics_every_batches=0)
+    with InferenceServer(net, cfg) as srv:
+        fe = HttpFrontend(srv, port=0)
+        try:
+            x = _example(0)
+            inproc = srv.infer(x)
+            out = http_infer(f"http://{fe.address[0]}:{fe.address[1]}",
+                             "default", x, deadline_s=30.0)
+            assert out["fc2"].dtype == np.float32
+            np.testing.assert_array_equal(out["fc2"], inproc["fc2"])
+        finally:
+            fe.stop()
+
+
+def test_bad_requests_answered_not_hung(net):
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      metrics_every_batches=0)
+    with InferenceServer(net, cfg) as srv:
+        fe = HttpFrontend(srv, port=0)
+        try:
+            host, port = fe.address
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            # undecodable body -> 400
+            resp, data = _post(conn, "/v1/infer", b"not json")
+            assert resp.status == 400
+            assert json.loads(data)["error_kind"] == "bad_request"
+            # unknown model -> 404 (and the connection survived the 400)
+            resp, data = _post(conn, "/v1/models/nope/infer",
+                               json.dumps({"inputs": {}}).encode())
+            assert resp.status == 404
+            assert json.loads(data)["error_kind"] == "unknown_model"
+            # not a net input -> 400 with the field named
+            resp, data = _post(conn, "/v1/infer", json.dumps(
+                {"inputs": {"bogus": [1.0]}}).encode())
+            assert resp.status == 400
+            assert "bogus" in json.loads(data)["error"]
+            # GET surfaces
+            conn.request("GET", "/v1/models")
+            r = conn.getresponse()
+            models = json.loads(r.read())["models"]
+            assert "default" in models
+            conn.request("GET", "/healthz")
+            r = conn.getresponse()
+            assert r.status == 200
+            r.read()
+            assert fe.connections == 1  # all of it on one connection
+        finally:
+            fe.stop()
+
+
+# -- admission control + shedding --------------------------------------------
+
+def test_429_retry_after_under_full_queue(net):
+    """Queue at capacity: excess requests are answered 429 with a
+    Retry-After header (admission control wired to QueueFullError), the
+    admitted ones still serve, nothing hangs."""
+    cfg = ServeConfig(max_batch=2, max_wait_ms=1.0, max_queue=2,
+                      outputs=("prob",), metrics_every_batches=0)
+    slow = SlowNet(net, 0.15)
+    with InferenceServer(slow, cfg) as srv:
+        srv.submit(_example(0)).result(timeout=30)  # compile outside
+        fe = HttpFrontend(srv, port=0)
+        try:
+            url = f"http://{fe.address[0]}:{fe.address[1]}"
+            codes, retry_after = [], []
+            lock = threading.Lock()
+
+            def client(i):
+                conn = http.client.HTTPConnection(*fe.address, timeout=30)
+                body = json.dumps(
+                    {"inputs": {"data": _example(i)["data"].tolist()}}
+                ).encode()
+                resp, data = _post(conn, "/v1/infer", body)
+                with lock:
+                    codes.append(resp.status)
+                    if resp.status == 429:
+                        retry_after.append(
+                            resp.getheader("Retry-After"))
+                        assert json.loads(data)["error_kind"] == \
+                            "queue_full"
+                conn.close()
+
+            ts = [threading.Thread(target=client, args=(i,))
+                  for i in range(12)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            assert not any(t.is_alive() for t in ts), "a client hung"
+            assert time.perf_counter() - t0 < 30
+            assert codes.count(200) >= 2, codes   # admitted ones served
+            assert 429 in codes, codes            # and overload was shed
+            assert all(ra and int(ra) >= 1 for ra in retry_after)
+        finally:
+            fe.stop()
+
+
+def test_deadline_shed_answers_503_not_hang(net):
+    """Expired deadlines: requests whose deadline passes while queued
+    behind a slow forward are answered 503 + Retry-After (error_kind
+    deadline) within bounded time — never a hang, and the shed counter
+    tells the story."""
+    cfg = ServeConfig(max_batch=2, max_wait_ms=1.0, outputs=("prob",),
+                      metrics_every_batches=0)
+    slow = SlowNet(net, 0.3)
+    with InferenceServer(slow, cfg) as srv:
+        srv.submit(_example(0)).result(timeout=30)  # compile outside
+        fe = HttpFrontend(srv, port=0)
+        try:
+            host, port = fe.address
+            # occupy the worker, then pile deadlined requests behind it
+            blocker = srv.submit(_example(1))
+            time.sleep(0.05)  # blocker's batch is in its slow forward
+
+            codes = []
+            lock = threading.Lock()
+
+            def client(i):
+                conn = http.client.HTTPConnection(host, port, timeout=30)
+                body = json.dumps({
+                    "inputs": {"data": _example(i)["data"].tolist()},
+                    "deadline_ms": 100.0}).encode()
+                resp, data = _post(conn, "/v1/infer", body)
+                with lock:
+                    codes.append((resp.status,
+                                  resp.getheader("Retry-After"),
+                                  json.loads(data).get("error_kind")))
+                conn.close()
+
+            ts = [threading.Thread(target=client, args=(i,))
+                  for i in range(2, 8)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=30)
+            dt = time.perf_counter() - t0
+            assert not any(t.is_alive() for t in ts), "a client hung"
+            assert dt < 10, f"shed took {dt:.1f}s"
+            blocker.result(timeout=30)
+            shed = [c for c in codes if c[0] == 503]
+            assert shed, codes  # the 100 ms deadlines could not all make it
+            for status, ra, kind in shed:
+                assert kind == "deadline" and ra is not None
+            assert srv.batcher.shed >= len(shed)
+        finally:
+            fe.stop()
+
+
+# -- multi-model routing ------------------------------------------------------
+
+def test_router_serves_two_models_with_per_model_metrics(net):
+    """Two models over one shared pool: requests route to the right
+    net (weights differ between lanes), per-model buckets hold, and the
+    shared registry carries model-labeled families for both."""
+    r = ModelRouter(RouterConfig(workers=2))
+    net_b = JaxNet(lenet(batch=4))
+    # make b's weights visibly different from a's
+    net_b.params = {ln: {pn: w * 0.5 for pn, w in lp.items()}
+                    for ln, lp in net_b.params.items()}
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("fc2",),
+                      metrics_every_batches=0)
+    r.add_model("a", net, cfg=cfg)
+    r.add_model("b", net_b, cfg=cfg)
+    with r:
+        fe = HttpFrontend(r, port=0)
+        try:
+            url = f"http://{fe.address[0]}:{fe.address[1]}"
+            x = _example(0)
+            out_a = http_infer(url, "a", x, deadline_s=30.0)
+            out_b = http_infer(url, "b", x, deadline_s=30.0)
+            da = net.forward({**zeros_batch(net, 1),
+                              "data": x["data"][None]},
+                             blob_names=["fc2"])
+            db = net_b.forward({**zeros_batch(net_b, 1),
+                                "data": x["data"][None]},
+                               blob_names=["fc2"])
+            np.testing.assert_allclose(out_a["fc2"], da["fc2"][0],
+                                       rtol=1e-4, atol=1e-4)
+            np.testing.assert_allclose(out_b["fc2"], db["fc2"][0],
+                                       rtol=1e-4, atol=1e-4)
+            assert not np.allclose(out_a["fc2"], out_b["fc2"])
+            # /v1/infer is ambiguous with two models
+            conn = http.client.HTTPConnection(*fe.address, timeout=30)
+            resp, data = _post(conn, "/v1/infer", json.dumps(
+                {"inputs": {"data": x["data"].tolist()}}).encode())
+            assert resp.status == 404
+            conn.close()
+            text = r.registry.render_prometheus()
+            assert ('sparknet_serve_requests_total{model="a",'
+                    'outcome="ok"}') in text
+            assert ('sparknet_serve_requests_total{model="b",'
+                    'outcome="ok"}') in text
+            assert 'sparknet_serve_routed_total{model="a",' in text
+        finally:
+            fe.stop()
+
+
+@pytest.mark.chaos
+def test_replica_drains_mid_traffic_zero_dropped(net):
+    """The routing chaos bar: model m has a local replica (router A) and
+    a remote replica (router B behind its HTTP frontend). Mid-traffic
+    the local replica DRAINS: every in-flight and queued request still
+    answers, new traffic routes to the remote replica, zero dropped or
+    corrupted responses."""
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      metrics_every_batches=0)
+    rb = ModelRouter(RouterConfig(workers=1))
+    rb.add_model("m", JaxNet(lenet(batch=4)), cfg=cfg)
+    ra = ModelRouter(RouterConfig(workers=1))
+    ra.add_model("m", net, cfg=cfg)
+    with rb:
+        fe_b = HttpFrontend(rb, port=0)
+        with ra:
+            ra.add_remote_replica(
+                "m", f"http://{fe_b.address[0]}:{fe_b.address[1]}")
+            answered, bad = [], []
+            stop = threading.Event()
+
+            def client(c):
+                i = 0
+                while not stop.is_set():
+                    try:
+                        out = ra.infer("m", _example(c * 10000 + i),
+                                       timeout=30.0)
+                        p = np.asarray(out["prob"])
+                        if p.shape != (10,) or not np.isfinite(p).all():
+                            bad.append((c, i, p))
+                        answered.append((c, i))
+                    except Exception as e:
+                        bad.append((c, i, e))
+                    i += 1
+
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(4)]
+            for t in threads:
+                t.start()
+            try:
+                time.sleep(0.4)  # traffic flowing through both replicas
+                before = len(answered)
+                ra.drain("m", "local:m")  # in-flight must still answer
+                time.sleep(0.6)  # all new traffic rides the remote
+                assert len(answered) > before + 4, \
+                    "traffic stalled after drain"
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(timeout=30)
+            assert not any(t.is_alive() for t in threads)
+            assert not bad, bad[:3]
+            assert len(answered) > 20
+            # the drain actually shifted routing to the remote replica
+            routed = ra.registry.counter(
+                "sparknet_serve_routed_total",
+                labels=("model", "replica"))
+            remote_name = ra.replicas["m"][1].name
+            assert routed.value(model="m", replica=remote_name) > 0
+        fe_b.stop()
+
+
+def test_busy_router_still_runs_idle_lane_duties(net, tmp_path):
+    """Sustained traffic to one lane must not starve the others'
+    periodic duties (regression: the pool only ran duty_tick on idle
+    sweeps): with a SINGLE pool worker hammered on model a, model b's
+    checkpoint hot-reload poll still runs and lands a swap, the router
+    heartbeat keeps beating, and /healthz stays ok throughout."""
+    from sparknet_tpu.utils import checkpoint as ckpt
+    from sparknet_tpu.utils.heartbeat import read_heartbeat
+
+    net_b = JaxNet(lenet(batch=4))
+    ckdir = tmp_path / "ck"
+    flat = {f"params/{ln}/{pn}": np.asarray(w)[None] * 0.9
+            for ln, lp in net_b.params.items() for pn, w in lp.items()}
+    ckpt.save(str(ckdir), flat, step=1)
+    hb_path = str(tmp_path / "hb.json")
+    r = ModelRouter(RouterConfig(workers=1, heartbeat_path=hb_path,
+                                 heartbeat_every_s=0.05))
+    cfg_a = ServeConfig(max_batch=4, max_wait_ms=1.0, outputs=("prob",),
+                        metrics_every_batches=0)
+    cfg_b = ServeConfig(max_batch=4, max_wait_ms=1.0, outputs=("prob",),
+                        checkpoint_dir=str(ckdir), poll_interval_s=0.05,
+                        metrics_every_batches=0)
+    r.add_model("a", net, cfg=cfg_a)
+    r.add_model("b", net_b, cfg=cfg_b)
+    with r:
+        r.infer("a", _example(0))  # compile before the hammer
+        assert r.lanes["b"].manager.step == 1
+        stop = threading.Event()
+        unhealthy = []
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                r.infer("a", _example(i), timeout=30.0)
+                i += 1
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        try:
+            time.sleep(0.3)  # lane a saturates the single pool worker
+            ckpt.save(str(ckdir), flat, step=2)  # b must still poll
+            deadline = time.monotonic() + 10
+            while r.lanes["b"].manager.step != 2 and \
+                    time.monotonic() < deadline:
+                if not r.healthy():
+                    unhealthy.append(time.monotonic())
+                time.sleep(0.02)
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        assert r.lanes["b"].manager.step == 2, (
+            "idle lane's hot-reload poll starved under sustained "
+            "traffic to the other lane")
+        assert not unhealthy, "router read unhealthy while serving fine"
+        hb = read_heartbeat(hb_path)
+        assert hb is not None and hb["age_s"] < 5.0, (
+            "router heartbeat starved under sustained traffic")
+
+
+def test_router_no_replica_is_503_shed(net):
+    """Every replica draining -> NoReplicaError locally, 503 +
+    Retry-After over HTTP (load shedding, never a hang)."""
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      metrics_every_batches=0)
+    r = ModelRouter(RouterConfig(workers=1))
+    r.add_model("m", net, cfg=cfg)
+    with r:
+        fe = HttpFrontend(r, port=0)
+        try:
+            r.drain("m", "local:m")
+            with pytest.raises(NoReplicaError):
+                r.submit("m", _example(0))
+            conn = http.client.HTTPConnection(*fe.address, timeout=30)
+            resp, data = _post(conn, "/v1/models/m/infer", json.dumps(
+                {"inputs": {"data": _example(0)["data"].tolist()}}
+            ).encode())
+            assert resp.status == 503
+            assert resp.getheader("Retry-After") is not None
+            assert json.loads(data)["error_kind"] == "no_replica"
+            conn.close()
+        finally:
+            fe.stop()
+
+
+def test_serve_cli_router_demo(tmp_path, capsys):
+    """`sparknet-serve --models a=lenet,b=lenet --demo` end to end: the
+    router CLI self-drives requests across both lanes and prints the
+    router status JSON."""
+    from sparknet_tpu.serve.app import main
+    main(["--models", "a=lenet,b=lenet", "--router-workers", "2",
+          "--outputs", "prob", "--max-batch", "4", "--demo", "8",
+          "--workdir", str(tmp_path)])
+    status = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert status["router"] is True
+    assert set(status["models"]) == {"a", "b"}
+    lanes = status["lanes"]
+    assert sum(lane["requests_ok"] for lane in lanes.values()) == 8
+    assert all(lane["requests_failed"] == 0 for lane in lanes.values())

@@ -280,6 +280,34 @@ def test_aggregator_file_mode_flags_injected_straggler(tmp_path):
     assert 'sparknet_pod_worker_round_seconds{worker="2"} 1.5' in text
 
 
+def test_aggregator_surfaces_per_model_serve_rows(tmp_path):
+    """A serve-role heartbeat's per-model vitals rows ride through the
+    aggregator into /pod/status worker rows and the podview table —
+    multi-model straggler attribution reads per model, not just per
+    process."""
+    pod_dir = str(tmp_path / "pod")
+    HeartbeatWriter(worker_heartbeat_path(pod_dir, 0)).beat(
+        7, status="ok", round_s=0.1)
+    HeartbeatWriter(worker_heartbeat_path(pod_dir, 1), role="serve").beat(
+        42, status="ok",
+        models={"mnist": {"step": 42, "queue_depth": 3, "p99_ms": 8.5,
+                          "requests_ok": 100, "requests_shed": 2,
+                          "swaps": 1},
+                "cifar": {"step": 9, "queue_depth": 0, "p99_ms": 30.1,
+                          "requests_ok": 10}})
+    agg = PodAggregator(pod_dir=pod_dir, min_refresh_s=0.0)
+    status = agg.pod_status()
+    serve = [w for w in status["workers"] if w["worker"] == "1"][0]
+    assert serve["role"] == "serve"
+    assert set(serve["models"]) == {"mnist", "cifar"}
+    assert serve["models"]["mnist"]["p99_ms"] == 8.5
+    train = [w for w in status["workers"] if w["worker"] == "0"][0]
+    assert "models" not in train  # train rows stay exactly as before
+    table = format_pod_table(status)
+    assert "model=mnist" in table and "p99=8.5ms" in table
+    assert "model=cifar" in table and "shed=2" in table
+
+
 def test_aggregator_file_mode_stale_worker_named(tmp_path):
     pod_dir = str(tmp_path / "pod")
     for i in range(2):
@@ -429,7 +457,8 @@ def test_serve_bucket_recompile_counter_steady_state():
                       outputs=("prob",), metrics_every_batches=0)
     x = {"data": np.zeros((28, 28, 1), np.float32)}
     with InferenceServer(net, cfg) as srv:
-        c = srv.registry.counter("sparknet_serve_bucket_compiles_total")
+        c = srv.registry.counter("sparknet_serve_bucket_compiles_total",
+                                 labels=("model",))
         srv.infer(x)                       # bucket 1
         futs = [srv.submit(x) for _ in range(4)]
         for f in futs:
@@ -446,12 +475,12 @@ def test_serve_bucket_recompile_counter_steady_state():
             for f in [srv.submit(x) for _ in range(n)]:
                 f.result(timeout=30)
         assert srv._compiled_buckets == {1, 2, 4}
-        assert c.value() == 3  # == len(buckets)
+        assert c.value(model="default") == 3  # == len(buckets)
         # steady state: more traffic adds NO compile events
         for f in [srv.submit(x) for _ in range(4)]:
             f.result(timeout=30)
         srv.infer(x)
-        assert c.value() == 3
+        assert c.value(model="default") == 3
         assert srv.status()["bucket_compiles"] == 3
 
 

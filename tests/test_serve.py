@@ -26,8 +26,9 @@ import numpy as np
 import pytest
 
 from sparknet_tpu.net_api import JaxNet
-from sparknet_tpu.serve import (DynamicBatcher, InferenceServer,
-                                ModelManager, QueueFullError, ServeConfig,
+from sparknet_tpu.serve import (DeadlineExpiredError, DynamicBatcher,
+                                InferenceServer, ModelManager,
+                                QueueFullError, ServeConfig,
                                 ServeModelError, zeros_batch)
 from sparknet_tpu.serve.model_manager import params_from_checkpoint_flat
 from sparknet_tpu.utils import checkpoint as ckpt
@@ -96,6 +97,151 @@ def test_batcher_deadline_keyed_on_oldest():
     assert got[0].payload["x"] == -1
     assert dt < 1.0, f"trickle starved the head of the queue for {dt:.2f}s"
     b.close()
+
+
+def test_batcher_wake_on_submit_no_poll_quantum():
+    """Wake-on-submit: a consumer parked with a FAR wake_at alarm is
+    woken by submit immediately — a lone request's wait is bounded by
+    max_wait_s + scheduling jitter, with no poll-interval quantum."""
+    b = DynamicBatcher(max_batch=8, max_wait_s=0.005)
+    got, lat = [], []
+
+    def consume():
+        t0 = time.perf_counter()
+        got.append(b.next_batch(wake_at=t0 + 30.0))  # alarm way out
+        lat.append(time.perf_counter() - t0)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.1)  # consumer is parked in the condition wait
+    t0 = time.perf_counter()
+    b.submit({"x": np.float32(7)})
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    dt = time.perf_counter() - t0
+    assert got[0][0].payload["x"] == 7
+    # bound: max_wait (5 ms) + generous scheduling jitter, FAR below the
+    # old 50 ms poll quantum this replaced
+    assert dt < 0.045, f"lone request waited {dt * 1e3:.1f} ms"
+    b.close()
+
+
+def test_batcher_sheds_expired_deadlines_before_forming():
+    """A queued request whose client deadline passed is shed at batch
+    formation (DeadlineExpiredError + shed counter), never returned in
+    a batch; requests without deadlines are unaffected."""
+    from sparknet_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
+    b = DynamicBatcher(max_batch=8, max_wait_s=0.01, registry=reg,
+                       model="m")
+    doomed = b.submit({"x": np.float32(1)}, deadline_s=0.005)
+    alive = b.submit({"x": np.float32(2)})
+    time.sleep(0.05)  # doomed expires while queued
+    got = b.next_batch()
+    assert [r.payload["x"] for r in got] == [2]
+    with pytest.raises(DeadlineExpiredError):
+        doomed.result(timeout=1.0)
+    assert b.shed == 1
+    c = reg.counter("sparknet_serve_shed_total",
+                    labels=("model", "reason"))
+    assert c.value(model="m", reason="deadline") == 1
+    # an ALREADY-expired deadline never touches the queue
+    pre = b.submit({"x": np.float32(3)}, deadline_s=0.0)
+    with pytest.raises(DeadlineExpiredError):
+        pre.result(timeout=1.0)
+    assert b.depth() == 0 and b.shed == 2
+    # sanity: the un-deadlined request was actually served
+    assert alive  # future returned; group serving is the server's job
+    b.close()
+
+
+def test_batcher_closes_batch_early_for_client_deadline():
+    """Deadline-aware formation: a request whose client deadline lands
+    BEFORE the oldest-request max_wait close resolves at ~its deadline —
+    served early (the formation loop closes 1 ms ahead of the deadline),
+    or, if a contended host loses that scheduling margin, shed AT it.
+    Either way the client is answered around its deadline, never held
+    to the 0.5 s batch deadline."""
+    b = DynamicBatcher(max_batch=64, max_wait_s=0.5)
+    t0 = time.perf_counter()
+    f = b.submit({"x": np.float32(1)}, deadline_s=0.05)
+    got = b.next_batch()
+    dt = time.perf_counter() - t0
+    assert dt < 0.3, (f"batch held {dt:.2f}s past the client deadline "
+                      f"instead of closing early")
+    if got:  # the common, uncontended outcome: served before expiry
+        assert got[0].payload["x"] == 1
+    else:    # margin lost to scheduling: shed AT the deadline, answered
+        with pytest.raises(DeadlineExpiredError):
+            f.result(timeout=1.0)
+    b.close()
+
+
+def test_server_infer_timeout_is_a_deadline(net):
+    """infer(timeout=) threads the deadline into batch formation: an
+    expired request is shed with DeadlineExpiredError instead of riding
+    a bucket slot (and instead of a bare concurrent.futures timeout)."""
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      metrics_every_batches=0)
+
+    class SlowNet:
+        """Facade: forwards take long enough that a queued request's
+        deadline expires while an earlier batch is still running."""
+
+        def __init__(self, inner, delay_s):
+            self._inner, self._delay = inner, delay_s
+
+        def __getattr__(self, k):
+            return getattr(self._inner, k)
+
+        def forward(self, *a, **kw):
+            time.sleep(self._delay)
+            return self._inner.forward(*a, **kw)
+
+    slow = SlowNet(net, 0.25)
+    with InferenceServer(slow, cfg) as srv:
+        srv.infer(_example(0))  # compile + warm
+        # first request occupies the worker; the second's 100 ms deadline
+        # expires during that forward -> shed at ITS batch formation
+        first = srv.submit(_example(1))
+        time.sleep(0.05)  # first's batch is IN the slow forward now
+        t0 = time.perf_counter()
+        with pytest.raises(DeadlineExpiredError):
+            srv.infer(_example(2), timeout=0.1)
+        dt = time.perf_counter() - t0
+        assert dt < 2.0, f"shed took {dt:.2f}s (shed-not-hang violated)"
+        first.result(timeout=30.0)
+        assert srv.batcher.shed >= 1
+        assert srv.status()["requests_shed"] >= 1
+
+
+def test_server_lone_request_latency_bounded(net):
+    """The wake-on-submit pin at server level: a warmed, idle server
+    answers a lone request within max_wait + a few forwards — the old
+    50 ms idle-poll quantum is gone from the path."""
+    cfg = ServeConfig(max_batch=4, max_wait_ms=5.0, outputs=("prob",),
+                      metrics_every_batches=0)
+    with InferenceServer(net, cfg) as srv:
+        srv.infer(_example(0))  # compile bucket 1
+        # estimate one forward
+        t0 = time.perf_counter()
+        srv.infer(_example(1))
+        fwd_s = max(time.perf_counter() - t0 - 0.005, 0.002)
+        time.sleep(0.3)  # worker fully parked (mid-poll, in the old code)
+        lats = []
+        for i in range(15):
+            t0 = time.perf_counter()
+            srv.infer(_example(2 + i))
+            lats.append(time.perf_counter() - t0)
+            time.sleep(0.01)
+        lats.sort()
+        p99 = lats[-1]
+        bound = 0.005 + 6 * fwd_s + 0.015  # deadline + forwards + jitter
+        assert p99 < max(bound, 0.045), (
+            f"lone p99 {p99 * 1e3:.1f} ms vs bound "
+            f"{max(bound, 0.045) * 1e3:.1f} ms — is an idle-poll quantum "
+            f"back in the path?")
 
 
 def test_batcher_backpressure_and_close():
@@ -419,8 +565,11 @@ def test_healthz_and_metrics_http(net):
             f"http://127.0.0.1:{port}/metrics", timeout=10)
         assert resp.headers["Content-Type"].startswith("text/plain")
         text = resp.read().decode()
-        assert 'sparknet_serve_requests_total{outcome="ok"} 1' in text
-        assert "sparknet_serve_batch_fill_ratio 1" in text
+        # serve families carry the model label (multi-model routers share
+        # one registry; a single-model server labels its sole lane)
+        assert ('sparknet_serve_requests_total{model="default",'
+                'outcome="ok"} 1') in text
+        assert 'sparknet_serve_batch_fill_ratio{model="default"} 1' in text
         assert "sparknet_build_info{" in text
         s = json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{port}/status", timeout=10).read())
