@@ -1754,6 +1754,40 @@ def sharding_bench(out_path: str | None = "BENCH_r07.json",
             jax.tree.map(np.asarray, state)
             fetch_ab["fetch_sync_ms"] = round(
                 (time.perf_counter() - t3) * 1e3, 3)
+        if name == "named_replicated":
+            # r8 collect A/B: the loop-blocking cost of the boundary
+            # result fetch — synchronous float(loss) right after
+            # dispatch vs the main-thread cost of handing the fetch to
+            # a collector thread (what cfg.collect_async makes the loop
+            # pay; the fetch itself then overlaps the next round)
+            state, loss = trainer.train_round(
+                state, trainer.place_batches(host, compute_dt),
+                jax.random.fold_in(key, 20_000))
+            t4 = time.perf_counter()
+            float(loss)
+            fetch_ab["collect_sync_ms"] = round(
+                (time.perf_counter() - t4) * 1e3, 3)
+            state, loss = trainer.train_round(
+                state, trainer.place_batches(host, compute_dt),
+                jax.random.fold_in(key, 20_001))
+            exe2 = ThreadPoolExecutor(1, thread_name_prefix="collect")
+            t5 = time.perf_counter()
+            fut = exe2.submit(float, loss)
+            fetch_ab["collect_async_blocking_ms"] = round(
+                (time.perf_counter() - t5) * 1e3, 3)
+            fut.result()
+            exe2.shutdown()
+            # the r8 gather-free stage 1 on the same state: per-shard
+            # host fetch (never the full state on one host)
+            from sparknet_tpu.parallel.mesh import fetch_state_shards
+            state, _ = trainer.train_round(
+                state, trainer.place_batches(host, compute_dt),
+                jax.random.fold_in(key, 20_002))
+            jax.block_until_ready(jax.tree.leaves(state.params))
+            t6 = time.perf_counter()
+            fetch_state_shards(state, trainer.mesh)
+            fetch_ab["fetch_shards_ms"] = round(
+                (time.perf_counter() - t6) * 1e3, 3)
         per_round = dt / trials
         img_per_sec = batch * n_dev * tau / per_round
         row = {
@@ -1775,6 +1809,7 @@ def sharding_bench(out_path: str | None = "BENCH_r07.json",
     rows = [
         run_arm("r6_prefetch_donate", ParallelTrainer),
         run_arm("named_replicated", ShardedTrainer),
+        run_arm("named_fused", ShardedTrainer, fused_boundary=True),
         run_arm("named_momentum", ShardedTrainer,
                 state_sharding="momentum"),
     ]
@@ -1792,9 +1827,202 @@ def sharding_bench(out_path: str | None = "BENCH_r07.json",
         "named_img_per_sec_vs_r6": round(
             by["named_replicated"]["images_per_sec"]
             / max(by["r6_prefetch_donate"]["images_per_sec"], 1e-9), 4),
+        # r8: the fused-boundary round vs the unfused two-step (same
+        # trainer, peeled final step) — the wire bytes are identical, so
+        # off-TPU this reads ~1.0; the lever is the overlap of the
+        # boundary all-reduce with the final update on real ICI
+        "fused_round_ms_vs_unfused": round(
+            by["named_fused"]["round_ms"]
+            / max(by["named_replicated"]["round_ms"], 1e-9), 4),
         "collect_stage1_ms": {a: by[a]["collect_stage1_ms"] for a in by},
         **fetch_ab,
         "n_data": n_dev, "batch_per_device": batch, "tau": tau,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"headline": out, "rows": rows,
+                       "meta": run_metadata()}, f, indent=1)
+    print(json.dumps(out))
+    return {"headline": out, "rows": rows}
+
+
+def ckpt_shard_bench(out_path: str | None = "BENCH_CKPT_SHARD.json",
+                     trials: int = 3, mb: int = 48,
+                     workers: tuple = (2, 4, 8)) -> dict:
+    """The r8 sharded-checkpoint audit (BENCH row): save + restore wall
+    time of the SAME logical state under the monolithic layout
+    (fetch_global allgather -> one state.npz) vs the sharded layout
+    (fetch_state_shards -> parallel shard-k-of-n files + manifest), as a
+    function of worker (mesh-device) count. Claims measured:
+
+      - bytes_equal: the sharded files persist exactly the monolithic
+        layout's logical bytes (no replicated leaf written twice)
+      - restore bitwise: both layouts reassemble the identical flat map
+      - stage-1 blocking (the round loop's stall) under the sharded
+        fetch never materializes the full state and sits below the
+        monolithic gather — the PR 8 baseline this arc started from
+      - save+restore wall time decreases as workers grow (parallel
+        files), where the monolithic path is flat
+
+    CPU rows are STRUCTURE PROOFS (one host, one disk: parallel local
+    writes measure thread/IO overlap, not n hosts' independent NICs and
+    stores) — rerun on the pod against gs:// for the acceptance truth."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{max(workers)}").strip()
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparknet_tpu.obs import run_metadata
+    from sparknet_tpu.parallel.mesh import (fetch_global,
+                                            fetch_state_shards, make_mesh)
+    from sparknet_tpu.utils import checkpoint as ckpt
+
+    # a TrainState-shaped tree at the LOGICAL layout: params replicated
+    # (the serve/export view), momentum as [n_data] worker rows sharded
+    # over data — the shapes the train loop actually snapshots. ~`mb` MB
+    # total so the files are big enough to time honestly on CPU.
+    per_leaf = (mb << 20) // 8 // 2
+
+    def build_state(mesh, n):
+        # CONSTANT total bytes across worker counts (the wall-time-vs-n
+        # curve must measure parallelism, not a growing state): params
+        # replicated (chunked across shard files), momentum as the ONE
+        # ZeRO-sharded logical tree (state_sharding="momentum" shape)
+        r = np.random.default_rng(0)
+        dim = max(8, (int(np.sqrt(per_leaf // 4)) // 8) * 8)
+        put = lambda a, s: jax.device_put(a, NamedSharding(mesh, s))  # noqa
+        return {
+            "params": {f"l{i}": {"w": put(r.standard_normal(
+                (dim, dim)).astype(np.float32), P())} for i in range(2)},
+            "momentum": {f"l{i}": {"w": put(
+                r.standard_normal((dim, dim)).astype(np.float32),
+                P("data"))} for i in range(2)},
+            "it": put(np.int32(3), P()),
+        }
+
+    rows = []
+    for n in workers:
+        if n > len(jax.devices()):
+            continue
+        mesh = make_mesh(n)
+        state = build_state(mesh, n)
+        row = {"workers": n}
+        for layout in ("monolithic", "sharded"):
+            t_f, t_s, t_r = [], [], []
+            for _ in range(trials):
+                d = tempfile.mkdtemp(prefix=f"ckshard-{layout}-")
+                try:
+                    t0 = time.perf_counter()
+                    if layout == "monolithic":
+                        snap = fetch_global(state)
+                    else:
+                        snap = fetch_state_shards(state, mesh)
+                    t1 = time.perf_counter()
+                    if layout == "monolithic":
+                        ckpt.save(d, snap, step=1)
+                    else:
+                        ckpt.save_sharded(d, snap, step=1)
+                    t2 = time.perf_counter()
+                    flat, _, _ = ckpt.restore_flat(d, step=1)
+                    t3 = time.perf_counter()
+                    t_f.append(t1 - t0)
+                    t_s.append(t2 - t1)
+                    t_r.append(t3 - t2)
+                finally:
+                    shutil.rmtree(d, ignore_errors=True)
+            row[layout] = {
+                "stage1_fetch_ms": round(min(t_f) * 1e3, 2),
+                "save_ms": round(min(t_s) * 1e3, 2),
+                "restore_ms": round(min(t_r) * 1e3, 2),
+                "save_restore_ms": round((min(t_s) + min(t_r)) * 1e3, 2)}
+        # bitwise + byte-ledger equality, asserted once per n
+        d1, d2 = tempfile.mkdtemp(), tempfile.mkdtemp()
+        try:
+            mono = fetch_global(state)
+            shrd = fetch_state_shards(state, mesh)
+            ckpt.save(d1, mono, step=1)
+            ckpt.save_sharded(d2, shrd, step=1)
+            fa, _, _ = ckpt.restore_flat(d1, step=1)
+            fb, _, _ = ckpt.restore_flat(d2, step=1)
+            assert sorted(fa) == sorted(fb)
+            for k in fa:
+                assert np.array_equal(fa[k], fb[k]), k
+            mono_bytes = sum(a.nbytes for a in fa.values())
+            row["bytes_equal"] = (ckpt.sharded_nbytes(shrd) == mono_bytes)
+            assert row["bytes_equal"], (ckpt.sharded_nbytes(shrd),
+                                        mono_bytes)
+            row["state_bytes"] = mono_bytes
+            # the EXACT per-worker share (deterministic on any backend,
+            # like the per_device_state_bytes HBM ledger): the largest
+            # shard file's bytes is what ONE worker fetches + writes per
+            # save on a pod — the O(1/n_workers) wall-time claim's
+            # structural half. Monolithic = the whole state on one host.
+            file_bytes: dict = {}
+            for rec in shrd["leaves"].values():
+                for fid, _, pshape, _ in rec["pieces"]:
+                    file_bytes[fid] = file_bytes.get(fid, 0) + \
+                        int(np.prod(pshape)) * np.dtype(
+                            rec["dtype"]).itemsize
+            row["sharded"]["per_worker_bytes"] = max(file_bytes.values())
+            row["monolithic"]["per_worker_bytes"] = mono_bytes
+        finally:
+            shutil.rmtree(d1, ignore_errors=True)
+            shutil.rmtree(d2, ignore_errors=True)
+        rows.append(row)
+        print(f"  n={n}: sharded save+restore "
+              f"{row['sharded']['save_restore_ms']:.1f} ms vs monolithic "
+              f"{row['monolithic']['save_restore_ms']:.1f} ms, stage-1 "
+              f"{row['sharded']['stage1_fetch_ms']:.1f} vs "
+              f"{row['monolithic']['stage1_fetch_ms']:.1f} ms",
+              file=sys.stderr)
+    if len(rows) < 2:
+        raise SystemExit(
+            f"--ckpt-shard needs >= 2 devices to compare worker counts "
+            f"(have {len(jax.devices())}; the virtual-mesh flag only "
+            f"affects the CPU backend — on a 1-chip accelerator run "
+            f"this on the pod)")
+    hi, lo = rows[-1], rows[0]
+    on_tpu = jax.default_backend() == "tpu"
+    pwb = [r["sharded"]["per_worker_bytes"] for r in rows]
+    out = {
+        "metric": "per_worker_checkpoint_bytes_ratio_at_max_workers",
+        "value": round(hi["sharded"]["per_worker_bytes"]
+                       / max(hi["monolithic"]["per_worker_bytes"], 1), 4),
+        "unit": (f"largest shard file over the full state at n="
+                 f"{hi['workers']} workers — the per-worker save/restore "
+                 f"share the O(1/n_workers) wall-time claim rides on "
+                 f"(exact on any backend, like the HBM byte ledger)"),
+        "per_worker_bytes_decreasing_with_workers": all(
+            a > b for a, b in zip(pwb, pwb[1:])),
+        "sharded_wall_decreases_with_workers": (
+            hi["sharded"]["save_restore_ms"]
+            < lo["sharded"]["save_restore_ms"]),
+        "save_restore_ms_ratio_vs_monolithic_at_max_workers": round(
+            hi["sharded"]["save_restore_ms"]
+            / max(hi["monolithic"]["save_restore_ms"], 1e-9), 4),
+        "bytes_equal": all(r["bytes_equal"] for r in rows),
+        "structure_proof": not on_tpu,
+        "note": (None if on_tpu else
+                 "CPU structure proof: the WALL-TIME halves of the "
+                 "acceptance (save+restore decreasing with workers; "
+                 "stage-1 blocking under the 691 ms BENCH_r07 baseline) "
+                 "cannot be shown on one host — fetch_global here is a "
+                 "zero-copy view and one disk serializes the parallel "
+                 "writes — so this artifact carries the exact structural "
+                 "halves instead: restored maps bitwise-identical across "
+                 "layouts, logical bytes equal, and the per-worker "
+                 "byte share falling as 1/n. Rerun `bench.py "
+                 "--ckpt-shard` on the pod (gs:// checkpoint_dir) to "
+                 "stamp the wall-time curve."),
     }
     if out_path:
         with open(out_path, "w") as f:
@@ -1881,6 +2109,10 @@ def main() -> None:
                    help="r6 overlap-and-fuse audit: host-fed rounds with "
                    "the prefetch/donation/Pallas levers toggled one at a "
                    "time + per-round breakdown; writes BENCH_r06")
+    p.add_argument("--ckpt-shard", action="store_true",
+                   help="sharded vs monolithic checkpoint save/restore "
+                   "wall time vs worker count + bitwise/byte-ledger "
+                   "equality; writes BENCH_CKPT_SHARD")
     p.add_argument("--sharding", action="store_true",
                    help="r7 NamedSharding audit: replica vs logical vs "
                    "ZeRO-1-momentum trainer arms — img/s, per-device "
@@ -1928,6 +2160,8 @@ def main() -> None:
         import jax as _jax
         mfu_bench(batch=args.batch or BATCH, tau=args.tau,
                   small=_jax.default_backend() != "tpu")
+    elif args.ckpt_shard:
+        ckpt_shard_bench()
     elif args.sharding:
         sharding_bench()
     elif args.elastic:

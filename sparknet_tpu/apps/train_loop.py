@@ -167,6 +167,7 @@ def train(cfg: RunConfig, spec: NetSpec, train_ds: ArrayDataset,
                           mode=cfg.mode, compute_health=compute_health,
                           elastic_tau=elastic_tau,
                           donate_batches=cfg.donate_batches,
+                          fused_boundary=cfg.fused_boundary,
                           ops=OpsImpl(lrn=cfg.lrn_impl,
                                       pool=cfg.pool_impl,
                                       interpret=cfg.ops_interpret),
@@ -279,6 +280,14 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     ElasticRelaunch) and resume from the newest periodic checkpoint."""
     n_dev = trainer.n_devices
     n_local = getattr(trainer, "n_local_devices", n_dev)
+    # validated at LOOP ENTRY, not at the first save 25 rounds in — the
+    # OpsImpl/ElasticConfig fail-at-build rule: a typo'd knob must not
+    # cost a run its work (or, with checkpointing off, go unreported)
+    if str(getattr(cfg, "checkpoint_sharded", "auto")) not in (
+            "auto", "on", "off"):
+        raise ValueError(
+            f"checkpoint_sharded={cfg.checkpoint_sharded!r}: expected "
+            f"'auto', 'on', or 'off'")
     if getattr(log, "worker", None) is None and jax.process_count() > 1:
         # stamp this process's JSONL records with its worker id so the
         # pod summary view can merge the N per-host files
@@ -614,15 +623,28 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     # time itself into the row it is writing.
     _last_flush_ms = [0.0]
 
+    # async collect (r8): with cfg.collect_async the deferred fetch below
+    # runs on a dedicated single-thread collector, so the round loop
+    # NEVER blocks on boundary results — t_collect_ms in the breakdown
+    # reads ~0 (the loop only enqueues a record) and the real off-thread
+    # wait lands as t_collect_bg_ms. FIFO order preserves the JSONL/log
+    # row ordering; every boundary (eval, checkpoint, recovery, resize,
+    # loop exit) drains the queue first, so supervisor decisions and
+    # row ordering are exactly the synchronous loop's, one cadence late
+    # at worst — which the deferred fetch already was.
+    collect_async = bool(getattr(cfg, "collect_async", False))
+
     def flush_round_log(rec) -> None:
         """Emit round R's metrics. `float(loss)` here is the pipeline's
         REAL synchronization — deferred one round so round R+1's dispatch
         overlaps round R's device execution (the reference fetched loss
         synchronously every round and stalled the accelerator; on a TPU the
-        dispatch+fetch round trip is a large fraction of a round). The
-        health scalars ride the same deferred fetch: classification
-        happens here, so anomaly detection costs no extra per-round sync
-        and latches a recovery decision at the same log_every cadence."""
+        dispatch+fetch round trip is a large fraction of a round), and
+        since r8 dispatched onto the collector thread (collect_async) so
+        the loop never blocks on it at all. The health scalars ride the
+        same deferred fetch: classification happens here, so anomaly
+        detection costs no extra per-round sync and latches a recovery
+        decision at the same log_every cadence."""
         t_flush0 = time.perf_counter()
         rnd_, loss_, probe_, health_, breakdown_ = rec
         t_c0 = time.perf_counter()
@@ -630,7 +652,16 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         t_collect = time.perf_counter() - t_c0
         kv: Dict[str, Any] = {}
         if breakdown_ is not None:
-            breakdown_["collect"] = t_collect
+            if collect_async:
+                # the round loop's blocking share is the enqueue: ~0.
+                # The fetch above still happened — on THIS collector
+                # thread, overlapped with the device round — and is
+                # attributed separately so a slow store of health
+                # scalars stays visible.
+                breakdown_["collect"] = 0.0
+                breakdown_["collect_bg"] = t_collect
+            else:
+                breakdown_["collect"] = t_collect
             breakdown_["log"] = _last_flush_ms[0] / 1e3
             kv.update({f"t_{k}_ms": round(v * 1e3, 3)
                        for k, v in breakdown_.items()})
@@ -709,10 +740,30 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
     # log_every=1 it is noise, but a high-K flush (or the abort-path drain
     # of a long deferred backlog) must not pay quadratic host time.
     deferred: deque = deque()
+    collector = (ThreadPoolExecutor(1, thread_name_prefix="collect")
+                 if collect_async else None)
+    collect_pending: deque = deque()  # in-flight collector futures (FIFO)
 
-    def flush_deferred() -> None:
+    def flush_deferred(wait: bool = True) -> None:
+        """Flush every deferred record: inline (synchronous collect), or
+        by handing them to the collector thread. `wait=False` — the
+        in-round path only — returns without joining, so the loop never
+        blocks on a boundary result; every other call site drains (the
+        deferred fetch's ordering/decision points), re-raising a
+        collector failure loudly. A bounded in-flight window keeps a
+        slow store from piling up device-scalar records."""
+        if collector is None:
+            while deferred:
+                flush_round_log(deferred.popleft())
+            return
         while deferred:
-            flush_round_log(deferred.popleft())
+            collect_pending.append(
+                collector.submit(flush_round_log, deferred.popleft()))
+            while len(collect_pending) > max(4, 2 * log_every):
+                collect_pending.popleft().result()
+        if wait:
+            while collect_pending:
+                collect_pending.popleft().result()
 
     def recover(state):
         """Roll back to the newest VERIFIED non-anomalous checkpoint.
@@ -1011,7 +1062,11 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
                     # (donation invalidates the old state buffers)
                     probe_val = probe(state) if probe else None
                     if len(deferred) >= log_every:
-                        flush_deferred()  # sync on rounds <= rnd-1
+                        # collect_async: enqueue only — the collector
+                        # thread syncs on rounds <= rnd-1 while this
+                        # loop dispatches ahead. Sync mode blocks here
+                        # (the pre-r8 pipeline's one-round overlap).
+                        flush_deferred(wait=False)
             if profile_this:
                 log.log(f"profiler trace written to {cfg.profile_dir}", rnd)
             # steady state (log_every=1), this measures one device round:
@@ -1084,6 +1139,16 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
         if pending is not None:
             pending.cancel()
         prefetch.shutdown(wait=False, cancel_futures=True)
+        if collector is not None:
+            # drain the collector (its queue may hold the abort-path
+            # records just submitted above); a failed flush must not
+            # mask the propagating exception
+            try:
+                while collect_pending:
+                    collect_pending.popleft().result()
+            except Exception:
+                pass
+            collector.shutdown(wait=True)
         if hasattr(source, "close"):
             source.close()
         try:
@@ -1274,6 +1339,34 @@ def _health_state(retry: int, lr_scale: float,
             "rollbacks": int(rollbacks)}
 
 
+def _sharded_save_enabled(cfg: RunConfig, trainer, state) -> bool:
+    """Resolve cfg.checkpoint_sharded for this trainer/state. "auto":
+    sharded for multi-device layer-IR trainers (the state carries
+    NamedShardings to key the piece plan on); monolithic for the graph
+    backend and single-device runs, where there is nothing to split.
+    "on" forces and fails loudly where the plan has no shardings to read;
+    "off" restores the monolithic fetch_global path wholesale."""
+    knob = str(getattr(cfg, "checkpoint_sharded", "off"))
+    if knob not in ("auto", "on", "off"):
+        raise ValueError(f"checkpoint_sharded={knob!r}: expected "
+                         f"'auto', 'on', or 'off'")
+    if knob == "off":
+        return False
+    placed = hasattr(trainer, "mesh") and all(
+        isinstance(leaf, jax.Array)
+        for leaf in jax.tree_util.tree_leaves(state))
+    if knob == "on":
+        if not placed:
+            raise ValueError(
+                "checkpoint_sharded='on' needs a mesh trainer with "
+                "device-placed state (the shard plan is keyed on each "
+                "leaf's NamedSharding) — the graph backend and host "
+                "states save monolithically")
+        return True
+    return (placed and getattr(trainer, "state_layout", None) is not None
+            and trainer.n_devices > 1)
+
+
 def _save_checkpoint(cfg: RunConfig, trainer, state, step: int,
                      retain: bool = True, source=None,
                      last_round: Optional[int] = None,
@@ -1281,15 +1374,21 @@ def _save_checkpoint(cfg: RunConfig, trainer, state, step: int,
                      health_state: Optional[Dict[str, Any]] = None,
                      writer: Optional[ckpt.AsyncCheckpointWriter] = None
                      ) -> None:
-    """Two-stage checkpoint save. Stage 1 (here, blocking, collective —
-    every host must call this): allgather the state to host buffers and
-    snapshot the stream cursors. Momentum is worker-local, so the gather
-    is substantive, not a replica read. Stage 2 (serialize + digest +
-    persist, process 0 only): inline when `writer` is None, else handed to
-    the background writer thread so the round loop resumes as soon as the
-    host buffers exist — the snapshot is immutable numpy, so later rounds
-    can't tear it. The saved bytes, digests, and tagging are IDENTICAL in
-    both modes.
+    """Two-stage checkpoint save. Stage 1 (here, blocking — every host
+    must call this): snapshot the state to host buffers and the stream
+    cursors. Since r8 the default stage 1 is GATHER-FREE
+    (`fetch_state_shards`): each worker materializes only the distinct
+    state pieces its own devices hold — never the full state on one host
+    — and stage 2 writes them as parallel per-shard files with a
+    manifest commit marker (`ckpt.save_sharded`). The monolithic
+    `fetch_global` allgather remains the fallback (graph backend, one
+    device, cfg.checkpoint_sharded="off"); restores read both layouts
+    bit-identically. Stage 2 (serialize + digest + persist) is inline
+    when `writer` is None, else handed to the background writer thread
+    so the round loop resumes as soon as the host buffers exist — the
+    snapshot is immutable numpy, so later rounds can't tear it. The
+    saved logical bytes, digests, and tagging are IDENTICAL in both
+    modes.
 
     The saved topology (device count, tp) lets a differently-sized job
     resume elastically; streaming sources also record their per-host
@@ -1297,21 +1396,53 @@ def _save_checkpoint(cfg: RunConfig, trainer, state, step: int,
     `anomalous=True` tags a checkpoint taken during an unhealthy training
     window (recent spike/nonfinite rounds) so the health supervisor's
     rollback skips it."""
-    host_state = fetch_global(state)
-    if writer is not None:
-        # the background writer must OWN its bytes: np.asarray on a CPU-
-        # backend jax array can be a zero-copy VIEW of the device buffer,
-        # and the next round's jitted step DONATES that buffer — the sync
-        # path finished serializing before the donation could reuse it,
-        # but stage 2 overlaps later rounds. One defensive memcpy of any
-        # non-owning leaf (~50 ms for a 244 MB state, still ~1000x under
-        # the sync stall); real-device fetches already own their memory
-        # and copy nothing here.
-        host_state = jax.tree.map(
-            lambda a: a if a.flags["OWNDATA"] else np.array(a), host_state)
+    sharded = _sharded_save_enabled(cfg, trainer, state)
+    snapshot = host_state = None
+    if sharded:
+        if jax.process_count() > 1:
+            # multi-process stage-1 cleanup (decommit an overwritten
+            # step, clear the step's stale files + commit reports,
+            # sweep orphans) fenced on BOTH sides: first every process
+            # drains its own in-flight stage-2 write and barriers (the
+            # previous step's uncommitted shard files must never read
+            # as sweepable orphans mid-write — writer.submit would
+            # have waited anyway, the backpressure just lands a beat
+            # earlier), then process 0 cleans, then a second barrier
+            # orders the cleanup before any peer's stage-2 writes
+            from jax.experimental import multihost_utils
+            if writer is not None:
+                writer.wait()
+            multihost_utils.sync_global_devices(
+                f"sharded_ckpt_drain_{step}")
+            if jax.process_index() == 0:
+                ckpt.prepare_sharded_step(cfg.checkpoint_dir, step)
+            multihost_utils.sync_global_devices(
+                f"sharded_ckpt_prepare_{step}")
+        # gather-free stage 1: per-shard host pieces, async D2H first;
+        # own_data deep-copies any piece view still aliasing a device
+        # buffer (donation may reuse it under the async stage 2)
+        from ..parallel.mesh import fetch_state_shards
+        snapshot = fetch_state_shards(state, trainer.mesh)
+    else:
+        host_state = fetch_global(state)
+        if writer is not None:
+            # the background writer must OWN its bytes: np.asarray on a
+            # CPU-backend jax array can be a zero-copy VIEW of the device
+            # buffer, and the next round's jitted step DONATES that
+            # buffer — the sync path finished serializing before the
+            # donation could reuse it, but stage 2 overlaps later rounds.
+            # One defensive memcpy of any non-owning leaf (~50 ms for a
+            # 244 MB state, still ~1000x under the sync stall);
+            # real-device fetches already own their memory and copy
+            # nothing here. (The sharded path owns its pieces already —
+            # fetch_state_shards' own_data default.)
+            host_state = jax.tree.map(
+                lambda a: a if a.flags["OWNDATA"] else np.array(a),
+                host_state)
     stream = _stream_rows(source, last_round) if source is not None else None
-    if jax.process_index() != 0:
-        return
+    if jax.process_index() != 0 and not sharded:
+        return  # monolithic: process 0 is the only writer; sharded:
+        #         every process persists its own shard files
 
     def persist() -> None:
         extra = {"n_devices": trainer.n_devices,
@@ -1332,8 +1463,14 @@ def _save_checkpoint(cfg: RunConfig, trainer, state, step: int,
             extra["anomalous"] = True
         if health_state is not None:
             extra["health"] = health_state
-        ckpt.save(cfg.checkpoint_dir, host_state, step=step, extra=extra)
-        if retain:
+        if sharded:
+            ckpt.save_sharded(
+                cfg.checkpoint_dir, snapshot, step=step, extra=extra,
+                metrics=writer.note_write if writer is not None else None)
+        else:
+            ckpt.save(cfg.checkpoint_dir, host_state, step=step,
+                      extra=extra)
+        if retain and jax.process_index() == 0:
             try:
                 ckpt.retain(cfg.checkpoint_dir, keep=3)
             except Exception as e:

@@ -41,9 +41,12 @@ from typing import Any, Dict, List, Optional
 
 from .pod import flag_stragglers
 
-#: step-time breakdown columns, in pipeline order (emitted by run_loop)
+#: step-time breakdown columns, in pipeline order (emitted by run_loop).
+#: t_collect_ms is the round loop's BLOCKING share of the deferred
+#: loss/health fetch — ~0 under collect_async (r8), where the fetch
+#: itself runs on the collector thread and lands as t_collect_bg_ms
 BREAKDOWN_FIELDS = ("t_data_ms", "t_h2d_ms", "t_round_ms", "t_collect_ms",
-                    "t_ckpt_fetch_ms", "t_log_ms")
+                    "t_collect_bg_ms", "t_ckpt_fetch_ms", "t_log_ms")
 
 
 def load_records(paths: List[str]) -> List[Dict[str, Any]]:

@@ -186,6 +186,13 @@ def fetch_global(tree):
     EVERY process — the collective the checkpoint writer needs (momentum is
     worker-local state, so this is a real allgather, not a replica read).
 
+    Since r8 this is the MONOLITHIC FALLBACK: the default checkpoint
+    path is `fetch_state_shards` below, which never materializes the
+    full state on any host — each worker fetches only the distinct
+    pieces its own devices hold and writes its own shard file. This
+    full gather remains for the graph backend, single-device runs, and
+    `checkpoint_sharded="off"`.
+
     Single-process, the device->host copies for ALL leaves are started
     asynchronously FIRST (`copy_to_host_async`), then materialized: the
     transfers overlap each other (and whatever the device is still
@@ -202,6 +209,140 @@ def fetch_global(tree):
         return jax.tree.map(np.asarray, tree)
     from jax.experimental import multihost_utils
     return multihost_utils.process_allgather(tree, tiled=True)
+
+
+def fetch_state_shards(tree, mesh: Mesh, own_data: bool = True) -> dict:
+    """Stage 1 of a SHARDED checkpoint save — the gather-free replacement
+    for `fetch_global`: instead of allgathering the full state to every
+    host, fetch only the DISTINCT pieces of each leaf (one representative
+    per replica group, owner = the lowest-ranked device holding it in
+    mesh order) and tag each with the shard FILE it belongs to (file id =
+    owning device's rank). Fully-replicated leaves are chunked along
+    their leading dim across the files so no byte is written twice and
+    the files stay balanced — total bytes across shard files equal the
+    monolithic layout's exactly.
+
+    Device→host copies for every piece are started asynchronously first
+    (`copy_to_host_async`, the r7 stage-1 overlap), then materialized.
+    `own_data=True` (the default) deep-copies any leaf whose host view
+    still aliases a device buffer — the async stage-2 writer overlaps
+    later rounds, and the round's donation may reuse that buffer (same
+    OWNDATA rule as the monolithic writer path).
+
+    Multi-host: each process materializes only the pieces its own devices
+    own (`pieces` carry arr=None for foreign ones — `checkpoint.
+    save_sharded` writes my files, process 0 commits the manifest), so
+    per-host stage-1 bytes are O(state/n_processes) for sharded leaves.
+    Returns the snapshot dict `checkpoint.save_sharded` consumes:
+    {"n_shards", "owners": {file: process}, "process_index",
+    "process_count", "leaves": {key: {"shape", "dtype", "pieces":
+    [(file_id, offsets, shape, arr|None), ...]}}}."""
+    from ..utils.checkpoint import _path_str  # no cycle: checkpoint is leaf
+
+    devices = list(mesh.devices.flat)
+    n = len(devices)
+    rank = {d: i for i, d in enumerate(devices)}
+    my_pi = jax.process_index()
+    owners = {i: int(d.process_index) for i, d in enumerate(devices)}
+
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        flat["/".join(_path_str(p) for p in path)] = leaf
+
+    def owned(a: np.ndarray) -> np.ndarray:
+        if own_data and not a.flags["OWNDATA"]:
+            return np.array(a)
+        return a
+
+    # pass 1: plan every leaf's pieces + start the async D2H copies
+    plans = {}
+    for key, leaf in flat.items():
+        if isinstance(leaf, jax.Array) and not isinstance(leaf, np.ndarray):
+            local = {s.device: s for s in leaf.addressable_shards}
+            idx_map = leaf.sharding.devices_indices_map(leaf.shape)
+            groups: dict = {}  # normalized index -> owner device
+            for d, idx in idx_map.items():
+                if d not in rank:
+                    continue  # a sharding over a sub-mesh never happens,
+                    # but never mis-file a foreign device's piece
+                norm = tuple(
+                    (int(s.start or 0),
+                     int(s.stop if s.stop is not None else dim))
+                    for s, dim in zip(idx, leaf.shape))
+                cur = groups.get(norm)
+                if cur is None or rank[d] < rank[cur]:
+                    groups[norm] = d
+            replicated = (len(groups) == 1 and all(
+                lo == 0 and hi == dim for (lo, hi), dim in
+                zip(next(iter(groups)), leaf.shape)))
+            if replicated:
+                src = local.get(next(iter(groups.values())),
+                                next(iter(local.values()), None))
+                if src is not None:
+                    try:
+                        src.data.copy_to_host_async()
+                    except Exception:
+                        pass
+                plans[key] = ("replicated", leaf, src)
+            else:
+                mine = []
+                for norm, d in sorted(groups.items(),
+                                      key=lambda kv: rank[kv[1]]):
+                    sh = local.get(d)
+                    if sh is not None:
+                        try:
+                            sh.data.copy_to_host_async()
+                        except Exception:
+                            pass
+                    mine.append((norm, d, sh))
+                plans[key] = ("sharded", leaf, mine)
+        else:
+            plans[key] = ("replicated", np.asarray(leaf), None)
+
+    # pass 2: materialize + assemble the piece lists
+    leaves = {}
+    for key, plan in plans.items():
+        kind, leaf, info = plan
+        shape, dtype = tuple(leaf.shape), np.dtype(leaf.dtype)
+        pieces = []
+        if kind == "sharded":
+            for norm, d, sh in info:
+                offsets = tuple(lo for lo, _ in norm)
+                pshape = tuple(hi - lo for lo, hi in norm)
+                arr = (owned(np.asarray(sh.data))
+                       if sh is not None and owners[rank[d]] == my_pi
+                       else None)
+                pieces.append((rank[d], offsets, pshape, arr))
+        else:
+            full = None
+            if info is not None:  # jax leaf: one local replica
+                full = owned(np.asarray(info.data))
+            elif isinstance(leaf, np.ndarray):
+                full = leaf
+            if shape == () or (shape and shape[0] == 0) or n == 1:
+                arr = full if owners[0] == my_pi else None
+                pieces.append((0, (0,) * len(shape), shape, arr))
+            else:
+                # chunk the replicated value across the shard files:
+                # contiguous leading-dim blocks, sizes differing by <= 1
+                lo = 0
+                for j, chunk in enumerate(
+                        np.array_split(np.arange(shape[0]),
+                                       min(n, shape[0]))):
+                    size = len(chunk)
+                    if not size:
+                        continue
+                    arr = (full[lo:lo + size]
+                           if full is not None and owners[j] == my_pi
+                           else None)
+                    pieces.append((j, (lo,) + (0,) * (len(shape) - 1),
+                                   (size,) + shape[1:], arr))
+                    lo += size
+        leaves[key] = {"shape": shape, "dtype": dtype, "pieces": pieces}
+    return {"n_shards": n, "owners": owners,
+            "process_index": int(my_pi),
+            "process_count": int(jax.process_count()),
+            "leaves": leaves}
 
 
 def per_device_state_bytes(state) -> dict:

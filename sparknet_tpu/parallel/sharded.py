@@ -101,6 +101,7 @@ class ShardedTrainer(ParallelTrainer):
                  compute_health: bool = True, elastic_tau: bool = False,
                  donate_batches: bool = False,
                  ops: Optional[OpsImpl] = None,
+                 fused_boundary: bool = False,
                  state_sharding: str = "replicated"):
         if state_sharding not in STATE_SHARDINGS:
             raise ValueError(f"unknown state_sharding {state_sharding!r}: "
@@ -117,7 +118,8 @@ class ShardedTrainer(ParallelTrainer):
                          loss_blob=loss_blob, acc_blob=acc_blob,
                          compute_health=compute_health,
                          elastic_tau=elastic_tau,
-                         donate_batches=donate_batches, ops=ops)
+                         donate_batches=donate_batches, ops=ops,
+                         fused_boundary=fused_boundary)
 
     def _ctor_extra(self) -> Dict[str, Any]:
         return {"state_sharding": self.state_sharding}

@@ -81,7 +81,8 @@ class ParallelTrainer:
                  loss_blob: str = "loss", acc_blob: Optional[str] = None,
                  compute_health: bool = True, elastic_tau: bool = False,
                  donate_batches: bool = False,
-                 ops: Optional[OpsImpl] = None):
+                 ops: Optional[OpsImpl] = None,
+                 fused_boundary: bool = False):
         assert mode in ("local_sgd", "sync_sgd")
         if mode == "sync_sgd":
             assert tau == 1, "sync_sgd averages every step; tau must be 1"
@@ -153,6 +154,26 @@ class ParallelTrainer:
         # into a buffer the device still owns. Bench/test callers that
         # re-feed one batches dict across rounds must leave this off.
         self.donate_batches = bool(donate_batches)
+        # fused_boundary (r8): peel the FINAL τ step out of the scan so
+        # the boundary weight-averaging pmean (and the ZeRO momentum
+        # average + at-rest re-shard under ShardedTrainer) traces in the
+        # SAME region as the last optimizer update. On TPU the rolled
+        # scan's while-loop boundary otherwise forces the full-params
+        # all-reduce to start strictly after every local step retired;
+        # peeled, XLA's latency-hiding scheduler can overlap the early
+        # layers' boundary collective with the tail of the final update.
+        # The peeled round runs the SAME ops on the same values in the
+        # same order — pinned bitwise against the unfused two-step
+        # (scan-then-average) on the TINY_MLP multi-round trajectory
+        # under BOTH trainer impls, health scalars included
+        # (tests/test_round_pipeline.py), so the shard_map trainer's
+        # semantics are preserved. On conv nets the changed program
+        # SHAPE can shift XLA's fusion tiling at the last ulp (the same
+        # caveat elastic_tau documents) — the loop-level pin holds at
+        # ulp tolerance there. Default OFF for direct-API callers (the
+        # donate_batches rule); RunConfig.fused_boundary (default ON)
+        # flips it for the train loop.
+        self.fused_boundary = bool(fused_boundary)
         # a pallas_call traced inside shard_map has no replication rule,
         # so replication checking goes off exactly when the ops config can
         # route LRN/pool to a Pallas kernel on this backend (explicit
@@ -554,9 +575,29 @@ class ParallelTrainer:
         step_rngs = jax.random.split(rng, self.tau)
         xs = ((batches, step_rngs) if my_tau is None
               else (batches, step_rngs, jnp.arange(self.tau)))
-        (params, sstate), (losses, grad_sqs) = lax.scan(
-            local_step, (params, SolverState(momentum=momentum, it=it)),
-            xs, unroll=scan_unroll(self.tau))
+        init = (params, SolverState(momentum=momentum, it=it))
+        if self.fused_boundary:
+            # fused τ-boundary (ctor comment): τ-1 scanned steps, then
+            # the final step PEELED inline so the boundary average below
+            # shares its trace region — same math, same order, bitwise
+            carry = init
+            if self.tau > 1:
+                carry, (losses, grad_sqs) = lax.scan(
+                    local_step, carry,
+                    jax.tree.map(lambda x: x[:-1], xs),
+                    unroll=scan_unroll(self.tau - 1))
+                carry, (loss_t, gs_t) = local_step(
+                    carry, jax.tree.map(lambda x: x[-1], xs))
+                losses = jnp.concatenate([losses, loss_t[None]])
+                grad_sqs = jnp.concatenate([grad_sqs, gs_t[None]])
+            else:  # τ=1: the whole round is scan-free
+                carry, (loss_t, gs_t) = local_step(
+                    carry, jax.tree.map(lambda x: x[-1], xs))
+                losses, grad_sqs = loss_t[None], gs_t[None]
+            params, sstate = carry
+        else:
+            (params, sstate), (losses, grad_sqs) = lax.scan(
+                local_step, init, xs, unroll=scan_unroll(self.tau))
 
         # pre-average view: after the pmean one poisoned worker's NaN is
         # every worker's NaN, so ATTRIBUTION must read the worker-local
@@ -747,6 +788,7 @@ class ParallelTrainer:
             mode=self.mode, loss_blob=self.loss_blob, acc_blob=self.acc_blob,
             compute_health=self.compute_health, elastic_tau=self.elastic_tau,
             donate_batches=self.donate_batches, ops=self.ops,
+            fused_boundary=self.fused_boundary,
             **self._ctor_extra())
 
     def _ctor_extra(self) -> Dict[str, Any]:

@@ -7,11 +7,14 @@ prefix), so a pod training into a bucket and a serving fleet reading from
 it need no extra copy step — and hot-swaps weights between batches:
 
   - a new step is loaded through `restore_flat(step=...)`, which
-    re-verifies every per-array SHA-256 digest: a torn upload or a byte
-    flipped at rest is REJECTED (`CheckpointCorruptError`) and the server
-    keeps answering from the current weights; the bad step goes on a
-    cooldown so the poll loop doesn't re-download a corrupt 244 MB
-    snapshot every 2 seconds.
+    re-verifies every digest (per-array for monolithic saves, per-shard
+    for the r8 SHARD-MANIFEST layout training writes by default — the
+    loader reassembles the exact flat map, so hot-swap is layout-blind
+    and the parallel per-worker checkpoint files serve as-is): a torn
+    upload or a byte flipped at rest is REJECTED
+    (`CheckpointCorruptError`) and the server keeps answering from the
+    current weights; the bad step goes on a cooldown so the poll loop
+    doesn't re-download a corrupt 244 MB snapshot every 2 seconds.
   - the swap itself happens on the server's worker thread between
     batches, so queued requests never race a half-installed weight set.
   - after installing, an optional CANARY forward runs (zeros batch at

@@ -12,6 +12,24 @@ Format: a directory with
                    "extra": {...}}
 Atomic via write-to-temp + rename. `step-N` naming with retention.
 
+SHARDED layout (r8, the gather-free checkpoint path): instead of one
+state.npz materialized from a full `fetch_global` allgather, a step may
+hold N `shard-xxxxx-of-NNNNN.npz` files — one per mesh device — each
+carrying only the distinct state pieces that device owns (replicated
+leaves are chunked across files so no byte is written twice and the
+files stay balanced). meta.json then carries a SHARD MANIFEST: per-file
+entries ({key: [offsets, shape]}) plus a per-shard digest of the exact
+file bytes, and is still written LAST as the commit marker — a killed
+parallel save leaves meta-less shard files every reader treats as
+not-a-checkpoint and the next save sweeps. `save_sharded` writes the
+files in parallel (stage 1 is `parallel.mesh.fetch_state_shards`, which
+replaces the full-state gather with per-shard host fetches), and
+`restore_flat`/`verify`/`retain` read BOTH layouts transparently: the
+manifest loader reassembles the exact flat {key: array} map a monolithic
+restore returns, bit for bit, so every adapt/resume/serve path is
+layout-blind. Checkpoint wall time becomes O(1/n_workers) and the state
+no longer has to fit one host's RAM on the save side.
+
 The "directory" may be a LOCAL path or a BUCKET URI (`gs://` / `s3://`):
 every public function here accepts both, so pod checkpoints go straight to
 the object store over the same native HTTP clients the data plane streams
@@ -158,7 +176,7 @@ def _join(directory: str, *names: str) -> str:
 # its keep-window (a flipped byte that updates neither mtime_ns nor
 # generation); steps written by other processes keep the full at-rest
 # guarantee, and every restore/rollback path still verifies for real.
-_written_verified: Dict[str, Tuple[int, Tuple]] = {}
+_written_verified: Dict[str, Tuple[int, Dict[str, Tuple]]] = {}
 
 
 def _cache_key(directory: str) -> str:
@@ -166,11 +184,12 @@ def _cache_key(directory: str) -> str:
             else os.path.abspath(directory))
 
 
-def _state_fingerprint(directory: str, step: int) -> Optional[Tuple]:
-    """Freshness token of step-N/state.npz: ("local", size, mtime_ns) or
+def _state_fingerprint(directory: str, step: int,
+                       name: str = "state.npz") -> Optional[Tuple]:
+    """Freshness token of one step file: ("local", size, mtime_ns) or
     ("bucket", size, generation|ETag). None when unreadable — the caller
     treats that as a cache miss, never as verified."""
-    url = _join(directory, f"step-{int(step)}", "state.npz")
+    url = _join(directory, f"step-{int(step)}", name)
     try:
         if is_bucket_path(directory):
             size, gen = _bucket_ops(directory).stat(url, fresh=True)
@@ -181,23 +200,32 @@ def _state_fingerprint(directory: str, step: int) -> Optional[Tuple]:
         return None
 
 
-def _record_written(directory: str, step: int) -> None:
-    fp = _state_fingerprint(directory, step)
+def _record_written(directory: str, step: int,
+                    files: Tuple[str, ...] = ("state.npz",)) -> None:
+    fps: Dict[str, Tuple] = {}
     key = _cache_key(directory)
-    if fp is None:
-        _written_verified.pop(key, None)
-    else:
-        _written_verified[key] = (int(step), fp)
+    for name in files:
+        fp = _state_fingerprint(directory, step, name)
+        if fp is None:
+            _written_verified.pop(key, None)
+            return
+        fps[name] = fp
+    _written_verified[key] = (int(step), fps)
 
 
 def _written_verified_hit(directory: str, step: int) -> bool:
-    """True when `step` is the one this process last wrote here AND its
-    stored state.npz still carries the fingerprint captured at write time
-    (nobody rewrote it since)."""
+    """True when `step` is the one this process last wrote here AND every
+    stored state file (state.npz, or all shard files of a sharded save)
+    still carries the fingerprint captured at write time (nobody rewrote
+    any since)."""
     cached = _written_verified.get(_cache_key(directory))
     if cached is None or cached[0] != int(step):
         return False
-    return _state_fingerprint(directory, step) == cached[1]
+    fps = cached[1]
+    if not isinstance(fps, dict):  # legacy single-file token (tests)
+        fps = {"state.npz": fps}
+    return all(_state_fingerprint(directory, step, n) == fp
+               for n, fp in fps.items())
 
 
 def invalidate_written_cache(directory: Optional[str] = None) -> None:
@@ -250,12 +278,16 @@ def _delete_step(directory: str, step: int) -> None:
             pass  # retention is best-effort; the next retain re-sweeps
 
 
-def _sweep_stale_tmp(directory: str) -> None:
-    """Remove `.tmp-*` work directories left behind by a previous process
-    killed mid-save (e.g. the chaos test's SIGKILL between mkdtemp and
-    rename) — otherwise they leak in checkpoint_dir forever. Only one
-    writer per directory is supported (process 0 of one run), so any
-    existing tmp dir is stale by definition."""
+def _sweep_stale_tmp(directory: str,
+                     current_step: Optional[int] = None) -> None:
+    """Remove leftovers of a previous writer killed mid-save: `.tmp-*`
+    work directories (SIGKILL between mkdtemp and rename), and — since
+    the sharded layout's multi-process path writes shard files directly
+    into `step-N/` with meta.json landing last — step directories WITHOUT
+    a meta.json commit marker (orphan shard files; every reader already
+    treats such a step as not-a-checkpoint). The step currently being
+    written is never swept. One writer per directory per process role is
+    supported, so anything else meta-less is stale by definition."""
     try:
         entries = os.listdir(directory)
     except OSError:
@@ -263,6 +295,14 @@ def _sweep_stale_tmp(directory: str) -> None:
     for d in entries:
         if d.startswith(".tmp-"):
             shutil.rmtree(os.path.join(directory, d), ignore_errors=True)
+            continue
+        if d.startswith("step-") and d.split("-", 1)[1].isdigit():
+            s = int(d.split("-", 1)[1])
+            if current_step is not None and s == int(current_step):
+                continue
+            if not os.path.exists(os.path.join(directory, d, "meta.json")):
+                shutil.rmtree(os.path.join(directory, d),
+                              ignore_errors=True)
 
 
 def _prepare_save(tree: Any, step: int, extra: Optional[Dict[str, Any]]
@@ -294,7 +334,7 @@ def save(directory: str, tree: Any, *, step: int,
     if is_bucket_path(directory):
         return _save_bucket(directory, tree, step=step, extra=extra)
     os.makedirs(directory, exist_ok=True)
-    _sweep_stale_tmp(directory)
+    _sweep_stale_tmp(directory, current_step=step)
     flat, meta = _prepare_save(tree, step, extra)
     tmp = tempfile.mkdtemp(dir=directory, prefix=".tmp-")
     try:
@@ -325,18 +365,9 @@ def _save_bucket(directory: str, tree: Any, *, step: int,
     ops = _bucket_ops(directory)
     final = _join(directory, f"step-{int(step)}")
     # sweep orphans of crashed earlier saves: any step with state but no
-    # meta never committed, and stray .part- components never composed.
-    # Best-effort — a transient delete failure must not abort a save
-    # whose own uploads would succeed; the next save re-sweeps
-    for s, files in _bucket_step_files(directory).items():
-        for f in files:
-            if ".part-" in f or ("meta.json" not in files):
-                try:
-                    ops.delete(_join(directory, f"step-{s}", f))
-                except Exception as e:
-                    warnings.warn(f"checkpoint orphan sweep: could not "
-                                  f"delete step-{s}/{f}: {e}",
-                                  RuntimeWarning)
+    # meta never committed, and stray .part- components never composed
+    # (one sweep policy shared with the sharded layout's commit paths)
+    _sweep_bucket_orphans(directory, ops, _bucket_step_files(directory))
     flat, meta = _prepare_save(tree, step, extra)
     buf = io.BytesIO()
     np.savez(buf, **flat)
@@ -348,6 +379,365 @@ def _save_bucket(directory: str, tree: Any, *, step: int,
     ops.write_large(f"{final}/state.npz", buf.getbuffer())
     ops.write(f"{final}/meta.json", json.dumps(meta).encode())
     _record_written(directory, step)
+    return final
+
+
+# -- sharded layout: per-worker shard files + manifest commit marker ---------
+
+def shard_file_name(i: int, n: int) -> str:
+    return f"shard-{int(i):05d}-of-{int(n):05d}.npz"
+
+
+def sharded_nbytes(sharded: Dict[str, Any]) -> int:
+    """Total LOGICAL state bytes a sharded snapshot will persist — by
+    construction identical to the monolithic layout's sum of array bytes
+    (every distinct piece written exactly once, replicated leaves never
+    duplicated). The BENCH ledger both layouts are compared on."""
+    return sum(int(np.prod(rec["shape"])) * np.dtype(rec["dtype"]).itemsize
+               for rec in sharded["leaves"].values())
+
+
+def _serialize_shard(pieces: Dict[str, Tuple[Tuple[int, ...], np.ndarray]]
+                     ) -> Tuple[bytes, str]:
+    """One shard file's (npz bytes, sha256 hex). Keys are the flat state
+    keys; each file holds at most one piece per key (the piece plan
+    guarantees it), so the piece offsets live in the MANIFEST, not here."""
+    buf = io.BytesIO()
+    np.savez(buf, **{k: arr for k, (_, arr) in pieces.items()})
+    raw = buf.getvalue()
+    return raw, hashlib.sha256(raw).hexdigest()
+
+
+def _sharded_meta(sharded: Dict[str, Any], step: int,
+                  extra: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Manifest meta.json for a sharded save — the piece PLAN is
+    deterministic from (global shape, sharding), so every process can
+    build the full manifest; only the per-file digests need the writers'
+    reports. Extension dtypes store as same-width uint views with the
+    real name in ext_dtypes, exactly like the monolithic format."""
+    n = int(sharded["n_shards"])
+    files: Dict[int, Dict[str, list]] = {}
+    glob: Dict[str, Dict[str, Any]] = {}
+    ext_dtypes: Dict[str, str] = {}
+    for key, rec in sharded["leaves"].items():
+        dt = np.dtype(rec["dtype"])
+        if _is_extension_dtype(dt):
+            ext_dtypes[key] = dt.name
+            dt = np.dtype(_UINT_OF_SIZE[dt.itemsize])
+        glob[key] = {"shape": [int(s) for s in rec["shape"]],
+                     "dtype": dt.name}
+        for fid, offsets, shape, _ in rec["pieces"]:
+            files.setdefault(int(fid), {})[key] = [
+                [int(o) for o in offsets], [int(s) for s in shape]]
+    meta = {"step": int(step), "keys": sorted(sharded["leaves"]),
+            "format": "sharded", "global": glob,
+            "shards": [{"file": shard_file_name(fid, n),
+                        "entries": files[fid]}
+                       for fid in sorted(files)]}
+    if ext_dtypes:
+        meta["ext_dtypes"] = ext_dtypes
+    if extra:
+        meta["extra"] = extra
+    return meta
+
+
+def _shard_payloads(sharded: Dict[str, Any]
+                    ) -> Dict[int, Dict[str, Tuple[Tuple[int, ...],
+                                                   np.ndarray]]]:
+    """{file_id: {key: (offsets, uint-viewed array)}} for the pieces THIS
+    process holds (arr is None for non-local pieces of a multi-host
+    snapshot — those files belong to the process that owns them)."""
+    out: Dict[int, Dict[str, Tuple[Tuple[int, ...], np.ndarray]]] = {}
+    for key, rec in sharded["leaves"].items():
+        dt = np.dtype(rec["dtype"])
+        view = (np.dtype(_UINT_OF_SIZE[dt.itemsize])
+                if _is_extension_dtype(dt) else None)
+        for fid, offsets, shape, arr in rec["pieces"]:
+            if arr is None:
+                continue
+            if view is not None:
+                arr = arr.view(view)
+            out.setdefault(int(fid), {})[key] = (tuple(offsets), arr)
+    return out
+
+
+def save_sharded(directory: str, sharded: Dict[str, Any], *, step: int,
+                 extra: Optional[Dict[str, Any]] = None,
+                 metrics=None, commit_timeout_s: float = 600.0) -> str:
+    """Write checkpoint `step-N` in the SHARDED layout from a
+    `parallel.mesh.fetch_state_shards` snapshot: N shard files written in
+    PARALLEL (threads over the same local/bucket writers), meta.json —
+    the manifest with per-shard digests — committed LAST. Single-process
+    writes everything; multi-process, every process calls this with its
+    own pieces and process 0 commits the manifest once every peer's
+    shard-digest report has landed (`commit-<p>.json` sidecars, removed
+    after commit). `metrics(scope, seconds, ok)` is the per-write
+    instrumentation hook (AsyncCheckpointWriter.note_write: scope
+    "shard" per file, "meta" for the commit marker)."""
+    payloads = _shard_payloads(sharded)
+    meta = _sharded_meta(sharded, step, extra)
+    owners: Dict[int, int] = {int(k): int(v) for k, v in
+                              sharded.get("owners", {}).items()}
+    my_proc = int(sharded.get("process_index", 0))
+    n_procs = int(sharded.get("process_count", 1))
+
+    def timed_write(scope, fn):
+        t0 = time.perf_counter()
+        try:
+            fn()
+        except BaseException:
+            if metrics is not None:
+                metrics(scope, time.perf_counter() - t0, ok=False)
+            raise
+        if metrics is not None:
+            metrics(scope, time.perf_counter() - t0, ok=True)
+
+    files = {shard_file_name(fid, sharded["n_shards"]): pieces
+             for fid, pieces in payloads.items()}
+    if n_procs == 1:
+        if is_bucket_path(directory):
+            return _commit_sharded_bucket(directory, step, files, meta,
+                                          timed_write)
+        return _commit_sharded_local(directory, step, files, meta,
+                                     timed_write)
+    return _commit_sharded_multiproc(directory, step, files, meta,
+                                     owners, my_proc, timed_write,
+                                     commit_timeout_s)
+
+
+def _parallel_file_writes(files: Dict[str, Dict], write_one,
+                          timed_write) -> Dict[str, str]:
+    """Serialize AND write every shard file on a thread pool — both the
+    np.savez/CRC pass and the store I/O parallelize per file (a serial
+    serialize stage would otherwise cap the O(1/n_workers) save-time
+    win). Returns {file name: sha256 of the exact bytes written} for the
+    manifest."""
+    if not files:
+        return {}
+
+    def one(name, pieces):
+        raw, digest = _serialize_shard(pieces)
+        timed_write("shard", lambda: write_one(name, raw))
+        return name, digest
+
+    with ThreadPoolExecutor(min(8, len(files)),
+                            thread_name_prefix="ckpt-shard") as ex:
+        futs = [ex.submit(one, name, pieces)
+                for name, pieces in sorted(files.items())]
+        return dict(f.result() for f in futs)
+
+
+def _stamp_digests(meta: Dict[str, Any], digests: Dict[str, str]) -> None:
+    for rec in meta["shards"]:
+        if rec["file"] not in digests:
+            raise RuntimeError(
+                f"sharded checkpoint step-{meta['step']}: manifest file "
+                f"{rec['file']} was never written")
+        rec["digest"] = digests[rec["file"]]
+
+
+def _commit_sharded_local(directory: str, step: int, files, meta,
+                          timed_write) -> str:
+    """Local single-process sharded save: parallel serialize+write into a
+    `.tmp-*` work dir, meta.json, one atomic rename — same crash story as
+    the monolithic twin (a SIGKILL leaves only a swept-next-save tmp
+    dir)."""
+    os.makedirs(directory, exist_ok=True)
+    _sweep_stale_tmp(directory, current_step=step)
+    tmp = tempfile.mkdtemp(dir=directory, prefix=".tmp-")
+    try:
+        def write_one(name, raw):
+            with open(os.path.join(tmp, name), "wb") as f:
+                f.write(raw)
+
+        _stamp_digests(meta, _parallel_file_writes(files, write_one,
+                                                   timed_write))
+
+        def write_meta():
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump(meta, f)
+
+        timed_write("meta", write_meta)
+        final = os.path.join(directory, f"step-{int(step)}")
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    _record_written(directory, step, files=tuple(sorted(files)))
+    return final
+
+
+def _sweep_bucket_orphans(directory: str, ops,
+                          listing: Dict[int, set]) -> None:
+    """Delete stray `.part-` components and every file of a meta-less
+    (uncommitted/killed) step from a bucket listing — shard files
+    included. Best-effort; the next save re-sweeps."""
+    for s, fs in listing.items():
+        for f in fs:
+            if ".part-" in f or ("meta.json" not in fs):
+                try:
+                    ops.delete(_join(directory, f"step-{s}", f))
+                except Exception as e:
+                    warnings.warn(f"checkpoint orphan sweep: could not "
+                                  f"delete step-{s}/{f}: {e}",
+                                  RuntimeWarning)
+
+
+def _commit_sharded_bucket(directory: str, step: int, files, meta,
+                           timed_write) -> str:
+    """Bucket single-process sharded save: sweep orphans (meta-less steps
+    lose ALL their files, shard files included), decommit + clear an
+    overwritten step (stale shard files from a previous wider save must
+    not pair with the new manifest), parallel shard uploads, meta last."""
+    ops = _bucket_ops(directory)
+    final = _join(directory, f"step-{int(step)}")
+    listing = _bucket_step_files(directory)  # ONE list serves sweep+stat
+    _sweep_bucket_orphans(directory, ops, listing)
+    # an overwritten step's files survive the sweep only when the step
+    # was COMMITTED (meta present) — a meta-less one was just reclaimed
+    step_files = listing.get(int(step), set())
+    existing = step_files if "meta.json" in step_files else set()
+    if existing:
+        # decommit FIRST (unguarded — see _save_bucket), then clear the
+        # old state files so a crash mid-overwrite can never pair a new
+        # manifest with leftover old shards
+        ops.delete(f"{final}/meta.json")
+        for f in existing:
+            if f != "meta.json":
+                try:
+                    ops.delete(f"{final}/{f}")
+                except Exception:
+                    pass  # next save's sweep retries (now meta-less)
+
+    def write_one(name, raw):
+        ops.write_large(f"{final}/{name}", raw)
+
+    _stamp_digests(meta, _parallel_file_writes(files, write_one,
+                                               timed_write))
+    timed_write("meta", lambda: ops.write(f"{final}/meta.json",
+                                          json.dumps(meta).encode()))
+    _record_written(directory, step, files=tuple(sorted(files)))
+    return final
+
+
+def prepare_sharded_step(directory: str, step: int) -> None:
+    """STAGE-1 cleanup for a MULTI-PROCESS sharded save, run by process 0
+    with an EXPLICIT cross-process barrier after it (train_loop calls
+    this then sync_global_devices before any process reaches stage 2, so
+    no peer can have written fresh files this cleanup would delete):
+    decommit an overwritten step's meta.json FIRST (a crash mid-clear
+    must leave not-a-checkpoint, never old-manifest-over-new-shards),
+    then clear ALL the step's remaining files — stale commit-*.json
+    reports of a previous crashed save (the commit poll must never
+    stamp a dead incarnation's digests into the new manifest — and
+    doing this in stage 2 would race peers' FRESH reports, since
+    process 0's writer systematically starts last) AND old shard files
+    (a previous WIDER save's shard-*-of-M must not survive inside the
+    new manifest's committed step) — and sweep meta-less orphan steps.
+    Single-process saves need none of this (their commits are
+    atomic)."""
+    if is_bucket_path(directory):
+        ops = _bucket_ops(directory)
+        listing = _bucket_step_files(directory)
+        step_files = sorted(listing.get(int(step), set()),
+                            key=lambda f: f != "meta.json")  # meta first
+        for f in step_files:
+            try:
+                ops.delete(_join(directory, f"step-{int(step)}", f))
+            except Exception:
+                if f == "meta.json":
+                    raise  # cannot decommit: do not proceed to overwrite
+        _sweep_bucket_orphans(directory, ops, {
+            s: fs for s, fs in listing.items() if s != int(step)})
+        return
+    step_dir = _join(directory, f"step-{int(step)}")
+    if os.path.isdir(step_dir):
+        meta = os.path.join(step_dir, "meta.json")
+        if os.path.exists(meta):
+            os.remove(meta)  # decommit first; a failure here propagates
+        shutil.rmtree(step_dir, ignore_errors=True)
+    _sweep_stale_tmp(directory, current_step=step)
+
+
+def _commit_sharded_multiproc(directory: str, step: int, files, meta,
+                              owners, my_proc, timed_write,
+                              commit_timeout_s: float) -> str:
+    """Multi-process sharded save (stage 2; `prepare_sharded_step` is the
+    process-0 stage-1 half): every process writes its own shard files
+    DIRECTLY under step-N plus a tiny commit-<p>.json digest report;
+    process 0 polls for every expected report, folds the digests into
+    the manifest, commits meta.json last, and removes the reports. A
+    writer killed anywhere leaves a meta-less step the NEXT save's
+    stage-1 sweep reclaims. (Structural multi-host path — single-process
+    runs take the atomic tmp/rename or bucket commit above; driven
+    per-process by tests/test_checkpoint_stores.py.)"""
+    final = _join(directory, f"step-{int(step)}")
+    bucket = is_bucket_path(directory)
+    if bucket:
+        ops = _bucket_ops(directory)
+
+        def write_file(name, raw):
+            (ops.write_large if len(raw) > (1 << 20) else ops.write)(
+                f"{final}/{name}", raw)
+
+        def read_file(name):
+            return ops.read(f"{final}/{name}")
+
+        def delete_file(name):
+            ops.delete(f"{final}/{name}")
+    else:
+        os.makedirs(final, exist_ok=True)
+
+        def write_file(name, raw):
+            tmp = f"{os.path.join(final, name)}.tmp-{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(raw)
+            os.replace(tmp, os.path.join(final, name))
+
+        def read_file(name):
+            with open(os.path.join(final, name), "rb") as f:
+                return f.read()
+
+        def delete_file(name):
+            os.remove(os.path.join(final, name))
+
+    digests = _parallel_file_writes(files, write_file, timed_write)
+    write_file(f"commit-{int(my_proc)}.json",
+               json.dumps(digests).encode())
+    if my_proc != 0:
+        return final
+    expected = sorted(set(owners.values()))
+    all_digests: Dict[str, str] = {}
+    deadline = time.monotonic() + commit_timeout_s
+    for p in expected:
+        while True:
+            try:
+                all_digests.update(json.loads(
+                    read_file(f"commit-{int(p)}.json")))
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"sharded checkpoint step-{step}: worker {p} "
+                        f"never reported its shard digests within "
+                        f"{commit_timeout_s}s — leaving the step "
+                        f"uncommitted (not-a-checkpoint)")
+                time.sleep(0.2)
+    _stamp_digests(meta, all_digests)
+    timed_write("meta", lambda: write_file(
+        "meta.json", json.dumps(meta).encode()))
+    for p in expected:
+        try:
+            delete_file(f"commit-{int(p)}.json")
+        except Exception:
+            pass  # harmless residue inside a committed step
+    # fingerprint every manifest file so retain()'s protect scan costs
+    # one stat per file instead of re-downloading + re-hashing the whole
+    # sharded state on every save (the single-process paths' rule)
+    _record_written(directory, step,
+                    files=tuple(sorted(r["file"] for r in meta["shards"])))
     return final
 
 
@@ -440,6 +830,8 @@ def _load_checkpoint(path: str) -> Tuple[Dict[str, np.ndarray], int,
     meta = _load_meta(path)
     if meta is None:
         raise CheckpointCorruptError(f"{path}: meta.json missing/unreadable")
+    if "shards" in meta:
+        return _load_sharded(path, meta)
     try:
         if is_bucket_path(path):
             # one ranged-GET stream with reconnect-resume (the data
@@ -490,6 +882,108 @@ def _load_checkpoint(path: str) -> Tuple[Dict[str, np.ndarray], int,
                 f"{path}: digest mismatch on {key!r} (stored "
                 f"{want[:12]}…, recomputed {got[:12]}…) — bytes were "
                 f"corrupted at rest or in transit")
+    for key, name in meta.get("ext_dtypes", {}).items():
+        flat[key] = flat[key].view(np.dtype(name))
+    return flat, int(meta["step"]), meta.get("extra", {})
+
+
+def _load_sharded(path: str, meta: Dict[str, Any]
+                  ) -> Tuple[Dict[str, np.ndarray], int, Dict[str, Any]]:
+    """Load + verify a SHARDED checkpoint: every manifest file fetched in
+    parallel, its sha256 recomputed over the exact stored bytes, pieces
+    reassembled into the same flat {key: array} map a monolithic restore
+    returns (bit-identical — the adapt/resume/serve paths stay
+    layout-blind). A missing/tampered shard is a digest mismatch ->
+    CheckpointCorruptError (the fallback scan skips to the previous
+    step); store trouble (ConnectionError, non-404 HTTPError) propagates,
+    same rule as the monolithic loader."""
+    ops = _bucket_ops(path) if is_bucket_path(path) else None
+
+    def load_one(rec: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        name = rec["file"]
+        try:
+            if ops is not None:
+                # the ranged-GET stream with reconnect-resume (the
+                # monolithic loader's transport): a dropped connection
+                # mid-shard resumes at the break instead of re-pulling
+                # the shard from byte 0
+                stream = ops.open_stream(f"{path}/{name}")
+                try:
+                    buf = io.BytesIO()
+                    shutil.copyfileobj(stream, buf, 1 << 20)
+                    raw = buf.getvalue()
+                finally:
+                    stream.close()
+            else:
+                with open(os.path.join(path, name), "rb") as f:
+                    raw = f.read()
+        except ConnectionError:
+            raise
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise CheckpointCorruptError(
+                    f"{path}: shard {name} missing: {e}") from e
+            raise
+        except OSError as e:
+            raise CheckpointCorruptError(
+                f"{path}: shard {name} unreadable: {e}") from e
+        want = rec.get("digest")
+        if want:
+            got = hashlib.sha256(raw).hexdigest()
+            if got != want:
+                raise CheckpointCorruptError(
+                    f"{path}: digest mismatch on shard {name} (stored "
+                    f"{want[:12]}…, recomputed {got[:12]}…) — bytes were "
+                    f"corrupted at rest or in transit")
+        try:
+            with np.load(io.BytesIO(raw)) as z:
+                return {k: z[k] for k in z.files}
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"{path}: shard {name} unparseable: {e}") from e
+
+    shards = meta["shards"]
+    with ThreadPoolExecutor(max(1, min(8, len(shards))),
+                            thread_name_prefix="ckpt-shard-read") as ex:
+        loaded = list(ex.map(load_one, shards))
+    glob = meta.get("global", {})
+    flat: Dict[str, np.ndarray] = {}
+    filled: Dict[str, int] = {}
+    for rec, data in zip(shards, loaded):
+        for key, (offsets, shape) in rec["entries"].items():
+            if key not in glob:
+                raise CheckpointCorruptError(
+                    f"{path}: shard {rec['file']} carries unknown key "
+                    f"{key!r}")
+            if key not in data:
+                raise CheckpointCorruptError(
+                    f"{path}: shard {rec['file']} missing key {key!r}")
+            piece = data[key]
+            if tuple(piece.shape) != tuple(shape):
+                raise CheckpointCorruptError(
+                    f"{path}: shard {rec['file']} piece {key!r} shape "
+                    f"{piece.shape} != manifest {tuple(shape)}")
+            g = glob[key]
+            if key not in flat:
+                flat[key] = np.empty(tuple(g["shape"]),
+                                     np.dtype(g["dtype"]))
+                filled[key] = 0
+            if piece.ndim == 0:
+                flat[key] = piece
+                filled[key] += 1
+            else:
+                flat[key][tuple(slice(o, o + s) for o, s in
+                                zip(offsets, piece.shape))] = piece
+                filled[key] += int(np.prod(piece.shape))
+    for key in meta.get("keys", ()):
+        g = glob.get(key)
+        want_n = (1 if g is None or not g["shape"]
+                  else int(np.prod(g["shape"])))
+        if filled.get(key, 0) != want_n:
+            raise CheckpointCorruptError(
+                f"{path}: key {key!r} covered {filled.get(key, 0)} of "
+                f"{want_n} elements across the manifest — incomplete or "
+                f"overlapping shards")
     for key, name in meta.get("ext_dtypes", {}).items():
         flat[key] = flat[key].view(np.dtype(name))
     return flat, int(meta["step"]), meta.get("extra", {})
@@ -640,13 +1134,21 @@ class AsyncCheckpointWriter:
         # the submit-side backpressure stall the round loop actually feels
         self._c_writes = self._h_write = self._h_stall = None
         if registry is not None:
+            # scope labels (r8): "snapshot" = the whole stage-2 closure;
+            # sharded saves additionally report every per-shard file
+            # write as scope="shard" and the manifest commit as
+            # scope="meta" (save_sharded's metrics hook -> note_write),
+            # so podview can attribute a slow save to the worker/shard
+            # that dragged it
             self._c_writes = registry.counter(
                 "sparknet_checkpoint_writes_total",
-                "background checkpoint writes by outcome",
-                labels=("outcome",))
+                "background checkpoint writes by outcome and scope "
+                "(snapshot|shard|meta)",
+                labels=("outcome", "scope"))
             self._h_write = registry.histogram(
                 "sparknet_checkpoint_write_seconds",
-                "stage-2 serialize+digest+persist duration")
+                "stage-2 persist duration by scope (snapshot|shard|meta)",
+                labels=("scope",))
             self._h_stall = registry.histogram(
                 "sparknet_checkpoint_submit_stall_seconds",
                 "round-loop blocking wait for the previous in-flight "
@@ -675,13 +1177,26 @@ class AsyncCheckpointWriter:
                     fn(*args, **kwargs)
             except BaseException:
                 if self._c_writes is not None:
-                    self._c_writes.inc(outcome="error")
+                    self._c_writes.inc(outcome="error", scope="snapshot")
                 raise
             if self._c_writes is not None:
-                self._c_writes.inc(outcome="ok")
-                self._h_write.observe(time.perf_counter() - t1)
+                self._c_writes.inc(outcome="ok", scope="snapshot")
+                self._h_write.observe(time.perf_counter() - t1,
+                                      scope="snapshot")
 
         self._pending = self._ex.submit(run)
+
+    def note_write(self, scope: str, seconds: float, ok: bool = True
+                   ) -> None:
+        """Per-file instrumentation hook for sharded saves (save_sharded
+        `metrics=`): one count + duration per shard file (scope="shard")
+        and per manifest commit (scope="meta"). Thread-safe (registry
+        families lock internally); a no-op without a registry."""
+        if self._c_writes is not None:
+            self._c_writes.inc(outcome="ok" if ok else "error",
+                               scope=scope)
+            if ok:
+                self._h_write.observe(seconds, scope=scope)
 
     def wait(self) -> None:
         """Block until the in-flight write (if any) completes; re-raise

@@ -163,6 +163,21 @@ class RunConfig:
     lrn_impl: str = "auto"
     pool_impl: str = "xla"
     ops_interpret: bool = False
+    # the r8 gather-free boundary levers (each pinned bit-exact by
+    # tests/test_round_pipeline.py). fused_boundary peels the final τ
+    # step out of the compiled scan so the boundary pmean (+ the ZeRO
+    # momentum average/re-shard under the named trainer) traces in the
+    # same region as the last optimizer update — on TPU the rolled
+    # scan's loop boundary otherwise serializes the full-params
+    # all-reduce behind every local step. collect_async moves the
+    # deferred loss/health fetch onto a background collector thread so
+    # the round loop NEVER blocks on boundary results: t_collect_ms in
+    # the step-time breakdown reads ~0 (the off-thread fetch lands as
+    # t_collect_bg_ms), log/JSONL content is unchanged and rows stay
+    # round-ordered (the collector is a FIFO drained at every eval/
+    # checkpoint/recovery boundary).
+    fused_boundary: bool = True
+    collect_async: bool = True
     # checkpoint. checkpoint_dir accepts a local path OR a gs://|s3://
     # prefix (native bucket checkpoints — no FUSE mount; utils/checkpoint
     # uploads through the data plane's HTTP clients). checkpoint_async
@@ -173,6 +188,19 @@ class RunConfig:
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 25          # rounds
     checkpoint_async: bool = True
+    # SHARDED checkpoint layout (r8): each worker writes/reads only its
+    # own NamedSharding shard in parallel (shard-k-of-n.npz files + a
+    # manifest with per-shard digests in meta.json, still committed
+    # LAST) instead of gathering the full state to one host — save time
+    # O(1/n_workers), stage-1 blocking never materializes the full
+    # state, and the state no longer has to fit one host's RAM on the
+    # save side. Restores read BOTH layouts transparently (bit-identical
+    # flat map), so sharded<->monolithic resume is exact in all
+    # directions. "auto" (default): sharded for multi-device layer-IR
+    # trainers, monolithic elsewhere (graph backend, single device);
+    # "on" forces, "off" restores the pre-r8 monolithic fetch_global
+    # path wholesale.
+    checkpoint_sharded: str = "auto"
     resume: bool = True
     # training health supervisor: anomaly classification (spike/nonfinite),
     # skip / rollback-to-verified-checkpoint / LR-backoff recovery, and the

@@ -196,7 +196,8 @@ def test_sharding_bench_smoke(tmp_path):
     out = bench.sharding_bench(out_path=out_path, trials=2, small=True)
     rows = out["rows"]
     assert [r["arm"] for r in rows] == [
-        "r6_prefetch_donate", "named_replicated", "named_momentum"]
+        "r6_prefetch_donate", "named_replicated", "named_fused",
+        "named_momentum"]
     by = {r["arm"]: r for r in rows}
     for r in rows:
         assert r["images_per_sec"] > 0
@@ -217,6 +218,35 @@ def test_sharding_bench_smoke(tmp_path):
         "per_device_momentum_bytes_sharded_over_replicated"
     assert art["meta"]["jax_version"]
     assert "fetch_async_ms" in art["headline"]
+    # r8 arms: the fused-boundary round ratio and the collect A/B (the
+    # async-collect main-thread cost must be far below the sync fetch's
+    # lower bound of an actual D2H materialization... on CPU both are
+    # small; assert presence + sanity, not timing)
+    assert art["headline"]["fused_round_ms_vs_unfused"] > 0
+    for k in ("collect_sync_ms", "collect_async_blocking_ms",
+              "fetch_shards_ms"):
+        assert k in art["headline"], k
+
+
+def test_ckpt_shard_bench_smoke(tmp_path):
+    """bench.ckpt_shard_bench writes the r8 BENCH_CKPT_SHARD artifact;
+    the DETERMINISTIC claims — restored maps bitwise equal across
+    layouts, logical bytes identical (no replicated leaf written twice)
+    — are asserted inside the bench per worker count and re-checked on
+    the artifact here. The wall-time-decreases claim is the committed
+    pod number (CPU rows stamp structure_proof)."""
+    import bench
+    out_path = str(tmp_path / "BENCH_CKPT_SHARD.json")
+    out = bench.ckpt_shard_bench(out_path=out_path, trials=1, mb=2,
+                                 workers=(2, 4))
+    art = json.load(open(out_path))
+    assert art["headline"]["bytes_equal"] is True
+    assert [r["workers"] for r in art["rows"]] == [2, 4]
+    for r in art["rows"]:
+        for layout in ("monolithic", "sharded"):
+            assert r[layout]["save_restore_ms"] > 0
+    assert art["headline"]["structure_proof"] is True  # CPU build
+    assert art["meta"]["jax_version"]
 
 
 def test_profiler_trace_capture(tmp_path):

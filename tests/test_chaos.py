@@ -229,6 +229,19 @@ def test_kill9_mid_upload_resumes_bitexact_from_bucket(tmp_path,
         assert sorted(fa) == sorted(fb)
         for k in fa:
             np.testing.assert_array_equal(fa[k], fb[k], err_msg=k)
+        # r8: the loop saves the SHARDED layout by default, so the kill
+        # window above lands mid-SHARD upload — assert the manifest
+        # layout really is in play, and that the relaunch's own saves
+        # swept every orphan: no meta-less step (stray shard files of
+        # the torn save), no stray .part- components, no commit residue
+        meta = ckpt._load_meta(f"{ck_b}/step-{BUCKET_ROUNDS}")
+        assert meta is not None and "shards" in meta, meta
+        for s, files in ckpt._bucket_step_files(ck_b).items():
+            assert "meta.json" in files, (
+                f"orphan shard files survived at step-{s}: {files}")
+            stray = [f for f in files
+                     if ".part-" in f or f.startswith("commit-")]
+            assert not stray, (s, stray)
     finally:
         stop_serving(srv)
 

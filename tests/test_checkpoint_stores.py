@@ -369,3 +369,248 @@ def test_train_loop_async_bucket_resume_exact(tmp_path, monkeypatch, kind):
     finally:
         if srv is not None:
             stop_serving(srv)
+
+
+# -- sharded layout (r8): per-shard files + manifest commit marker ----------
+
+
+def _placed_state(n_dev=4, seed=0):
+    """A small NamedSharding-placed state with every piece-plan shape:
+    fully replicated leaves (chunked across shard files), data-sharded
+    leaves (one piece per owner device), a bf16 extension-dtype leaf,
+    and a replicated scalar."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from sparknet_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(n_dev)
+    r = np.random.default_rng(seed)
+
+    def put(a, spec):
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    tree = {
+        "params": {"l1": {
+            "w": put(r.standard_normal((12, 6)).astype(np.float32), P()),
+            "b": put(r.standard_normal((6,)).astype(np.float32), P())}},
+        "momentum": {"l1": {
+            "w": put(jnp.asarray(r.standard_normal(
+                (n_dev, 12, 6)), jnp.bfloat16), P("data")),
+            "b": put(r.standard_normal(
+                (n_dev, 6)).astype(np.float32), P("data"))}},
+        "it": put(np.int32(5), P()),
+    }
+    return tree, mesh
+
+
+def _shard_urls(d, step):
+    if ckpt.is_bucket_path(d):
+        return sorted(u for u in ckpt._bucket_ops(d).list_urls(
+            f"{d.rstrip('/')}/step-{step}") if "/shard-" in u)
+    sd = os.path.join(d, f"step-{step}")
+    return sorted(os.path.join(sd, f) for f in os.listdir(sd)
+                  if f.startswith("shard-"))
+
+
+def _rewrite(d, url, mutate_fn):
+    if ckpt.is_bucket_path(d):
+        ops = ckpt._bucket_ops(d)
+        ops.write(url, mutate_fn(ops.read(url)))
+    else:
+        with open(url, "rb") as f:
+            raw = f.read()
+        with open(url, "wb") as f:
+            f.write(mutate_fn(raw))
+
+
+def test_sharded_roundtrip_bitwise_matches_monolithic(store):
+    """The sharded layout is a STORAGE format: restore_flat over a
+    sharded save must return the exact flat map a monolithic save of the
+    same state returns — keys, dtypes, bytes — and the logical bytes
+    written are identical (no replicated leaf persisted twice)."""
+    from sparknet_tpu.parallel.mesh import fetch_global, fetch_state_shards
+    d, _, _ = store
+    tree, mesh = _placed_state()
+    snap = fetch_state_shards(tree, mesh)
+    ckpt.save_sharded(d, snap, step=1, extra={"layout": "logical"})
+    ckpt.save(d, fetch_global(tree), step=2, extra={"layout": "logical"})
+    f_sh, s_sh, e_sh = ckpt.restore_flat(d, step=1)
+    f_mono, _, _ = ckpt.restore_flat(d, step=2)
+    assert e_sh == {"layout": "logical"}
+    assert sorted(f_sh) == sorted(f_mono)
+    for k in f_mono:
+        assert f_sh[k].dtype == f_mono[k].dtype, k
+        np.testing.assert_array_equal(f_sh[k], f_mono[k], err_msg=k)
+    assert ckpt.sharded_nbytes(snap) == sum(
+        a.nbytes for a in f_mono.values())
+    assert ckpt.verify(ckpt._join(d, "step-1"))
+    # files: one per mesh device + the manifest commit marker
+    assert len(_shard_urls(d, 1)) == 4
+
+
+def test_sharded_corrupt_shard_detected_and_falls_back(store):
+    """A flipped byte in ONE shard file is a digest mismatch: verify
+    fails, explicit-step restore raises, auto-latest falls back to the
+    previous step bit-exactly — the monolithic integrity story, per
+    shard."""
+    from fake_stores import corrupt_npz_bytes
+    from sparknet_tpu.parallel.mesh import fetch_state_shards
+    d, _, _ = store
+    tree, mesh = _placed_state(seed=1)
+    ckpt.save_sharded(d, fetch_state_shards(tree, mesh), step=1)
+    ref, _, _ = ckpt.restore_flat(d, step=1)
+    tree2, _ = _placed_state(seed=2)
+    ckpt.save_sharded(d, fetch_state_shards(tree2, mesh), step=2)
+    _rewrite(d, _shard_urls(d, 2)[1], corrupt_npz_bytes)
+    assert not ckpt.verify(ckpt._join(d, "step-2"))
+    with pytest.raises(ckpt.CheckpointCorruptError, match="digest"):
+        ckpt.restore_flat(d, step=2)
+    with pytest.warns(RuntimeWarning, match="digest mismatch"):
+        flat, step, _ = ckpt.restore_flat(d)
+    assert step == 1
+    for k in ref:
+        np.testing.assert_array_equal(flat[k], ref[k], err_msg=k)
+
+
+def test_sharded_uncommitted_save_invisible_and_swept(store):
+    """Orphan shard files (a writer killed before the manifest landed)
+    are not-a-checkpoint, and the NEXT save's sweep removes them — the
+    stale-.tmp rule taught about per-shard files."""
+    from sparknet_tpu.parallel.mesh import fetch_state_shards
+    d, _, drop_meta = store
+    tree, mesh = _placed_state(seed=3)
+    snap = fetch_state_shards(tree, mesh)
+    ckpt.save_sharded(d, snap, step=1)
+    ckpt.save_sharded(d, snap, step=2)
+    drop_meta(2)  # the kill -9 shape: shards landed, commit marker gone
+    assert ckpt.latest_step(d) == 1
+    with pytest.warns(RuntimeWarning):
+        _, step, _ = ckpt.restore_flat(d)
+    assert step == 1
+    ckpt.save_sharded(d, snap, step=3)  # sweep runs here
+    if ckpt.is_bucket_path(d):
+        assert _shard_urls(d, 2) == []
+    else:
+        assert not os.path.isdir(os.path.join(d, "step-2"))
+    assert ckpt.latest_step(d) == 3
+
+
+def test_sharded_overwrite_clears_stale_shards(store):
+    """Overwriting a step with a NARROWER sharded save (fewer devices ->
+    fewer files) must not leave the old save's extra shard files behind
+    to pair with the new manifest."""
+    from sparknet_tpu.parallel.mesh import fetch_state_shards
+    d, _, _ = store
+    tree4, mesh4 = _placed_state(n_dev=4, seed=4)
+    ckpt.save_sharded(d, fetch_state_shards(tree4, mesh4), step=1)
+    assert len(_shard_urls(d, 1)) == 4
+    tree2, mesh2 = _placed_state(n_dev=2, seed=5)
+    ckpt.save_sharded(d, fetch_state_shards(tree2, mesh2), step=1)
+    assert len(_shard_urls(d, 1)) == 2
+    flat, _, _ = ckpt.restore_flat(d, step=1)
+    from sparknet_tpu.parallel.mesh import fetch_global
+    ref = ckpt._flatten(fetch_global(tree2))
+    for k in ref:
+        np.testing.assert_array_equal(flat[k], ref[k], err_msg=k)
+
+
+def test_sharded_retain_written_cache_covers_all_shards(store,
+                                                        monkeypatch):
+    """retain()'s read-back-skip cache fingerprints EVERY shard file of
+    a sharded save: unchanged -> no re-verify; ONE rewritten shard ->
+    full read-back (which then catches a corrupt rewrite)."""
+    from fake_stores import corrupt_npz_bytes
+    from sparknet_tpu.parallel.mesh import fetch_state_shards
+    d, _, _ = store
+    tree, mesh = _placed_state(seed=6)
+    snap = fetch_state_shards(tree, mesh)
+    for s in (1, 2, 3):
+        ckpt.save_sharded(d, snap, step=s)
+    calls = []
+    real_verify = ckpt.verify
+    monkeypatch.setattr(ckpt, "verify",
+                        lambda p: calls.append(p) or real_verify(p))
+    ckpt.retain(d, keep=2)
+    assert calls == [], "retain re-verified our own just-written shards"
+    _rewrite(d, _shard_urls(d, 3)[0], corrupt_npz_bytes)
+    ckpt.retain(d, keep=2)
+    assert len(calls) >= 1, "rewritten shard did not invalidate the cache"
+    # and the corrupt newest step no longer counts as verified
+    assert ckpt.newest_verified_step(d) == 2
+
+
+def test_sharded_multiprocess_commit_protocol(tmp_path):
+    """The multi-host write path, driven in-process: two 'processes'
+    each persist their own shard files + digest report; the manifest
+    commits only once every report landed, and the restored map is the
+    full state. (Real pods run this per process — structurally the same
+    calls.)"""
+    from sparknet_tpu.parallel.mesh import fetch_state_shards, fetch_global
+    d = str(tmp_path / "ck")
+    tree, mesh = _placed_state(n_dev=2, seed=7)
+    snap = fetch_state_shards(tree, mesh)
+    ref = ckpt._flatten(fetch_global(tree))
+
+    def proc_view(p):
+        view = {"n_shards": snap["n_shards"],
+                "owners": {0: 0, 1: 1},  # file i owned by process i
+                "process_index": p, "process_count": 2, "leaves": {}}
+        for key, rec in snap["leaves"].items():
+            view["leaves"][key] = {
+                "shape": rec["shape"], "dtype": rec["dtype"],
+                "pieces": [(f, o, s, (a if f == p else None))
+                           for f, o, s, a in rec["pieces"]]}
+        return view
+
+    # a PREVIOUS incarnation's crashed save left a stale digest report
+    # (and, say, a half-written shard): the stage-1 prepare — process 0
+    # + barrier, before any stage-2 write — must clear it so the commit
+    # poll can never stamp dead digests into the new manifest
+    os.makedirs(os.path.join(d, "step-1"))
+    with open(os.path.join(d, "step-1", "commit-1.json"), "w") as f:
+        json.dump({ckpt.shard_file_name(1, 2): "deadbeef" * 8}, f)
+    ckpt.prepare_sharded_step(d, 1)
+    assert not os.path.exists(os.path.join(d, "step-1", "commit-1.json"))
+
+    # process 1 writes first (its shards + report); step stays invisible
+    ckpt.save_sharded(d, proc_view(1), step=1)
+    assert ckpt.latest_step(d) is None
+    # process 0 writes its shards, collects the reports, commits meta
+    ckpt.save_sharded(d, proc_view(0), step=1)
+    assert ckpt.latest_step(d) == 1
+    flat, _, _ = ckpt.restore_flat(d, step=1)
+    for k in ref:
+        np.testing.assert_array_equal(flat[k], ref[k], err_msg=k)
+    # commit reports were cleaned up after the manifest landed
+    left = os.listdir(os.path.join(d, "step-1"))
+    assert not [f for f in left if f.startswith("commit-")], left
+
+
+def test_sharded_writer_metrics_scope_labels(tmp_path):
+    """The AsyncCheckpointWriter families carry scope labels: the whole
+    stage-2 closure as scope='snapshot', each shard file write as
+    scope='shard', the manifest commit as scope='meta' — podview's
+    slow-shard attribution input."""
+    from sparknet_tpu.obs import MetricsRegistry
+    from sparknet_tpu.parallel.mesh import fetch_state_shards
+    d = str(tmp_path / "ck")
+    tree, mesh = _placed_state(seed=8)
+    snap = fetch_state_shards(tree, mesh)
+    reg = MetricsRegistry()
+    w = ckpt.AsyncCheckpointWriter(registry=reg)
+    try:
+        w.submit(lambda: ckpt.save_sharded(d, snap, step=1,
+                                           metrics=w.note_write))
+        w.wait()
+    finally:
+        w.close()
+    text = reg.render_prometheus()
+    writes = [ln for ln in text.splitlines()
+              if ln.startswith("sparknet_checkpoint_writes_total{")]
+    for scope in ("snapshot", "shard", "meta"):
+        assert any(f'scope="{scope}"' in ln and 'outcome="ok"' in ln
+                   for ln in writes), (scope, writes)
+    # the shard counter saw one inc per shard file
+    shard_line = next(ln for ln in writes if 'scope="shard"' in ln)
+    assert float(shard_line.rsplit(" ", 1)[1]) == 4.0, shard_line
+    assert 'sparknet_checkpoint_write_seconds' in text

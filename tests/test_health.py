@@ -500,7 +500,12 @@ def test_corrupt_latest_checkpoint_resume_falls_back_bit_exactly(tmp_path):
     assert ckpt.latest_step(ckdir) == 4
     good, good_step, _ = ckpt.restore_flat(ckdir, step=2)
 
-    _silently_corrupt(os.path.join(ckdir, "step-4", "state.npz"))
+    # the loop writes the sharded layout by default since r8: corrupt
+    # whichever state file the step holds (state.npz, or a shard file)
+    step_dir = os.path.join(ckdir, "step-4")
+    victims = sorted(f for f in os.listdir(step_dir)
+                     if f == "state.npz" or f.startswith("shard-"))
+    _silently_corrupt(os.path.join(step_dir, victims[0]))
 
     with pytest.warns(RuntimeWarning, match="digest mismatch"):
         flat, step, _ = ckpt.restore_flat(ckdir)

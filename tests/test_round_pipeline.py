@@ -453,3 +453,104 @@ def test_train_loop_levers_do_not_change_the_trajectory(tmp_path):
     assert all("t_h2d_ms" in rec for rec in on)
     on_h2d = [rec["t_h2d_ms"] for rec in on[1:]]   # round 0 places inline
     assert max(on_h2d) < 50.0, on_h2d  # passthrough, not a batch copy
+
+
+# -- r8: fused τ-boundary + async collect ------------------------------------
+
+
+def test_fused_boundary_bitwise_multi_round(net, solver_cfg, trainer_cls):
+    """The r8 fused τ-boundary (final scan step peeled so the boundary
+    pmean — and the ZeRO re-shard under the named trainer — traces in the
+    same region as the last optimizer update) must be a pure
+    RESTRUCTURING: the same ops on the same values in the same order.
+    Pinned bitwise against the unfused two-step round over a multi-round
+    trajectory — losses, params, momentum, AND the health scalars —
+    under BOTH trainer impls (the conftest trainer_cls matrix)."""
+    mesh = make_mesh(N_DEV)
+    ref = trainer_cls(net, solver_cfg, mesh, tau=TAU)
+    fused = trainer_cls(net, solver_cfg, mesh, tau=TAU,
+                        fused_boundary=True)
+    assert ref.fused_boundary is False and fused.fused_boundary is True
+    s_ref = ref.init_state(jax.random.PRNGKey(0))
+    s_fus = fused.init_state(jax.random.PRNGKey(0))
+    for rnd in range(4):
+        batches = make_round_batches(rnd)
+        key = jax.random.PRNGKey(rnd)
+        s_ref, l_ref = ref.train_round(s_ref, batches, key)
+        s_fus, l_fus = fused.train_round(s_fus, batches, key)
+        assert float(l_ref) == float(l_fus), rnd
+        for (ka, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(s_ref),
+                jax.tree_util.tree_leaves_with_path(s_fus)):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (rnd, ka)
+        for k in ("grad_norm", "nonfinite", "nonfinite_by_worker"):
+            assert np.array_equal(np.asarray(ref.last_health[k]),
+                                  np.asarray(fused.last_health[k])), \
+                (rnd, k)
+
+
+def test_fused_boundary_tau1_and_elastic_masked(net, solver_cfg,
+                                                trainer_cls):
+    """Edge geometry: τ=1 compiles the fused round scan-free, and an
+    elastic_tau-masked round (per-worker budgets, the peeled final step
+    masked off for short-budget workers) still pins bitwise against the
+    unfused trainer fed the same tau vector."""
+    mesh = make_mesh(N_DEV)
+    for kw, tau, tbw in (({}, 1, None),
+                         ({"elastic_tau": True}, TAU, [1, TAU, 2, TAU])):
+        ref = trainer_cls(net, solver_cfg, mesh, tau=tau, **kw)
+        fused = trainer_cls(net, solver_cfg, mesh, tau=tau,
+                            fused_boundary=True, **kw)
+        s_ref = ref.init_state(jax.random.PRNGKey(1))
+        s_fus = fused.init_state(jax.random.PRNGKey(1))
+        r = np.random.default_rng(5)
+        batches = {
+            "data": r.standard_normal(
+                (tau, N_DEV * LOCAL_B, 6)).astype(np.float32)}
+        batches["label"] = (batches["data"].sum(-1, keepdims=True)
+                            > 0).astype(np.int32)
+        extra = {"tau_by_worker": tbw} if tbw is not None else {}
+        s_ref, l_ref = ref.train_round(s_ref, batches,
+                                       jax.random.PRNGKey(2), **extra)
+        s_fus, l_fus = fused.train_round(s_fus, batches,
+                                         jax.random.PRNGKey(2), **extra)
+        assert float(l_ref) == float(l_fus), (tau, tbw)
+        for (ka, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(s_ref),
+                jax.tree_util.tree_leaves_with_path(s_fus)):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), \
+                (tau, tbw, ka)
+
+
+def test_fused_boundary_resize_carries_knob(net, solver_cfg, trainer_cls):
+    t = trainer_cls(net, solver_cfg, make_mesh(N_DEV), tau=TAU,
+                    fused_boundary=True)
+    assert t.resized(2).fused_boundary is True
+
+
+def test_async_collect_loop_bitwise_and_t_collect_zero(tmp_path):
+    """The r8 loop levers through the REAL train(). Async collect only
+    moves WHERE the deferred fetch blocks (the collector thread, not the
+    round loop), so collect on/off must reproduce the same losses
+    BITWISE — and with it on, the breakdown's t_collect_ms (the round
+    loop's blocking share) must read ~0 with the off-thread fetch
+    attributed as t_collect_bg_ms. The fused boundary changes the traced
+    program shape (peeled final step), which on conv nets shifts XLA's
+    fusion tiling at the last ulp — same caveat the elastic_tau masking
+    documents — so fused on/off pins at ulp tolerance here; the BITWISE
+    fused pin is the TINY_MLP trainer matrix above."""
+    on = _run_tiny_train(tmp_path, "r8_on")  # defaults: fused + async
+    sync = _run_tiny_train(tmp_path, "r8_sync", collect_async=False)
+    unfused = _run_tiny_train(tmp_path, "r8_unf", fused_boundary=False,
+                              collect_async=False)
+    assert [rec["step"] for rec in on] == [rec["step"] for rec in sync]
+    for a, b in zip(on, sync):
+        assert a["loss"] == b["loss"], (a, b)  # collect: bitwise
+    for a, b in zip(on, unfused):  # fused: same math, ulp-level conv
+        assert abs(a["loss"] - b["loss"]) <= 1e-5 * abs(b["loss"]), (a, b)
+    on_rows = [rec for rec in on if "t_collect_ms" in rec]
+    assert on_rows, "breakdown rows missing under async collect"
+    assert all(rec["t_collect_ms"] == 0.0 for rec in on_rows), on_rows
+    assert all("t_collect_bg_ms" in rec for rec in on_rows)
+    sync_rows = [rec for rec in sync if "t_collect_ms" in rec]
+    assert all("t_collect_bg_ms" not in rec for rec in sync_rows)

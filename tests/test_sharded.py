@@ -275,7 +275,7 @@ def test_adapt_live_replacement_matches_checkpoint_roundtrip(net,
 
 
 def _loop_cfg(tmp_path, sub, impl, max_rounds, ckdir=None,
-              state_sharding="replicated"):
+              state_sharding="replicated", checkpoint_sharded="auto"):
     from sparknet_tpu.utils.config import RunConfig
     wd = tmp_path / sub
     wd.mkdir(exist_ok=True)
@@ -285,12 +285,13 @@ def _loop_cfg(tmp_path, sub, impl, max_rounds, ckdir=None,
         tau=2, local_batch=4, eval_every=0, max_rounds=max_rounds,
         workdir=str(wd), seed=0, trainer_impl=impl,
         state_sharding=state_sharding,
+        checkpoint_sharded=checkpoint_sharded,
         checkpoint_dir=str(ckdir or wd / "ck"), checkpoint_every=2,
         checkpoint_async=False)
 
 
 def _run_loop(tmp_path, sub, impl, max_rounds, ckdir=None,
-              state_sharding="replicated"):
+              state_sharding="replicated", checkpoint_sharded="auto"):
     from sparknet_tpu.apps.train_loop import train
     from sparknet_tpu.data import cifar
     from sparknet_tpu.data.dataset import ArrayDataset
@@ -301,7 +302,8 @@ def _run_loop(tmp_path, sub, impl, max_rounds, ckdir=None,
         cifar.write_synthetic(d, n_per_file=40)
     loader = cifar.CifarLoader(d)
     cfg = _loop_cfg(tmp_path, sub, impl, max_rounds, ckdir=ckdir,
-                    state_sharding=state_sharding)
+                    state_sharding=state_sharding,
+                    checkpoint_sharded=checkpoint_sharded)
     jsonl = os.path.join(cfg.workdir, "m.jsonl")
     train(cfg, cifar10_quick(batch=cfg.local_batch),
           ArrayDataset(loader.train_batch_dict()),
@@ -360,3 +362,48 @@ def test_zero1_loop_checkpoint_roundtrip(tmp_path):
                         ckdir=c1.checkpoint_dir,
                         state_sharding="momentum")
     assert len(cont) == 2 and all(np.isfinite(l) for l in cont)
+
+
+# -- r8: sharded checkpoint layout, crossed with state layouts + stores ------
+
+_FMT_REF: list = []
+
+
+@pytest.mark.parametrize("kind", ["local", "gs", "s3"])
+def test_cross_layout_and_format_restore_matrix(tmp_path, kind,
+                                                monkeypatch):
+    """The r8 storage matrix: checkpoint FORMAT (sharded <-> monolithic)
+    x state LAYOUT (replica <-> logical) x STORE (local / gs:// / s3://).
+    A seed run saves under one (format, layout); a continuation under the
+    OTHER format and layout resumes from the same store and must
+    reproduce the uninterrupted reference trajectory exactly — the
+    format, like the layout, is a storage decision no resume may be able
+    to observe."""
+    import contextlib
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from fake_stores import bucket_store
+
+    with contextlib.ExitStack() as stack:
+        if kind == "local":
+            root = None
+        else:
+            root, _ = stack.enter_context(bucket_store(kind))
+        # the uninterrupted reference trajectory is deterministic and
+        # store-independent — computed once, reused across the 3 params
+        if not _FMT_REF:
+            _FMT_REF.extend(_run_loop(tmp_path, "fmt_ref",
+                                      "shard_map", 4)[0])
+        ref = list(_FMT_REF)
+        cells = (("named", "on", "shard_map", "off"),
+                 ("shard_map", "off", "named", "on"))
+        for i, (impl_a, fmt_a, impl_b, fmt_b) in enumerate(cells):
+            ckdir = (f"{root}/fmt{i}" if root
+                     else str(tmp_path / f"fmt{i}"))
+            _, cfg_a = _run_loop(tmp_path, f"fmt_seed{i}", impl_a, 2,
+                                 ckdir=ckdir, checkpoint_sharded=fmt_a)
+            meta = ckpt._load_meta(ckpt._join(ckdir, "step-2"))
+            assert ("shards" in meta) == (fmt_a == "on"), meta.keys()
+            cont, _ = _run_loop(tmp_path, f"fmt_cont{i}", impl_b, 4,
+                                ckdir=ckdir, checkpoint_sharded=fmt_b)
+            assert cont == ref[2:], (i, kind, cont, ref)
