@@ -151,6 +151,13 @@ def train(cfg: RunConfig, spec: NetSpec, train_ds: ArrayDataset,
     log = logger or default_logger(cfg.workdir)
     precision.set_policy(cfg.precision)
     resolve_solver(cfg)
+    # persistent compile cache (process-global): the initial round
+    # compile AND every elastic trainer_factory rebuild hit it — a
+    # relaunched/resized worker with a warm cache skips XLA entirely
+    from ..utils.compile_cache import init_compile_cache
+    cache = init_compile_cache(cfg.compile_cache_dir)
+    if cache:
+        log.log(f"persistent compile cache: {cache}")
     net = CompiledNet.compile(spec)
     mesh = make_mesh(cfg.n_devices)
     n_dev = int(np.prod(mesh.devices.shape))

@@ -29,6 +29,7 @@ from jax import lax
 from ..ops.pooling import caffe_pool_output_size, global_pool2d, pool2d
 from ..ops.lrn import lrn as lrn_op
 from .. import precision
+from .quant import QuantConfig
 from .spec import Filler, LayerSpec
 
 Params = Dict[str, jnp.ndarray]
@@ -92,6 +93,11 @@ class ApplyCtx:
 
     ops: kernel-implementation selection (OpsImpl) for LRN / pooling —
     the Pallas-vs-XLA lever of the r6 MFU push.
+
+    quant: serve-side weight-only quantization config (model/quant.py) —
+    sets the activation dtype quantized layers dequantize into. Only
+    consulted when a layer's params carry the (w_q, w_scale) pair; the
+    f32 path never reads it.
     """
 
     train: bool = False
@@ -99,6 +105,7 @@ class ApplyCtx:
     tp_axis: Optional[str] = None
     tp_size: int = 1
     ops: OpsImpl = dataclasses.field(default_factory=OpsImpl)
+    quant: Optional[QuantConfig] = None
 
     def tp_shards(self, layer: "LayerSpec") -> bool:
         return self.tp_axis is not None and tp_shards_layer(layer,
@@ -133,6 +140,30 @@ def fill(key: jax.Array, filler: Filler, shape: Tuple[int, ...],
         return jax.random.uniform(key, shape, minval=filler.min,
                                   maxval=filler.max)
     raise ValueError(f"unknown filler type {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# Quantized-weight resolution (shared by Convolution / InnerProduct)
+# ---------------------------------------------------------------------------
+
+
+def resolve_weight(params: Params, x: jnp.ndarray, ctx: ApplyCtx):
+    """(x, w, matmul precision, preferred_element_type) for either weight
+    layout. The f32 path is byte-for-byte the pre-quant code: policy cast
+    + policy precision. The quantized path (int8 `w_q` + per-channel
+    `w_scale`, installed by the serve ModelManager) dequantizes into the
+    quant activation dtype — `w_q * scale` fuses into the consuming
+    conv/matmul under XLA — casts the activations to match, and runs
+    DEFAULT precision with no forced f32 output (the bf16 MXU fast
+    path; accumulation still happens in f32 inside the unit)."""
+    if "w_q" in params:
+        qc = ctx.quant or QuantConfig()
+        dt = qc.act_dtype()
+        w = (params["w_q"].astype(jnp.float32)
+             * params["w_scale"]).astype(dt)
+        return x.astype(dt), w, lax.Precision.DEFAULT, None
+    return (precision.cast_in(x), precision.cast_in(params["w"]),
+            precision.matmul_precision(), precision.preferred_out())
 
 
 # ---------------------------------------------------------------------------
@@ -189,8 +220,7 @@ def _space_to_depth(x: jnp.ndarray, s: int) -> jnp.ndarray:
 def apply_convolution(layer: LayerSpec, params: Params, inputs, ctx: ApplyCtx):
     p = layer.conv
     (x,) = inputs
-    x = precision.cast_in(x)
-    w = precision.cast_in(params["w"])
+    x, w, mm_precision, mm_out = resolve_weight(params, x, ctx)
     cin = x.shape[-1]
     if _s2d_eligible(p, cin):
         # EXACT stride-s -> stride-1 rewrite: group the input into s x s
@@ -221,8 +251,8 @@ def apply_convolution(layer: LayerSpec, params: Params, inputs, ctx: ApplyCtx):
         y = lax.conv_general_dilated(
             xs, ks, window_strides=(1, 1), padding="VALID",
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            precision=precision.matmul_precision(),
-            preferred_element_type=precision.preferred_out(),
+            precision=mm_precision,
+            preferred_element_type=mm_out,
         )[:, :oh, :ow]
     elif p.group > 1 and CONV_GROUP_IMPL == "split":
         # A/B lever (PERF.md r4): grouped convs as EXPLICIT per-group convs
@@ -235,8 +265,8 @@ def apply_convolution(layer: LayerSpec, params: Params, inputs, ctx: ApplyCtx):
                 xg, wg, window_strides=(p.stride, p.stride),
                 padding=((p.pad, p.pad), (p.pad, p.pad)),
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
-                precision=precision.matmul_precision(),
-                preferred_element_type=precision.preferred_out())
+                precision=mm_precision,
+                preferred_element_type=mm_out)
             for xg, wg in zip(xs, ws)], axis=-1)
     else:
         y = lax.conv_general_dilated(
@@ -245,8 +275,8 @@ def apply_convolution(layer: LayerSpec, params: Params, inputs, ctx: ApplyCtx):
             padding=((p.pad, p.pad), (p.pad, p.pad)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=p.group,
-            precision=precision.matmul_precision(),
-            preferred_element_type=precision.preferred_out(),
+            precision=mm_precision,
+            preferred_element_type=mm_out,
         )
     if "b" in params:
         y = y + params["b"].astype(y.dtype)
@@ -341,10 +371,10 @@ def apply_innerproduct(layer: LayerSpec, params: Params, inputs, ctx: ApplyCtx):
         # Caffe flattens NCHW-ordered; transpose so imported Caffe weights
         # (and exported ones) line up element-for-element.
         x = jnp.transpose(x, (0, 3, 1, 2))
-    x = precision.cast_in(x.reshape(x.shape[0], -1))
-    y = jnp.dot(x, precision.cast_in(params["w"]),
-                precision=precision.matmul_precision(),
-                preferred_element_type=precision.preferred_out())
+    x, w, mm_precision, mm_out = resolve_weight(
+        params, x.reshape(x.shape[0], -1), ctx)
+    y = jnp.dot(x, w, precision=mm_precision,
+                preferred_element_type=mm_out)
     if "b" in params:
         y = y + params["b"].astype(y.dtype)
     if ctx.tp_shards(layer):

@@ -19,9 +19,14 @@ import jax
 import jax.numpy as jnp
 
 from .layers import LAYER_IMPLS, ApplyCtx, OpsImpl, Params
+from .quant import QuantConfig
 from .spec import InputSpec, NetSpec, validate
 
 PyTree = Dict[str, Params]
+
+#: CompiledNet.compile memo: identical NetSpecs (frozen, hashable) compile
+#: once per process — the spec-level half of the compile-cache story
+_SPEC_MEMO: Dict[NetSpec, "CompiledNet"] = {}
 
 
 def _to_nhwc_shape(shape: Tuple[int, ...]) -> Tuple[int, ...]:
@@ -52,10 +57,34 @@ class CompiledNet:
     def compile(spec: NetSpec) -> "CompiledNet":
         # stamped as a compile event (obs/device.py): every spec compile
         # lands in the process-wide record, so jit-cache churn driven by
-        # repeated net construction is scrapeable, not invisible
-        from ..obs.device import timed_compile
-        with timed_compile("net"):
-            return CompiledNet._compile(spec)
+        # repeated net construction is scrapeable, not invisible.
+        # Identical specs (frozen dataclasses, hashable) return the
+        # memoized CompiledNet — router lanes, elastic rebuilds, and
+        # serve hot-swap retraces of the same architecture skip
+        # re-validation, and the event records cache_hit="true". A memo
+        # MISS stamps cache_hit=None ("unknown"): spec compilation is
+        # pure Python — the persistent XLA cache neither applies to it
+        # nor should claim it — so its real duration still lands in the
+        # compile-seconds histogram while never counting as a fresh-XLA
+        # miss against the warm-replica acceptance.
+        import time as _time
+
+        from ..obs.device import note_compile
+        try:
+            cached = _SPEC_MEMO.get(spec)
+        except TypeError:  # unhashable spec (hand-built with lists)
+            cached = None
+        if cached is not None:
+            note_compile("net", 0.0, cache_hit=True)
+            return cached
+        t0 = _time.perf_counter()
+        net = CompiledNet._compile(spec)
+        note_compile("net", _time.perf_counter() - t0)
+        try:
+            _SPEC_MEMO[spec] = net
+        except TypeError:
+            pass
+        return net
 
     @staticmethod
     def _compile(spec: NetSpec) -> "CompiledNet":
@@ -107,8 +136,9 @@ class CompiledNet:
     def apply(self, params: PyTree, batch: Dict[str, jnp.ndarray], *,
               train: bool = False, rng: Optional[jax.Array] = None,
               phase: Optional[str] = None, tp_axis: Optional[str] = None,
-              tp_size: int = 1,
-              ops: Optional[OpsImpl] = None) -> Dict[str, jnp.ndarray]:
+              tp_size: int = 1, ops: Optional[OpsImpl] = None,
+              quant: Optional[QuantConfig] = None
+              ) -> Dict[str, jnp.ndarray]:
         """Run the net. `batch` maps input blob names to NHWC arrays.
 
         Returns every blob produced (inputs excluded), so callers can read
@@ -122,10 +152,18 @@ class CompiledNet:
         ops: kernel-implementation selection for LRN/pooling (OpsImpl;
         None = "auto" dispatch — Pallas kernels on TPU, portable paths
         elsewhere).
+
+        quant: serving-side weight-only quantization config (model/
+        quant.py). `params` may then hold int8 `w_q` + per-channel
+        `w_scale` leaves in place of `w` for Convolution/InnerProduct
+        layers; the layer impls dequantize at use into the quant
+        activation dtype. With f32 `w` leaves this knob changes nothing —
+        the f32 path is untouched by construction.
         """
         phase = phase or ("TRAIN" if train else "TEST")
         ctx = ApplyCtx(train=train, rng=rng, tp_axis=tp_axis,
-                       tp_size=tp_size, ops=ops or OpsImpl())
+                       tp_size=tp_size, ops=ops or OpsImpl(),
+                       quant=quant)
         blobs: Dict[str, jnp.ndarray] = dict(batch)
         all_tops = set()
         for layer in self.spec.layers_for_phase(phase):

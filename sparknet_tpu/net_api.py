@@ -69,17 +69,33 @@ class JaxNet:
         self.params: PyTree = self.net.init_params(jax.random.PRNGKey(seed))
         self.solver: Optional[SgdSolver] = None
         self.solver_state: Optional[SolverState] = None
+        # serving-side weight-only quantization (model/quant.py): set via
+        # set_quant() alongside a quantized params pytree. The config is
+        # a STATIC jit argument (QuantConfig is frozen/hashable), so a
+        # config change is part of the cache key — switching e.g. the
+        # act dtype retraces instead of silently reusing the old
+        # executable — and the f32 path (quant=None) keeps its own entry.
+        self.quant = None
         if solver is not None:
             self.solver = SgdSolver(self.net, solver, loss_blob=loss_blob)
             self.solver_state = self.solver.init_state(self.params)
         self._fwd_test = jax.jit(
-            lambda p, b: self.net.apply(p, b, train=False))
+            lambda p, b, q: self.net.apply(p, b, train=False, quant=q),
+            static_argnums=2)
         self._fwd_train = jax.jit(
             lambda p, b, r: self.net.apply(p, b, train=True, rng=r))
         _loss_blob = loss_blob
         self._grad = jax.jit(jax.grad(
             lambda p, b, r: self.net.apply(p, b, train=True, rng=r)[_loss_blob]))
         self._rng = jax.random.PRNGKey(seed ^ 0x5EED)
+
+    def set_quant(self, quant) -> None:
+        """Install/clear the quant config for test-phase forwards. Call
+        alongside swapping `self.params` to/from a quantized pytree
+        (model/quant.py quantize_params); the config rides the jit cache
+        key, so mismatched combinations merely compile their own
+        executables — they never reuse a stale one."""
+        self.quant = quant
 
     # -- data plumbing ------------------------------------------------------
 
@@ -105,7 +121,7 @@ class JaxNet:
         """Test-phase forward. Returns output blobs (+ any requested hidden
         blobs, parity with `forward(rowIt, dataBlobNames)`,
         `libs/CaffeNet.scala:88-109`)."""
-        blobs = self._fwd_test(self.params, self._prep(batch))
+        blobs = self._fwd_test(self.params, self._prep(batch), self.quant)
         want = set(self.net.output_names) | set(blob_names or [])
         return {k: np.asarray(v) for k, v in blobs.items() if k in want}
 

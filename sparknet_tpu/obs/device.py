@@ -12,17 +12,29 @@ Two signals the host-side registry could not see before this module:
 
   - **Compiles.** XLA compilation is the serving tail-latency cliff and
     the training warm-up tax, yet it was invisible: nothing counted how
-    often it happened or how long it took. `note_compile(what, seconds)`
-    is the process-wide record — `CompiledNet.compile` stamps spec
-    compiles, the serve worker stamps the first forward of each batch
-    bucket (the jit-cache entry being built), and
+    often it happened or how long it took. `note_compile(what, seconds,
+    cache_hit=...)` is the process-wide record — `CompiledNet.compile`
+    stamps spec compiles, the serve worker stamps the first forward of
+    each batch bucket (the jit-cache entry being built), and
     `attach_compile_metrics` replays the history into a registry as
-    `sparknet_compile_events_total{what}` +
+    `sparknet_compile_events_total{what,cache_hit}` +
     `sparknet_compile_seconds{what}` so a registry created AFTER the
     model was compiled (the train loop's per-run registry) still shows
     the compile that preceded it. Jit-cache CHURN — recompiles past the
     expected steady state — is then a first-class scrapeable number
     instead of a log-grep.
+
+    `cache_hit` (r9, the persistent-compile-cache PR) says whether the
+    event required FRESH XLA compilation: "true" = the region built no
+    executable from scratch (served from the persistent cache via
+    `utils/compile_cache.py`, or a memoized spec compile), "false" = at
+    least one executable compiled fresh with the cache absent or
+    missing, "unknown" = the verdict doesn't apply (a memo-MISS spec
+    compile is pure Python — no XLA to cache — and out-of-tree
+    note_compile callers don't sample). A warm replica's cold start
+    showing ZERO cache_hit="false" events is the BENCH_ECON acceptance
+    row; the seconds histogram records non-"true" events only, so memo
+    hits never dilute real compile-cost percentiles.
 
 The accumulator is process-global by design (compiles happen before any
 registry exists); attached registries are held weakly so per-run/test
@@ -43,25 +55,39 @@ COMPILE_BUCKETS = (0.001, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                    10.0, 30.0, 60.0, 120.0, 300.0)
 
 _lock = threading.Lock()
-_events: List[Tuple[str, float]] = []  # (what, seconds), process lifetime
+#: (what, seconds, cache_hit), process lifetime. cache_hit: True/False/None
+_events: List[Tuple[str, float, Optional[bool]]] = []
 #: weakly-held (counter, histogram) pairs of attached registries
 _attached: List[Tuple["weakref.ref[Metric]", "weakref.ref[Metric]"]] = []
 
 
-def note_compile(what: str, seconds: float) -> None:
+def _hit_label(cache_hit: Optional[bool]) -> str:
+    return "unknown" if cache_hit is None else \
+        ("true" if cache_hit else "false")
+
+
+def note_compile(what: str, seconds: float,
+                 cache_hit: Optional[bool] = None) -> None:
     """Record one compile event (`what` is the site: "net" for
     CompiledNet.compile, "serve_bucket" for a serve bucket's first
-    forward). Fans out to every attached registry; never raises."""
+    forward). `cache_hit` is the persistent-cache verdict for the region
+    (see module doc; None = not sampled). Fans out to every attached
+    registry; never raises."""
+    cache_hit = None if cache_hit is None else bool(cache_hit)
     with _lock:
-        _events.append((str(what), float(seconds)))
+        _events.append((str(what), float(seconds), cache_hit))
         pairs = list(_attached)
     for c_ref, h_ref in pairs:
         c, h = c_ref(), h_ref()
         if c is None or h is None:
             continue
         try:
-            c.inc(what=what)
-            h.observe(seconds, what=what)
+            c.inc(what=what, cache_hit=_hit_label(cache_hit))
+            # the seconds histogram records REAL compile cost only:
+            # ~0-second memo/cache-hit events would collapse its
+            # percentiles toward zero and blind slow-compile attribution
+            if cache_hit is not True:
+                h.observe(seconds, what=what)
         except Exception:
             pass  # a dying registry must not break the compile path
 
@@ -71,45 +97,60 @@ def attach_compile_metrics(registry: MetricsRegistry) -> None:
     every event recorded so far (compiles routinely PRECEDE registry
     creation), and keep feeding it (weakly held) as new ones land."""
     c = registry.counter("sparknet_compile_events_total",
-                         "XLA/spec compile events by site", labels=("what",))
+                         "XLA/spec compile events by site and persistent-"
+                         "cache outcome", labels=("what", "cache_hit"))
     h = registry.histogram("sparknet_compile_seconds",
-                           "seconds per compile event", labels=("what",),
-                           buckets=COMPILE_BUCKETS)
+                           "seconds per FRESH compile event (cache/memo "
+                           "hits excluded — real compile cost only)",
+                           labels=("what",), buckets=COMPILE_BUCKETS)
     with _lock:
         history = list(_events)
         _attached[:] = [(cr, hr) for cr, hr in _attached
                         if cr() is not None and hr() is not None]
         _attached.append((weakref.ref(c), weakref.ref(h)))
-    for what, seconds in history:
-        c.inc(what=what)
-        h.observe(seconds, what=what)
+    for what, seconds, cache_hit in history:
+        c.inc(what=what, cache_hit=_hit_label(cache_hit))
+        if cache_hit is not True:  # replay keeps the histogram's
+            h.observe(seconds, what=what)  # real-compile-cost contract
 
 
 def compile_stats() -> Dict[str, Dict[str, float]]:
-    """{what: {"events": n, "seconds": total}} — the accumulated record
-    (tests, status JSON)."""
+    """{what: {"events": n, "seconds": total, "cache_hits": n,
+    "cache_misses": n}} — the accumulated record (tests, status JSON,
+    the BENCH_ECON cold-start child). Events with an unknown verdict
+    count in "events" only."""
     out: Dict[str, Dict[str, float]] = {}
     with _lock:
-        for what, seconds in _events:
-            d = out.setdefault(what, {"events": 0, "seconds": 0.0})
+        for what, seconds, cache_hit in _events:
+            d = out.setdefault(what, {"events": 0, "seconds": 0.0,
+                                      "cache_hits": 0, "cache_misses": 0})
             d["events"] += 1
             d["seconds"] += seconds
+            if cache_hit is not None:
+                d["cache_hits" if cache_hit else "cache_misses"] += 1
     return out
 
 
 class timed_compile:
-    """Context manager stamping its wall time as one compile event."""
+    """Context manager stamping its wall time as one compile event, with
+    the persistent-cache verdict sampled over the region (thread-local —
+    concurrent lanes' compiles don't cross-attribute)."""
 
     def __init__(self, what: str):
         self.what = what
 
     def __enter__(self):
+        from ..utils.compile_cache import track_compiles
+        self._track = track_compiles()
+        self._track.__enter__()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
+        self._track.__exit__(*exc)
         if exc[0] is None:
-            note_compile(self.what, time.perf_counter() - self._t0)
+            note_compile(self.what, time.perf_counter() - self._t0,
+                         cache_hit=self._track.cache_hit)
         return False
 
 

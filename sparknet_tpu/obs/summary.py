@@ -118,7 +118,52 @@ def summarize(recs: List[Dict[str, Any]], tail: int = 10) -> Dict[str, Any]:
     pod = _pod_view(loss_rows)
     if pod is not None:
         out["pod"] = pod
+    serve = _serve_view(recs)
+    if serve is not None:
+        out["serve"] = serve
     return out
+
+
+def _serve_view(recs: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Per-model serve vitals + the formed-batch request-size histogram —
+    the input `sparknet-serve --buckets-from` / serve.buckets.
+    derive_buckets fits a bucket ladder to. Hist rows are CUMULATIVE per
+    process, so the LAST row per (source, model) counts and sources sum;
+    None when the records carry no serve rows."""
+    last: Dict[tuple, Dict[str, Any]] = {}
+    for r in recs:
+        if isinstance(r.get("batch_size_hist"), dict):
+            key = (r.get("worker"), str(r.get("model", "default")))
+            last[key] = r
+    if not last:
+        return None
+    models: Dict[str, Any] = {}
+    for (_, name), r in last.items():
+        m = models.setdefault(name, {"batch_size_hist": {}, "rows": 0})
+        for s, n in r["batch_size_hist"].items():
+            try:
+                s, n = int(s), int(n)
+            except (TypeError, ValueError):
+                continue
+            m["batch_size_hist"][s] = m["batch_size_hist"].get(s, 0) + n
+        m["rows"] += 1
+        # multi-source (several replicas' files for one model): counters
+        # SUM; per-process quality gauges take the WORST source (max
+        # p99, min fill) — never one arbitrary replica's number
+        # presented as the model's
+        for fld in ("requests_ok", "requests_shed", "bucket_compiles",
+                    "images_per_sec"):
+            if r.get(fld) is not None:
+                m[fld] = round(m.get(fld, 0) + r[fld], 2)
+        if r.get("p99_ms") is not None:
+            m["p99_ms"] = max(m.get("p99_ms", 0.0), r["p99_ms"])
+        if r.get("batch_fill_ratio") is not None:
+            m["batch_fill_ratio"] = min(m.get("batch_fill_ratio", 1.0),
+                                        r["batch_fill_ratio"])
+    for m in models.values():
+        m["batch_size_hist"] = {
+            str(s): c for s, c in sorted(m["batch_size_hist"].items())}
+    return {"models": models}
 
 
 def _pod_view(loss_rows: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
@@ -246,6 +291,22 @@ def format_text(s: Dict[str, Any]) -> str:
         else:
             lines.append("  straggler audit trail: clean (no rounds "
                          "flagged)")
+    serve = s.get("serve")
+    if serve:
+        lines.append("")
+        lines.append("serve view (request-size histogram = the "
+                     "bucket-ladder derivation input):")
+        for name, m in sorted(serve["models"].items()):
+            vit = "  ".join(
+                f"{fld}={m[fld]}" for fld in
+                ("requests_ok", "batch_fill_ratio", "bucket_compiles",
+                 "p99_ms") if m.get(fld) is not None)
+            lines.append(f"  model {name}: {vit}")
+            hist = m["batch_size_hist"]
+            peak = max(hist.values(), default=0)
+            for sz, n in hist.items():
+                bar = "#" * max(1, round(24 * n / peak)) if peak else ""
+                lines.append(f"    batch size {sz:>4}  {n:>8}  {bar}")
     if s["event_trail"]:
         lines.append("")
         lines.append("health/event audit trail:")
@@ -303,7 +364,41 @@ def _selfcheck_jsonl(n_workers: int = 1,
         finally:
             log.close()
         paths.append(jsonl)
+    paths.append(_selfcheck_serve_jsonl(root))
     return paths
+
+
+def _selfcheck_serve_jsonl(root: str) -> str:
+    """Run a tiny live InferenceServer (lenet, CPU) against a short
+    synthetic request trace and return the serve metrics JSONL it wrote —
+    the freshest possible serve schema, so the request-size-histogram
+    section (the `--buckets-from` input) cannot rot against the live
+    logger without failing the selfcheck."""
+    import os
+
+    import numpy as np
+
+    from ..net_api import JaxNet
+    from ..serve import InferenceServer, ServeConfig
+    from ..utils.logger import Logger
+    from ..zoo import lenet
+
+    jsonl = os.path.join(root, "selfcheck_serve_metrics.jsonl")
+    log = Logger(os.path.join(root, "selfcheck_serve_log.txt"),
+                 echo=False, jsonl_path=jsonl)
+    net = JaxNet(lenet(batch=4))
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, buckets=(1, 4),
+                      outputs=("prob",), metrics_every_batches=1)
+    r = np.random.default_rng(0)
+    req = {"data": r.standard_normal((28, 28, 1)).astype(np.float32)}
+    try:
+        with InferenceServer(net, cfg, logger=log) as srv:
+            srv.infer(req)                     # a size-1 batch
+            for f in [srv.submit(req) for _ in range(4)]:  # a size-4 one
+                f.result(timeout=60.0)
+    finally:
+        log.close()
+    return jsonl
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -362,6 +457,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.selfcheck and args.selfcheck_workers > 1 and "pod" not in s:
         print("selfcheck: multi-worker run produced no pod view",
               file=sys.stderr)
+        return 1
+    if args.selfcheck and not (s.get("serve") or {}).get("models"):
+        print("selfcheck: serve run produced no request-size histogram "
+              "(the --buckets-from input)", file=sys.stderr)
         return 1
     return 0
 

@@ -28,19 +28,22 @@ makes it reachable:
     is the matching keep-alive client.
   - `sparknet-serve` (app.py): the console entry point.
 """
+from ..model.quant import QuantConfig
 from .batcher import (DeadlineExpiredError, DynamicBatcher,
                       QueueFullError, ServeRequest)
+from .buckets import derive_buckets, fill_ratio, size_hist_from_jsonl
 from .http_frontend import HttpFrontend, http_infer
 from .model_manager import ModelManager, ServeModelError
 from .router import (ModelRouter, NoReplicaError, Replica, RouterConfig,
                      UnknownModelError, heartbeat_health)
-from .server import InferenceServer, ServeConfig, zeros_batch
+from .server import InferenceServer, ServeConfig, parity_batch, zeros_batch
 
 __all__ = [
     "DynamicBatcher", "QueueFullError", "DeadlineExpiredError",
     "ServeRequest",
     "ModelManager", "ServeModelError",
-    "InferenceServer", "ServeConfig", "zeros_batch",
+    "InferenceServer", "ServeConfig", "zeros_batch", "parity_batch",
+    "QuantConfig", "derive_buckets", "fill_ratio", "size_hist_from_jsonl",
     "ModelRouter", "RouterConfig", "Replica", "NoReplicaError",
     "UnknownModelError", "heartbeat_health",
     "HttpFrontend", "http_infer",

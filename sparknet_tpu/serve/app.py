@@ -141,6 +141,28 @@ def main(argv=None) -> None:
     p.add_argument("--buckets", default=None,
                    help="comma-separated batch buckets (default: powers "
                    "of 2 up to max-batch)")
+    p.add_argument("--buckets-from", default=None, metavar="JSONL",
+                   nargs="+",
+                   help="derive the bucket ladder from recorded serve "
+                   "metrics JSONL(s) (batch_size_hist rows) instead of "
+                   "pow2: the ladder minimizing padded slots for the "
+                   "traffic the files observed (per model name when the "
+                   "rows carry one)")
+    p.add_argument("--buckets-k", type=int, default=4,
+                   help="max rungs for --buckets-from ladders (compiled "
+                   "forwards per model; default 4)")
+    p.add_argument("--quant", default=None, choices=("int8",),
+                   help="weight-only quantized serving: int8 per-channel "
+                   "weights + bf16 activations, parity-gated against the "
+                   "f32 forward at every checkpoint load")
+    p.add_argument("--quant-tol", type=float, default=None,
+                   help="override the quant parity tolerance (sets both "
+                   "rtol and atol of the load-time allclose gate)")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compile-cache directory "
+                   "(default: $SPARKNET_COMPILE_CACHE / "
+                   "$JAX_COMPILATION_CACHE_DIR if set) — warm replica "
+                   "cold-starts skip every bucket compile")
     p.add_argument("--outputs", default=None,
                    help="comma-separated blob names to return "
                    "(default: the net's output schema)")
@@ -172,16 +194,47 @@ def main(argv=None) -> None:
     log = default_logger(args.workdir, name="serving")
     buckets = (tuple(int(b) for b in args.buckets.split(","))
                if args.buckets else None)
+    derived: dict = {}
+    if args.buckets_from:
+        from .buckets import derive_buckets, size_hist_from_jsonl
+        hists = size_hist_from_jsonl(args.buckets_from)
+        merged: dict = {}
+        for h in hists.values():
+            for s, n in h.items():
+                merged[s] = merged.get(s, 0) + n
+        derived = {name: derive_buckets(h, args.max_batch,
+                                        k=args.buckets_k)
+                   for name, h in hists.items()}
+        derived[None] = derive_buckets(merged, args.max_batch,
+                                       k=args.buckets_k)
+        log.log(f"bucket ladders derived from "
+                f"{len(args.buckets_from)} JSONL(s): "
+                + "; ".join(f"{n or 'merged'}={list(b)}"
+                            for n, b in sorted(
+                                derived.items(),
+                                key=lambda kv: str(kv[0]))))
     outputs = tuple(args.outputs.split(",")) if args.outputs else None
+    if args.quant_tol is not None and not args.quant:
+        p.error("--quant-tol requires --quant (no parity gate exists "
+                "on the f32 path)")
+    quant = args.quant
+    if quant and args.quant_tol is not None:
+        from ..model.quant import QuantConfig
+        quant = QuantConfig(mode=args.quant, rtol=args.quant_tol,
+                            atol=args.quant_tol)
 
     def lane_cfg(name: str, checkpoint_dir: Optional[str]) -> ServeConfig:
+        # explicit --buckets wins; then the model's derived ladder, then
+        # the merged-traffic ladder, then pow2
+        lane_buckets = buckets or derived.get(name) or derived.get(None)
         return ServeConfig(
             model_name=name, max_batch=args.max_batch,
-            max_wait_ms=args.max_wait_ms, buckets=buckets,
+            max_wait_ms=args.max_wait_ms, buckets=lane_buckets,
             slo_p99_ms=args.slo_p99_ms, outputs=outputs,
             checkpoint_dir=checkpoint_dir,
             poll_interval_s=args.poll_interval,
-            canary=not args.no_canary)
+            canary=not args.no_canary, quant=quant,
+            compile_cache_dir=args.compile_cache)
 
     from ..obs import trace as obs_trace
 

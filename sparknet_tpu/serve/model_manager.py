@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from ..model.quant import QuantConfig, quantize_params
 from ..obs import trace as obs_trace
 from ..utils import checkpoint as ckpt
 from ..utils.heartbeat import HeartbeatWriter
@@ -124,12 +125,40 @@ class ModelManager:
                  logger: Optional[Logger] = None,
                  heartbeat: Optional[HeartbeatWriter] = None,
                  bad_step_retry_s: float = 30.0, registry=None,
-                 model: str = "default"):
+                 model: str = "default",
+                 quant: Optional[QuantConfig] = None,
+                 parity_batch: Optional[Dict[str, np.ndarray]] = None):
         if checkpoint_dir and not hasattr(net, "params"):
             raise ServeModelError(
                 "checkpoint hot-reload needs a layer-IR JaxNet (exposes "
                 ".params); serve a graph net from a weights file instead")
+        if quant is not None and not (hasattr(net, "params")
+                                      and hasattr(net, "set_quant")):
+            raise ServeModelError(
+                "quantized serving needs a layer-IR JaxNet (exposes "
+                ".params/.set_quant); the graph backend serves f32")
+        #: weight-only quantization at load time (model/quant.py). Every
+        #: install — initial weights included — quantizes the f32 params
+        #: and gates on the PARITY canary: the quantized forward of
+        #: `parity_batch` must allclose the f32 forward within the
+        #: calibrated tolerance, else the swap rolls back. A checkpoint
+        #: whose quantization is poisoned (corrupted scale) never serves.
+        self.quant = quant
+        self.parity_batch = parity_batch
+        self.last_parity_drift: Optional[float] = None
         self.net = net
+        # the f32 SHAPE template for checkpoint extraction: once quant
+        # installs a (w_q, w_scale) pytree, net.params no longer carries
+        # the f32 "w" shapes a checkpoint must reassemble to — shape
+        # structs cost nothing and outlive every swap
+        self._f32_template = None
+        if quant is not None:
+            import jax
+            self._f32_template = {
+                lname: {pname: jax.ShapeDtypeStruct(tuple(np.shape(w)),
+                                                    jnp.float32)
+                        for pname, w in lp.items()}
+                for lname, lp in net.params.items()}
         self.checkpoint_dir = checkpoint_dir
         self.poll_interval_s = float(poll_interval_s)
         self.canary_batch = canary_batch
@@ -169,18 +198,40 @@ class ModelManager:
     def load_initial(self) -> Optional[int]:
         """Serve the newest VERIFIED checkpoint if the watched dir has one
         (fresh-init weights otherwise — a server may come up before its
-        trainer's first save). Returns the loaded step or None."""
+        trainer's first save). Returns the loaded step or None. With
+        quant enabled the serving weights are ALWAYS quantized — the
+        initial weights too, so the compiled forwards and pad buffers
+        never flip representation under traffic."""
         if not self.checkpoint_dir:
+            self._quantize_initial()
             return None
         found = ckpt.restore_newest_verified(self.checkpoint_dir)
         if found is None:
             self._log("serve: no verified checkpoint under "
                       f"{self.checkpoint_dir!r} yet — serving initial "
                       f"weights")
+            self._quantize_initial()
             return None
         flat, step, extra = found
-        self._install(flat, step, extra, initial=True)
+        if not self._install(flat, step, extra, initial=True):
+            # the newest verified checkpoint failed the install gates:
+            # keep serving (quantized) initial weights; the poll loop
+            # retries newer steps as they land
+            self._quantize_initial()
         return self.step
+
+    def _quantize_initial(self) -> None:
+        """Quantize the fresh-init weights in place (quant mode only).
+        Failing the parity gate HERE is a configuration error — there is
+        no earlier good state to serve — so it raises instead of
+        degrading."""
+        if self.quant is None or getattr(self.net, "quant", None) is not None:
+            return
+        ok, why = self._quant_swap(self.net.params)
+        if not ok:
+            raise ServeModelError(
+                f"initial weights failed the quantization parity gate: "
+                f"{why} — check QuantConfig tolerances")
 
     def poll(self, now: Optional[float] = None) -> bool:
         """Time-gated reload check (the server calls this every idle tick
@@ -229,16 +280,32 @@ class ModelManager:
     def _install(self, flat: Dict[str, np.ndarray], step: int,
                  extra: Dict[str, Any], initial: bool = False) -> bool:
         old_params = self.net.params
+        old_quant = getattr(self.net, "quant", None)
         try:
             # tp>1 checkpoints serve fine since r7: replica-axis column
             # shards reassemble inside params_from_checkpoint_flat, and
             # the NamedSharding trainer's TP checkpoints are already full
-            # logical weights — the canary still vets the result
-            self.net.params = params_from_checkpoint_flat(
-                flat, self.net.params, tp=int(extra.get("tp", 1)))
+            # logical weights — the canary still vets the result. Quant
+            # mode extracts against the retained f32 shape template (the
+            # live params may be a quantized pytree).
+            f32_params = params_from_checkpoint_flat(
+                flat, self._f32_template or self.net.params,
+                tp=int(extra.get("tp", 1)))
         except ServeModelError as e:
             self._reject(step, str(e))
             return False
+        if self.quant is not None:
+            ok, why = self._quant_swap(f32_params)
+            if not ok:
+                # a quantization that fails parity NEVER serves: roll
+                # back to the (quantized) weights answering traffic now
+                self.net.params = old_params
+                self.net.set_quant(old_quant)
+                self._reject(step, f"quantization rejected: {why} — "
+                                   f"swap rolled back")
+                return False
+        else:
+            self.net.params = f32_params
         try:
             canary_ok = self._canary_ok()
         except Exception as e:
@@ -252,6 +319,8 @@ class ModelManager:
             # saved mid-divergence): roll back to the weights that were
             # answering traffic a moment ago
             self.net.params = old_params
+            if self.quant is not None:
+                self.net.set_quant(old_quant)
             self._reject(step, "canary forward failed (nonfinite "
                                "outputs or crash) — swap rolled back")
             return False
@@ -267,12 +336,63 @@ class ModelManager:
         self._beat(step, "ok")
         return True
 
+    def _quant_swap(self, f32_params) -> tuple:
+        """Quantize + parity-gate + install (quant mode's install tail).
+        Runs the f32 forward of `parity_batch` as the reference, installs
+        the quantized pytree, and compares the quantized forward against
+        it: every output blob must be finite and allclose within the
+        calibrated QuantConfig tolerance. Returns (ok, why); on ok the
+        net holds the quantized params. The caller owns rollback."""
+        net = self.net
+        try:
+            net.params = f32_params
+            net.set_quant(None)
+            ref = net.forward(self.parity_batch,
+                              blob_names=list(self.canary_outputs or ())) \
+                if self.parity_batch is not None else {}
+            qparams = quantize_params(f32_params, self.quant)
+            net.params = qparams
+            net.set_quant(self.quant)
+            if self.parity_batch is None:
+                return True, None
+            out = net.forward(self.parity_batch,
+                              blob_names=list(self.canary_outputs or ()))
+        except Exception as e:
+            return False, f"quantized forward raised: {e}"
+        drift = 0.0
+        # compare the PER-ROW blobs clients actually consume (prob,
+        # features). Batch-aggregate scalars (the zoo heads' loss/
+        # accuracy over the parity batch's zero labels) are label-
+        # dependent and DISCONTINUOUS — an argmax flip on a near-tie
+        # moves accuracy by 1/batch, which is noise, not corruption —
+        # and the server's de-pad drops them from responses anyway.
+        keys = [k for k in ref if np.ndim(ref[k]) >= 1] or list(ref)
+        for k in keys:
+            q = out.get(k)
+            if q is None:
+                return False, f"quantized forward lost blob {k!r}"
+            r = np.asarray(ref[k], dtype=np.float32)
+            q = np.asarray(q, dtype=np.float32)
+            if not np.isfinite(q).all():
+                return False, f"nonfinite quantized outputs in {k!r}"
+            if r.size:
+                drift = max(drift, float(np.max(np.abs(q - r))))
+            if not np.allclose(q, r, rtol=self.quant.rtol,
+                               atol=self.quant.atol):
+                return False, (
+                    f"parity drift vs f32 forward in {k!r}: max "
+                    f"{np.max(np.abs(q - r)):.4g} exceeds rtol="
+                    f"{self.quant.rtol}/atol={self.quant.atol}")
+        self.last_parity_drift = drift
+        return True, None
+
     def _canary_ok(self) -> bool:
         if self.canary_batch is None:
             return True
         out = self.net.forward(self.canary_batch,
                                blob_names=list(self.canary_outputs or ()))
-        return all(np.isfinite(np.asarray(v)).all() for v in out.values())
+        return all(np.isfinite(np.asarray(v, dtype=np.float32)).all()
+                   for v in out.values())
 
     def swap_cooldown_active(self, cooldown_s: float) -> bool:
         """True within `cooldown_s` of the last rejected/rolled-back

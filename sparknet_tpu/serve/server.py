@@ -47,9 +47,11 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..model.quant import QuantConfig
 from ..obs import (MetricsRegistry, StatusServer, register_build_info,
                    trace as obs_trace)
 from ..obs import device as obs_device
+from ..utils.compile_cache import init_compile_cache, track_compiles
 from ..utils.heartbeat import HeartbeatWriter
 from ..utils.logger import Logger
 from ..utils.metrics import FillMeter, LatencyStats
@@ -70,11 +72,36 @@ def net_input_specs(net) -> Dict[str, Tuple[Tuple[int, ...], str]]:
                    dtypes.get(name, "float32")) for name in shapes}
 
 
-def zeros_batch(net, n: int) -> Dict[str, np.ndarray]:
+def zeros_batch(net, n: int, float_dtype=None) -> Dict[str, np.ndarray]:
     """An all-zeros batch of n examples in the net's input schema — the
-    canary forward's food, and the source of padding for absent inputs."""
-    return {name: np.zeros((n,) + shape, dtype=np.dtype(dtype))
-            for name, (shape, dtype) in net_input_specs(net).items()}
+    canary forward's food, and the source of padding for absent inputs.
+    `float_dtype` overrides the schema dtype for FLOATING inputs (the
+    quantized serve path feeds bf16 activation buffers — half the
+    host->device bytes; int/label inputs keep their schema dtype)."""
+    out = {}
+    for name, (shape, dtype) in net_input_specs(net).items():
+        dt = np.dtype(dtype)
+        if float_dtype is not None and np.issubdtype(dt, np.floating):
+            dt = np.dtype(float_dtype)
+        out[name] = np.zeros((n,) + shape, dtype=dt)
+    return out
+
+
+def parity_batch(net, n: int, seed: int = 0) -> Dict[str, np.ndarray]:
+    """A deterministic RANDOM batch in the net's input schema — the
+    quant parity canary's food. Zeros would vet only the bias path (a
+    conv of zeros never touches w, so a corrupted weight SCALE would
+    sail through); standard-normal pixels exercise every quantized
+    weight."""
+    r = np.random.default_rng(seed)
+    out = {}
+    for name, (shape, dtype) in net_input_specs(net).items():
+        dt = np.dtype(dtype)
+        if np.issubdtype(dt, np.floating):
+            out[name] = r.standard_normal((n,) + shape).astype(dt)
+        else:
+            out[name] = np.zeros((n,) + shape, dtype=dt)
+    return out
 
 
 def default_buckets(max_batch: int) -> Tuple[int, ...]:
@@ -100,8 +127,28 @@ class ServeConfig:
     # batching policy
     max_batch: int = 8
     max_wait_ms: float = 5.0            # oldest-request deadline
-    buckets: Optional[Tuple[int, ...]] = None  # None -> powers of 2
+    # batch-size buckets (None -> powers of 2 up to max_batch; or a
+    # traffic-derived ladder from serve.buckets.derive_buckets /
+    # `sparknet-serve --buckets-from`). Validated at CONSTRUCTION
+    # (__post_init__, the ElasticConfig rule): strictly increasing,
+    # positive, and the top rung must cover a full max_batch batch —
+    # a bad ladder used to surface as a StopIteration inside the first
+    # forward's bucket pick, long after the config typo that caused it.
+    buckets: Optional[Tuple[int, ...]] = None
     max_queue: int = 1024               # backpressure threshold
+    # weight-only quantized serving (model/quant.py): None = the f32
+    # path exactly as before; "int8" (or a QuantConfig) = weights are
+    # quantized per output channel at ModelManager load time, forwards
+    # run int8-weight x bf16-activation, and every install is gated on
+    # an allclose parity canary against the f32 forward — a bad
+    # quantization (e.g. a corrupted scale) never serves.
+    quant: Optional[Any] = None
+    # persistent XLA compile cache (utils/compile_cache.py): directory
+    # for jax's compilation cache, so replica cold-starts / hot-swap
+    # retraces / bucket first-forwards re-use executables across
+    # PROCESSES. None = only $SPARKNET_COMPILE_CACHE /
+    # $JAX_COMPILATION_CACHE_DIR, if set.
+    compile_cache_dir: Optional[str] = None
     # per-model latency objective (ms). Advisory: stamped into /status
     # and BENCH_SERVE rows (p99 <= slo at the sustainable rate is the
     # open-loop acceptance); nothing enforces it at runtime.
@@ -132,6 +179,31 @@ class ServeConfig:
     idle_poll_s: float = 0.05
     registry: Optional[MetricsRegistry] = None
 
+    def __post_init__(self) -> None:
+        # fail at construction, not at the first _pick_bucket next() —
+        # the ElasticConfig/OpsImpl rule
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1 "
+                             f"(got {self.max_batch})")
+        if self.buckets is not None:
+            b = tuple(int(x) for x in self.buckets)
+            if not b:
+                raise ValueError("buckets must be None or non-empty")
+            if any(x <= 0 for x in b):
+                raise ValueError(f"buckets must be positive (got {b})")
+            if any(y <= x for x, y in zip(b, b[1:])):
+                raise ValueError(
+                    f"buckets must be strictly increasing — sorted, no "
+                    f"duplicates (got {b})")
+            if b[-1] < self.max_batch:
+                raise ValueError(
+                    f"largest bucket {b[-1]} < max_batch "
+                    f"{self.max_batch}: a full batch would have no "
+                    f"bucket")
+            self.buckets = b
+        # "int8" / dict / QuantConfig -> QuantConfig (validates knobs)
+        self.quant = QuantConfig.coerce(self.quant)
+
 
 class InferenceServer:
     """Dynamic-batching inference over one NetInterface net (module doc)."""
@@ -143,11 +215,27 @@ class InferenceServer:
         self.model_name = cfg.model_name
         self.preprocessor = preprocessor
         self.log = logger
+        # persistent compile cache: process-global, so first-server-wins
+        # on the directory; a replica cold-start with a warm cache dir
+        # re-uses every bucket executable instead of recompiling them.
+        # Called UNCONDITIONALLY (the train loop's rule): with no knob,
+        # $SPARKNET_COMPILE_CACHE / $JAX_COMPILATION_CACHE_DIR still
+        # apply — and get the cache-everything floors dropped
+        init_compile_cache(cfg.compile_cache_dir)
         self.buckets = tuple(sorted(cfg.buckets or
                                     default_buckets(cfg.max_batch)))
         assert self.buckets[-1] >= cfg.max_batch, (
             f"largest bucket {self.buckets[-1]} < max_batch "
             f"{cfg.max_batch}: a full batch would have no bucket")
+        # quantized serving: bf16 activation buffers (half the H2D bytes;
+        # the schema dtype otherwise). The pad-buffer cache below is
+        # keyed by dtype as well as bucket so a quant<->f32 transition
+        # can never alias buffers of the wrong dtype.
+        self.quant = cfg.quant
+        self._float_dtype = None
+        if self.quant is not None and self.quant.act == "bfloat16":
+            import ml_dtypes
+            self._float_dtype = np.dtype(ml_dtypes.bfloat16)
         # the shared-schema registry: every serve component registers into
         # it and /metrics renders it (one exporter for train AND serve);
         # under the router ALL lanes share one registry and the `model`
@@ -184,10 +272,14 @@ class InferenceServer:
         self.manager = ModelManager(
             net, checkpoint_dir=cfg.checkpoint_dir,
             poll_interval_s=cfg.poll_interval_s,
-            canary_batch=(zeros_batch(net, self.buckets[0])
+            canary_batch=(zeros_batch(net, self.buckets[0],
+                                      float_dtype=self._float_dtype)
                           if cfg.canary else None),
             canary_outputs=cfg.outputs, logger=logger, heartbeat=hb,
-            registry=self.registry, model=cfg.model_name)
+            registry=self.registry, model=cfg.model_name,
+            quant=self.quant,
+            parity_batch=(parity_batch(net, self.buckets[0])
+                          if self.quant is not None else None))
         # meters: worker-thread-written, internally locked — status() and
         # the HTTP scrape read consistent snapshots, never torn state
         self.latency = LatencyStats(registry=self.registry,
@@ -199,11 +291,14 @@ class InferenceServer:
         self.batch_log: List[Tuple[int, int]] = []  # (n_real, bucket)
         self._t0 = time.time()
         self._images = 0
-        # pre-sized pad buffers: {bucket: {input: zeros host array}} plus
-        # the set of inputs a previous batch wrote real rows into (those
-        # must be re-zeroed before a batch that doesn't carry them)
-        self._bucket_buf: Dict[int, Dict[str, np.ndarray]] = {}
-        self._bucket_dirty: Dict[int, set] = {}
+        # pre-sized pad buffers: {(bucket, float dtype): {input: zeros
+        # host array}} plus the set of inputs a previous batch wrote real
+        # rows into (those must be re-zeroed before a batch that doesn't
+        # carry them). Keyed by DTYPE as well as bucket: the quantized
+        # path fills bf16 activation buffers, and those must never alias
+        # the f32 buffers a non-quant forward of the same bucket owns.
+        self._bucket_buf: Dict[tuple, Dict[str, np.ndarray]] = {}
+        self._bucket_dirty: Dict[tuple, set] = {}
         # router integration: exactly one thread may drive serve_tick at
         # a time (the lane's own worker, or one pool thread)
         self.lane_lock = threading.Lock()
@@ -274,6 +369,14 @@ class InferenceServer:
         if self._worker is not None:
             self._worker.join(timeout=max(drain_s, 1.0))
             self._worker = None
+        # one final metrics row with the worker quiesced: a short-lived
+        # server (demo, bench arm) whose traffic never reached the
+        # metrics cadence still leaves its batch_size_hist on disk —
+        # the --buckets-from input must survive the process
+        # (metrics_every_batches=0 keeps meaning "JSONL off")
+        if self.log is not None and self.fill.batches and \
+                self.cfg.metrics_every_batches:
+            self._log_metrics_row()
         if self._http is not None:
             self._http.stop()
             self._http = None
@@ -314,6 +417,11 @@ class InferenceServer:
             "batch_fill_ratio": round(real / padded if padded else 0.0, 4),
             "buckets": list(self.buckets),
             "bucket_compiles": len(self._compiled_buckets),
+            # formed-batch size distribution (string keys: JSON object),
+            # the input `serve.buckets.derive_buckets` fits a ladder to
+            "batch_size_hist": {str(s): c for s, c
+                                in sorted(self.fill.size_hist().items())},
+            "quant": None if self.quant is None else self.quant.mode,
             "model_step": m.step,
             "swaps": m.swaps,
             "swap_failures": m.swap_failures,
@@ -434,6 +542,15 @@ class InferenceServer:
         with obs_trace.span("forward", n=len(reqs)):
             self._forward_group_inner(reqs)
 
+    @staticmethod
+    def _wire_dtype(v):
+        """bf16 blobs (the quantized forward's outputs) -> f32 for the
+        response; everything else passes through untouched."""
+        arr = np.asarray(v)
+        if str(arr.dtype) == "bfloat16":
+            return arr.astype(np.float32)
+        return arr
+
     def _bucket_batch(self, reqs: List[ServeRequest], bucket: int
                       ) -> Dict[str, np.ndarray]:
         """Fill this bucket's cached buffers with the group's rows: one
@@ -441,10 +558,12 @@ class InferenceServer:
         it, the pad tail re-zeroed. Inputs absent from the request stay
         zero (re-zeroed only when a previous batch dirtied them)."""
         n = len(reqs)
-        buf = self._bucket_buf.get(bucket)
+        key = (bucket, str(self._float_dtype))
+        buf = self._bucket_buf.get(key)
         if buf is None:
-            buf = self._bucket_buf[bucket] = zeros_batch(self.net, bucket)
-            self._bucket_dirty[bucket] = set()
+            buf = self._bucket_buf[key] = zeros_batch(
+                self.net, bucket, float_dtype=self._float_dtype)
+            self._bucket_dirty[key] = set()
         payload = reqs[0].payload
         if self.preprocessor is not None:
             # batch-level decode, eval semantics: center crop + mean
@@ -453,7 +572,7 @@ class InferenceServer:
             payload = self.preprocessor.convert_batch(
                 {k: np.stack([r.payload[k] for r in reqs])
                  for k in payload}, train=False)
-        dirty = self._bucket_dirty[bucket]
+        dirty = self._bucket_dirty[key]
         for k in dirty - set(payload):
             buf[k][:] = 0  # stale rows from a batch that carried k
         dirty.intersection_update(payload)
@@ -484,21 +603,29 @@ class InferenceServer:
         try:
             full = self._bucket_batch(reqs, bucket)
             t_fwd0 = time.perf_counter()
-            out = self.net.forward(
-                full, blob_names=list(self.cfg.outputs or ()))
+            with track_compiles() as tc:
+                out = self.net.forward(
+                    full, blob_names=list(self.cfg.outputs or ()))
             if bucket not in self._compiled_buckets:
-                # this forward traced+compiled the bucket's executable
+                # this forward traced+compiled the bucket's executable;
+                # cache_hit says whether the persistent compile cache
+                # served it (warm replica cold-start) or XLA built it
+                # fresh (utils/compile_cache.py region verdict)
                 self._compiled_buckets.add(bucket)
                 dt = time.perf_counter() - t_fwd0
                 self._c_bucket_compiles.inc(model=self.model_name)
                 self._h_bucket_compile.observe(dt, model=self.model_name)
-                obs_device.note_compile("serve_bucket", dt)
+                obs_device.note_compile("serve_bucket", dt,
+                                        cache_hit=tc.cache_hit)
             # de-pad: slice each request's own row out of per-row blobs;
             # batch-AGGREGATE blobs (the zoo heads' scalar loss/accuracy
             # — averaged over padding, meaningless per request) are
             # dropped unless cfg.outputs names them explicitly
             want = set(self.cfg.outputs) if self.cfg.outputs else None
-            fields = [(k, v, getattr(v, "ndim", 0) >= 1
+            # responses are always f32 on the wire: the quantized path
+            # computes in bf16, but npz does not round-trip bf16 and
+            # clients should not need ml_dtypes to read a probability
+            fields = [(k, self._wire_dtype(v), getattr(v, "ndim", 0) >= 1
                        and v.shape[0] == bucket)
                       for k, v in out.items()
                       if want is None or k in want]
@@ -526,9 +653,17 @@ class InferenceServer:
             del self.batch_log[:5000]
         if self.cfg.metrics_every_batches and self.log is not None and \
                 self.fill.batches % self.cfg.metrics_every_batches == 0:
-            self.log.metrics(self.fill.batches, **{
-                k: v for k, v in self.status().items()
-                if isinstance(v, (int, float)) and v is not None})
+            self._log_metrics_row()
+
+    def _log_metrics_row(self) -> None:
+        st = self.status()
+        self.log.metrics(self.fill.batches, model=self.model_name,
+                         # cumulative; offline readers (sparknet-metrics,
+                         # --buckets-from) take the LAST row per model
+                         batch_size_hist=st["batch_size_hist"], **{
+                             k: v for k, v in st.items()
+                             if isinstance(v, (int, float))
+                             and v is not None})
 
     def _log(self, msg: str) -> None:
         if self.log is not None:

@@ -1,0 +1,191 @@
+"""Persistent XLA compilation cache — cold-start-to-zero for serve + train.
+
+Every replica cold-start, checkpoint hot-swap retrace, serve-bucket first
+forward, and elastic `trainer_factory` rebuild pays a fresh XLA compile
+today; PR 5's compile-event telemetry (`obs/device.py`) measures exactly
+what that costs but nothing SAVES it. This module wires jax's persistent
+compilation cache (`jax_compilation_cache_dir`) through one init point and
+gives the telemetry the `cache_hit` signal:
+
+  - `init_compile_cache(dir)` — point jax at a persistent on-disk cache
+    (local path; a pod shares one via NFS or a per-host mirror). Resolves,
+    in order: the explicit argument, `$SPARKNET_COMPILE_CACHE`, then
+    whatever `jax_compilation_cache_dir` already holds (jax binds it to
+    `$JAX_COMPILATION_CACHE_DIR` natively). The entry-size / min-compile-
+    time floors are dropped to "cache everything": serve-bucket forwards
+    on small nets compile in well under jax's default 1 s floor, and those
+    are exactly the compiles a replica cold-start repays.
+
+  - `track_compiles()` — a context manager counting the fresh XLA backend
+    compiles and persistent-cache hits/misses that happen INSIDE the
+    region, on this thread. `obs.device.timed_compile` and the serve
+    bucket first-forward wrap their compile regions with it and stamp the
+    verdict as the `cache_hit` label on `sparknet_compile_events_total`:
+    a region that did no fresh XLA work (everything served from the
+    persistent cache, or no XLA compile at all — e.g. a memoized spec
+    compile) is a HIT; a region that built at least one executable from
+    scratch is a MISS. "Zero cache_hit=false events on a warm replica
+    cold-start" is then a scrapeable acceptance number (BENCH_ECON).
+
+Counting rides `jax.monitoring`: jax records
+`/jax/core/compile/backend_compile_duration` around every
+compile-or-fetch and `/jax/compilation_cache/cache_{hits,misses}` when
+the persistent cache is consulted, all ON THE COMPILING THREAD — so
+thread-local counters attribute a region's compiles to the thread that
+ran it (the serve lane's single-writer worker, the trainer's dispatch
+thread) even while other lanes compile concurrently.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_lock = threading.Lock()
+_listening = False
+_cache_dir: Optional[str] = None
+_tls = threading.local()
+
+
+def _counts():
+    c = getattr(_tls, "counts", None)
+    if c is None:
+        c = _tls.counts = {"xla": 0, "hit": 0, "miss": 0}
+    return c
+
+
+def _on_event(event: str, **kw) -> None:
+    if event == _CACHE_HIT_EVENT:
+        _counts()["hit"] += 1
+    elif event == _CACHE_MISS_EVENT:
+        _counts()["miss"] += 1
+
+
+def _on_duration(event: str, duration: float, **kw) -> None:
+    if event == _BACKEND_COMPILE_EVENT:
+        _counts()["xla"] += 1
+
+
+def ensure_listeners() -> None:
+    """Register the jax.monitoring listeners once per process (idempotent,
+    cheap). Called by init and by every track_compiles — compile counting
+    works even when no persistent cache is configured."""
+    global _listening
+    with _lock:
+        if _listening:
+            return
+        import jax.monitoring as mon
+        mon.register_event_listener(_on_event)
+        mon.register_event_duration_secs_listener(_on_duration)
+        _listening = True
+
+
+def init_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Initialize the persistent compilation cache (idempotent; safe to
+    call from the train loop, the serve CLI, and tests alike). Returns
+    the active cache directory, or None when no directory is configured
+    anywhere — in which case only the compile-counting listeners are
+    installed and every XLA-compiling region reads as a cache MISS
+    (honest: there is no cache to hit)."""
+    ensure_listeners()
+    import jax
+
+    global _cache_dir
+    d = cache_dir or os.environ.get("SPARKNET_COMPILE_CACHE") or None
+    if d is None:
+        try:
+            d = jax.config.jax_compilation_cache_dir  # env-bound option
+        except AttributeError:
+            d = None
+    if not d:
+        return _cache_dir
+    d = os.path.abspath(os.path.expanduser(str(d)))
+    with _lock:
+        if _cache_dir is not None:
+            # FIRST caller wins: the cache is process-global jax state,
+            # and repointing it mid-flight would abandon every lane's
+            # warm entries (reset_for_tests() exists for tests that
+            # genuinely need a fresh dir)
+            return _cache_dir
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        # cache EVERYTHING: the default floors (1 s compile time, 4 KiB
+        # entries) skip exactly the small serve-bucket executables whose
+        # re-compilation a replica cold-start is made of
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # jax latches cache-off on the first compile that runs without a
+        # dir configured; a server/CLI initializing AFTER model build
+        # (any jax touch) would silently get no cache. reset_cache()
+        # drops the latch so the next compile re-reads the config.
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc)
+            _cc.reset_cache()
+        except Exception:
+            pass  # older/newer jax without the hook: init-early still works
+        _cache_dir = d
+    return d
+
+
+def cache_dir() -> Optional[str]:
+    """The active persistent-cache directory (None = not initialized)."""
+    return _cache_dir
+
+
+def is_initialized() -> bool:
+    return _cache_dir is not None
+
+
+class track_compiles:
+    """Context manager: counts this THREAD's fresh XLA backend compiles
+    and persistent-cache hits/misses inside the region.
+
+    After exit: `.xla_compiles`, `.cache_hits`, `.cache_misses`, and the
+    verdict `.cache_hit` — True iff the region required no fresh XLA
+    compilation (no backend compile at all, or every compile request was
+    served from the persistent cache). With no cache configured, any XLA
+    compile in the region is by definition a miss."""
+
+    xla_compiles = 0
+    cache_hits = 0
+    cache_misses = 0
+
+    def __enter__(self) -> "track_compiles":
+        ensure_listeners()
+        c = _counts()
+        self._t0 = (c["xla"], c["hit"], c["miss"])
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        c = _counts()
+        self.xla_compiles = c["xla"] - self._t0[0]
+        self.cache_hits = c["hit"] - self._t0[1]
+        self.cache_misses = c["miss"] - self._t0[2]
+        return False
+
+    @property
+    def cache_hit(self) -> bool:
+        if self.xla_compiles == 0:
+            return True  # nothing was compiled fresh
+        # fresh XLA work happened: a hit requires the persistent cache
+        # to have actually been CONSULTED for it (hit/miss events fired)
+        # with zero misses. `is_initialized()` alone is not enough — a
+        # configured-but-latched-off cache (init after first compile on
+        # a jax without the reset hook) would otherwise read as a hit
+        # exactly when the cache silently failed.
+        return (self.cache_misses == 0
+                and self.cache_hits + self.cache_misses > 0)
+
+
+def reset_for_tests() -> None:
+    """Clear the active-dir latch so tests can re-init against their own
+    tmp dirs (the jax config itself is process-global either way)."""
+    global _cache_dir
+    with _lock:
+        _cache_dir = None

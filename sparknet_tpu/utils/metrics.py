@@ -195,7 +195,17 @@ class FillMeter:
     """Batch-fill accounting for the dynamic batcher: real examples over
     padded bucket slots. fill == 1.0 means every compiled forward ran at
     its bucket's full width; low fill at high offered load means the
-    batcher is flushing early (deadline too tight or buckets too big)."""
+    batcher is flushing early (deadline too tight or buckets too big).
+
+    Also keeps the per-batch-SIZE histogram — how many formed batches
+    carried exactly n real examples. That distribution is what
+    `serve.buckets.derive_buckets` fits a bucket ladder to (the Orca
+    lesson: schedule the queue INTO the accelerator's batch shape), so
+    the meter that measures fill also records the evidence for fixing
+    it. The histogram lands in /status and the serve JSONL
+    (`batch_size_hist`), and in the registry as
+    `<prefix>_size_batches_total{model,size}` (cardinality is bounded by
+    max_batch)."""
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  prefix: str = "sparknet_serve_batch",
@@ -206,9 +216,11 @@ class FillMeter:
         self.real = 0
         self.padded = 0
         self.batches = 0
+        self.size_counts: Dict[int, int] = {}
         self._lock = threading.Lock()
         self._labels = {} if model is None else {"model": str(model)}
         self._c_rows = self._c_batches = self._g_fill = None
+        self._c_sizes = None
         if registry is not None:
             lnames = tuple(self._labels)
             self._c_rows = registry.counter(
@@ -222,18 +234,25 @@ class FillMeter:
                 f"{prefix}_fill_ratio",
                 "real rows / padded bucket slots, cumulative",
                 labels=lnames)
+            self._c_sizes = registry.counter(
+                f"{prefix}_size_batches_total",
+                "formed batches by real-example count (the bucket-ladder "
+                "derivation input)", labels=lnames + ("size",))
 
     def add(self, n_real: int, bucket: int) -> None:
         with self._lock:
             self.real += int(n_real)
             self.padded += int(bucket)
             self.batches += 1
+            self.size_counts[int(n_real)] = \
+                self.size_counts.get(int(n_real), 0) + 1
         if self._c_rows is not None:
             self._c_rows.inc(int(n_real), kind="real", **self._labels)
             self._c_rows.inc(int(bucket) - int(n_real), kind="padding",
                              **self._labels)
             self._c_batches.inc(**self._labels)
             self._g_fill.set(self.ratio(), **self._labels)
+            self._c_sizes.inc(size=int(n_real), **self._labels)
 
     def ratio(self) -> float:
         with self._lock:
@@ -244,6 +263,12 @@ class FillMeter:
         with self._lock:
             return self.real, self.padded, self.batches
 
+    def size_hist(self) -> Dict[int, int]:
+        """{real batch size: formed batches} — a consistent copy."""
+        with self._lock:
+            return dict(self.size_counts)
+
     def reset(self) -> None:
         with self._lock:
             self.real = self.padded = self.batches = 0
+            self.size_counts.clear()

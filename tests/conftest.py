@@ -26,6 +26,21 @@ def _logs_to_tmp(tmp_path, monkeypatch):
     monkeypatch.setenv("SPARKNET_TPU_HOME", str(tmp_path))
 
 
+@pytest.fixture(autouse=True)
+def _precision_policy_isolated():
+    """Restore the (thread-local) precision policy after every test: the
+    bench arms set bfloat16 on the main thread and a leaked policy turns
+    later f32-exactness tests red — a latent cross-file coupling that only
+    shows when the whole suite runs in one process past test_bench."""
+    import jax.numpy as jnp
+
+    from sparknet_tpu import precision
+    prev = ("bfloat16" if precision.compute_dtype() == jnp.bfloat16
+            else "float32")
+    yield
+    precision.set_policy(prev)
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)

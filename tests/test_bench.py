@@ -126,6 +126,41 @@ def test_serve_bench_smoke(tmp_path):
     assert (tmp_path / "keep" / "serve_bench.jsonl").exists()
 
 
+def test_econ_bench_smoke(tmp_path):
+    """bench.econ_bench runs the three r9 inference-economics levers
+    through the REAL serving stack: quant-vs-f32 saturate + parity, the
+    cold/warm subprocess replica against a shared persistent compile
+    cache, and the pow2-vs-derived bucket-ladder A/B on a skewed trace.
+    The committed BENCH_ECON.json pins the acceptance numbers; this
+    smoke asserts the harness and its gates hold at CI scale."""
+    import bench
+    out = bench.econ_bench(out_path=str(tmp_path / "BENCH_ECON.json"),
+                           duration_s=0.4, max_batch=8,
+                           keep=str(tmp_path / "keep"))
+    head = out["headline"]
+    # quant parity: drift within the calibrated tolerance
+    assert head["quant_parity_ok"] is True
+    # the cold-start acceptance: a warm replica compiles NOTHING fresh
+    assert head["coldstart_warm_zero_miss"] is True
+    cold_s, warm_s = head["coldstart_cold_vs_warm_s"]
+    assert cold_s > 0 and warm_s > 0
+    # the ladder acceptance: derived beats pow2 on fill, jit cache pinned
+    assert head["ladder_fill_improved"] is True
+    assert head["jit_cache_ok"] is True
+    assert head["ok"] is True
+    rows = {r.get("arm", r.get("load")): r for r in out["rows"]}
+    warm_stats = rows["coldstart"]["warm_compile_stats"]
+    for what in ("net", "serve_bucket"):
+        assert warm_stats.get(what, {}).get("cache_misses", 1) == 0, what
+    lad = rows["ladder_ab"]
+    # the deterministic half: optimal-by-construction on the observed
+    # histogram, never worse than pow2
+    assert lad["derived_fill_on_observed"] >= lad["pow2_fill_on_observed"]
+    art = json.load(open(tmp_path / "BENCH_ECON.json"))
+    assert art["headline"]["metric"] == "serve_econ_levers"
+    assert (tmp_path / "keep" / "econ_bench.log").exists()
+
+
 def test_obs_bench_smoke(tmp_path, monkeypatch):
     """bench.obs_bench runs the REAL train loop in both arms (telemetry
     on with status server + trace + scraper, and off) and writes a

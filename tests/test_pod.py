@@ -371,7 +371,8 @@ def test_train_worker_exports_straggler_inputs(pod_trained):
                  "sparknet_train_data_wait_seconds",
                  "sparknet_train_round_compiled_variants",
                  "sparknet_device_live_arrays",
-                 'sparknet_compile_events_total{what="net"}'):
+                 # the r9 cache_hit label rides every compile event
+                 'sparknet_compile_events_total{what="net",cache_hit='):
         assert name in text, f"missing {name} in worker /metrics"
 
 
@@ -427,21 +428,68 @@ def test_device_telemetry_memory_gauges_from_stats():
     assert 'sparknet_device_hbm_peak_bytes{device="tpu:3"} 4096' in text
 
 
+def _compile_event_count(reg, what):
+    snap = reg.snapshot()["sparknet_compile_events_total"]
+    return sum(v for key, v in snap["values"].items() if key[0] == what)
+
+
 def test_compile_events_replayed_into_late_registry():
     from sparknet_tpu.model.net import CompiledNet
-    from sparknet_tpu.obs.device import attach_compile_metrics
+    from sparknet_tpu.obs.device import (attach_compile_metrics,
+                                         compile_stats)
     from sparknet_tpu.zoo import lenet
 
     CompiledNet.compile(lenet(batch=2))  # happens BEFORE the registry
     reg = MetricsRegistry()
     attach_compile_metrics(reg)
-    c = reg.counter("sparknet_compile_events_total", labels=("what",))
-    before = c.value(what="net")
-    assert before and before >= 1  # the history replayed
+    before = _compile_event_count(reg, "net")
+    assert before >= 1  # the history replayed
     CompiledNet.compile(lenet(batch=2))  # and live events keep flowing
-    assert c.value(what="net") == before + 1
+    assert _compile_event_count(reg, "net") == before + 1
+    # the seconds histogram carries REAL compile cost only: memo/cache
+    # hits count events but never dilute the duration percentiles
     snap = reg.snapshot()["sparknet_compile_seconds"]
-    assert snap["values"][("net",)]["count"] == c.value(what="net")
+    stats = compile_stats()["net"]
+    assert snap["values"][("net",)]["count"] == \
+        stats["events"] - stats["cache_hits"]
+
+
+def test_compile_events_cache_hit_labeling():
+    """The r9 cache_hit label end to end: a region doing FRESH XLA work
+    records cache_hit="false" (a cold compile — with no persistent cache
+    there is nothing to hit), an identical spec recompile records
+    cache_hit="true" (the CompiledNet memo: zero fresh work), and the
+    Prometheus exposition carries both label values."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparknet_tpu.model.net import CompiledNet
+    from sparknet_tpu.obs.device import (attach_compile_metrics,
+                                         compile_stats, timed_compile)
+    from sparknet_tpu.zoo import lenet
+
+    what = f"test_site_{time.time_ns()}"  # unique event site
+    salt = time.time_ns() % 89
+    f = jax.jit(lambda x: x * 3 + salt)   # a jit nobody compiled before
+    with timed_compile(what):
+        f(jnp.ones((2,)))                 # cold: fresh XLA compile
+    assert compile_stats()[what]["cache_misses"] == 1
+    with timed_compile(what):
+        f(jnp.ones((2,)))                 # cached executable: no work
+    assert compile_stats()[what]["cache_hits"] == 1
+    # identical spec recompile -> memo hit recorded as a hit
+    CompiledNet.compile(lenet(batch=2))
+    before = compile_stats()["net"]["cache_hits"]
+    CompiledNet.compile(lenet(batch=2))
+    assert compile_stats()["net"]["cache_hits"] == before + 1
+    # the exposition carries the label, both values
+    reg = MetricsRegistry()
+    attach_compile_metrics(reg)
+    text = reg.render_prometheus()
+    assert (f'sparknet_compile_events_total{{what="{what}",'
+            f'cache_hit="false"}} 1') in text
+    assert (f'sparknet_compile_events_total{{what="{what}",'
+            f'cache_hit="true"}} 1') in text
 
 
 def test_serve_bucket_recompile_counter_steady_state():

@@ -1,0 +1,475 @@
+"""Inference economics (r9): quantized serving, persistent compile cache,
+traffic-derived bucket ladders.
+
+Tier-1 (CPU). The contracts pinned:
+
+  - quantization math: per-channel symmetric int8 round-trips within
+    scale/2 per weight; the quantized pytree is self-describing.
+  - per-bucket parity: the int8-weight/bf16-activation forward stays
+    allclose to the f32 forward within the calibrated QuantConfig
+    tolerance on every zoo serve model, at every bucket size — the PR 7
+    Pallas-pin pattern applied to the quant lever.
+  - the load-time parity gate: a corrupted-scale quantization NEVER
+    serves — canary-rejected mid-traffic with zero corrupted responses
+    (the chaos acceptance), and the f32 path stays bitwise untouched.
+  - bucket-ladder derivation: derive_buckets is optimal on the observed
+    histogram (checked against exhaustive search) and the ladder rides
+    config validation (ServeConfig.__post_init__ fails bad ladders at
+    construction).
+  - compile-cache verdicts: a fresh XLA compile region records a MISS,
+    a no-fresh-work region (memoized spec compile, cached executable)
+    records a HIT, and the exposition carries the label.
+"""
+import itertools
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.model.quant import (QuantConfig, dequantize_params,
+                                      is_quantized, quantize_leaf,
+                                      quantize_params)
+from sparknet_tpu.net_api import JaxNet
+from sparknet_tpu.serve import (InferenceServer, ModelManager, ServeConfig,
+                                ServeModelError, derive_buckets,
+                                fill_ratio, parity_batch,
+                                size_hist_from_jsonl, zeros_batch)
+from sparknet_tpu.utils import checkpoint as ckpt
+from sparknet_tpu.utils.metrics import FillMeter
+from sparknet_tpu.zoo import adult_mlp, caffenet, cifar10_quick, lenet
+
+
+# -- quantization math -------------------------------------------------------
+
+def test_quantize_leaf_roundtrip_error_bounded():
+    r = np.random.default_rng(0)
+    w = (r.standard_normal((5, 5, 3, 16)) * r.uniform(0.01, 3.0, 16))
+    w = w.astype(np.float32)
+    q = quantize_leaf(w)
+    assert np.asarray(q["w_q"]).dtype == np.int8
+    assert q["w_scale"].shape == (16,)
+    deq = np.asarray(q["w_q"], np.float32) * np.asarray(q["w_scale"])
+    # symmetric rounding: error <= scale/2 per element, per channel
+    assert np.all(np.abs(deq - w) <= np.asarray(q["w_scale"]) / 2 + 1e-7)
+    # an all-zero channel must not divide by zero
+    w[..., 3] = 0.0
+    q0 = quantize_leaf(w)
+    assert np.all(np.asarray(q0["w_q"])[..., 3] == 0)
+    assert np.isfinite(np.asarray(q0["w_scale"])).all()
+
+
+def test_quantize_params_structure_and_dequant():
+    net = JaxNet(lenet(batch=2))
+    qp = quantize_params(net.params, QuantConfig())
+    assert is_quantized(qp) and not is_quantized(net.params)
+    for lname, lp in net.params.items():
+        if "w" in lp and np.ndim(lp["w"]) >= 2:
+            assert "w_q" in qp[lname] and "w_scale" in qp[lname]
+            assert "w" not in qp[lname]
+        if "b" in lp:  # biases ride along in f32
+            np.testing.assert_array_equal(np.asarray(qp[lname]["b"]),
+                                          np.asarray(lp["b"]))
+    deq = dequantize_params(qp)
+    for lname, lp in net.params.items():
+        for pname, w in lp.items():
+            assert deq[lname][pname].shape == np.shape(w)
+
+
+def test_quant_config_validates_at_construction():
+    with pytest.raises(ValueError, match="quant mode"):
+        QuantConfig(mode="int4")
+    with pytest.raises(ValueError, match="act dtype"):
+        QuantConfig(act="float16")
+    assert QuantConfig.coerce("int8").mode == "int8"
+    assert QuantConfig.coerce(None) is None
+    assert QuantConfig.coerce({"atol": 0.2}).atol == 0.2
+    with pytest.raises(ValueError, match="quant"):
+        QuantConfig.coerce(3.14)
+
+
+# -- per-bucket parity on the zoo serve models -------------------------------
+
+def _zoo_serve_models():
+    # every zoo model the serve path can carry, at serve-size shapes
+    # (caffenet at the e2e-smoke crop: tier-1 budget, same layer set)
+    return [("lenet", lenet(batch=4)),
+            ("cifar10_quick", cifar10_quick(batch=4)),
+            ("adult_mlp", adult_mlp(batch=4, n_features=10)),
+            ("caffenet", caffenet(batch=4, crop=67, n_classes=16))]
+
+
+@pytest.mark.parametrize("name,spec", _zoo_serve_models(),
+                         ids=[n for n, _ in _zoo_serve_models()])
+def test_quant_parity_per_bucket_vs_f32(name, spec):
+    """The acceptance pin: quantized forward allclose to f32 within the
+    calibrated tolerance on EVERY zoo serve model, per bucket (1 and a
+    full bucket — the two compiled shapes a 2-rung ladder serves)."""
+    net = JaxNet(spec)
+    qc = QuantConfig()
+    f32p = net.params
+    qp = quantize_params(f32p, qc)
+    for bucket in (1, 4):
+        batch = parity_batch(net, bucket, seed=11)
+        net.params = f32p
+        net.set_quant(None)
+        ref = net.forward(batch)
+        net.params = qp
+        net.set_quant(qc)
+        out = net.forward(batch)
+        # per-row blobs — the responses clients consume; batch-aggregate
+        # scalars (accuracy) are argmax-discontinuous, the gate's
+        # documented exclusion
+        for k, rv in ref.items():
+            if np.ndim(rv) < 1:
+                continue
+            qv = np.asarray(out[k], np.float32)
+            rv = np.asarray(rv, np.float32)
+            assert np.isfinite(qv).all(), (name, bucket, k)
+            np.testing.assert_allclose(
+                qv, rv, rtol=qc.rtol, atol=qc.atol,
+                err_msg=f"{name} bucket {bucket} blob {k}")
+        net.params = f32p
+        net.set_quant(None)
+
+
+def test_f32_path_bitwise_untouched_by_quant_plumbing():
+    """The quant lever must not perturb the f32 path: a forward through
+    the same net before and after a quantized install/rollback cycle is
+    BITWISE identical."""
+    net = JaxNet(lenet(batch=4))
+    batch = parity_batch(net, 4, seed=3)
+    ref = net.forward(batch, blob_names=["prob"])
+    f32p = net.params
+    net.params = quantize_params(f32p, QuantConfig())
+    net.set_quant(QuantConfig())
+    net.forward(batch, blob_names=["prob"])  # quantized trace exercised
+    net.params = f32p
+    net.set_quant(None)
+    again = net.forward(batch, blob_names=["prob"])
+    np.testing.assert_array_equal(ref["prob"], again["prob"])
+
+
+# -- quantized serving end to end --------------------------------------------
+
+def _example(i):
+    r = np.random.default_rng(1000 + i)
+    return {"data": r.standard_normal((28, 28, 1)).astype(np.float32)}
+
+
+def test_quantized_server_serves_f32_wire_within_tol():
+    """End to end: a quantized server answers f32 arrays (npz/JSON
+    clients never see bf16), within tolerance of an f32 server over the
+    same weights, with the jit cache pinned at len(buckets) and the pad
+    buffers keyed by the bf16 activation dtype (satellite: no aliasing
+    with f32 buffers)."""
+    spec = lenet(batch=4)
+    net_f = JaxNet(spec)
+    net_q = JaxNet(spec)
+    net_q.set_weights(net_f.get_weights())  # identical weights
+    cfg_f = ServeConfig(max_batch=4, max_wait_ms=2.0, buckets=(1, 4),
+                        outputs=("prob",), metrics_every_batches=0)
+    cfg_q = ServeConfig(max_batch=4, max_wait_ms=2.0, buckets=(1, 4),
+                        outputs=("prob",), metrics_every_batches=0,
+                        quant="int8")
+    with InferenceServer(net_f, cfg_f) as sf:
+        refs = [sf.infer(_example(i)) for i in range(3)]
+    with InferenceServer(net_q, cfg_q) as sq:
+        outs = [sq.infer(_example(i)) for i in range(3)]
+        futs = [sq.submit(_example(i)) for i in range(4)]
+        for f in futs:
+            f.result(timeout=30.0)
+        st = sq.status()
+        assert st["quant"] == "int8"
+        assert st["bucket_compiles"] == 2 == len(sq.buckets)
+        assert all(k[1] == "bfloat16" for k in sq._bucket_buf)
+        hist = st["batch_size_hist"]
+        assert sum(int(v) for v in hist.values()) == st["batches"]
+    qc = QuantConfig()
+    for ref, out in zip(refs, outs):
+        assert out["prob"].dtype == np.float32
+        np.testing.assert_allclose(out["prob"], ref["prob"],
+                                   rtol=qc.rtol, atol=qc.atol)
+
+
+def test_quant_rejects_graph_backend():
+    class FakeGraphNet:  # no .params / .set_quant
+        pass
+    with pytest.raises(ServeModelError, match="quantized serving"):
+        ModelManager(FakeGraphNet(), quant=QuantConfig())
+
+
+def test_manager_quantizes_initial_weights_without_checkpoint():
+    net = JaxNet(lenet(batch=4))
+    m = ModelManager(net, quant=QuantConfig(),
+                     parity_batch=parity_batch(net, 1))
+    assert m.load_initial() is None
+    assert is_quantized(net.params) and net.quant is not None
+    assert m.last_parity_drift is not None
+    assert m.last_parity_drift <= QuantConfig().atol
+
+
+def _save_trainstate_like(net_params, d, step, scale=1.0):
+    flat = {}
+    for lname, lp in net_params.items():
+        for pname, w in lp.items():
+            flat[f"params/{lname}/{pname}"] = np.asarray(w)[None] * scale
+    return ckpt.save(str(d), flat, step=step)
+
+
+def test_manager_hot_swap_installs_quantized(tmp_path):
+    net = JaxNet(lenet(batch=4))
+    f32p = {l: {p: np.asarray(w) for p, w in lp.items()}
+            for l, lp in net.params.items()}
+    d = tmp_path / "ck"
+    _save_trainstate_like(f32p, d, step=5, scale=0.5)
+    m = ModelManager(net, checkpoint_dir=str(d), quant=QuantConfig(),
+                     parity_batch=parity_batch(net, 1),
+                     canary_batch=zeros_batch(net, 1))
+    assert m.load_initial() == 5
+    assert is_quantized(net.params)
+    # the installed quantization dequantizes to the checkpoint's weights
+    deq = dequantize_params(net.params)
+    w_ref = f32p["conv1"]["w"] * 0.5
+    got = np.asarray(deq["conv1"]["w"])
+    assert np.max(np.abs(got - w_ref)) <= \
+        float(np.max(np.asarray(net.params["conv1"]["w_scale"]))) / 2 + 1e-6
+
+
+@pytest.mark.chaos
+def test_corrupted_scale_checkpoint_canary_rejected_mid_traffic(
+        tmp_path, monkeypatch):
+    """The quant chaos acceptance: mid-traffic, (1) a good checkpoint
+    hot-swaps into the QUANTIZED path, (2) a checkpoint whose
+    quantization comes out corrupted (scale blown up 16x on one layer —
+    digest-valid bytes, poisoned math) is canary-rejected by the parity
+    gate with the server still answering from the previous weights.
+    Zero dropped, zero corrupted responses."""
+    import sparknet_tpu.serve.model_manager as mm
+
+    net = JaxNet(lenet(batch=4))
+    f32p = {l: {p: np.asarray(w) for p, w in lp.items()}
+            for l, lp in net.params.items()}
+    d = tmp_path / "ck"
+    _save_trainstate_like(f32p, d, step=1)
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      checkpoint_dir=str(d), poll_interval_s=0.05,
+                      metrics_every_batches=0, quant="int8")
+    answered, bad = [], []
+    stop = threading.Event()
+
+    def client():
+        i = 0
+        while not stop.is_set():
+            try:
+                out = srv.infer(_example(i), timeout=30.0)
+                p = out["prob"]
+                if p.shape != (10,) or p.dtype != np.float32 or \
+                        not np.isfinite(p).all() or \
+                        abs(float(p.sum()) - 1.0) > 5e-2:
+                    bad.append((i, p))
+                answered.append(i)
+            except Exception as e:
+                bad.append((i, e))
+            i += 1
+
+    real_quantize = mm.quantize_params
+
+    def corrupted_quantize(params, cfg_):
+        qp = real_quantize(params, cfg_)
+        qp["fc1"]["w_scale"] = qp["fc1"]["w_scale"] * 16.0
+        return qp
+
+    with InferenceServer(net, cfg) as srv:
+        assert srv.manager.step == 1 and is_quantized(net.params)
+        threads = [threading.Thread(target=client) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            # (1) a good swap lands, still quantized
+            _save_trainstate_like(f32p, d, step=2, scale=0.9)
+            _wait(lambda: srv.manager.step == 2)
+            assert is_quantized(net.params)
+            # (2) corrupted scales: the parity gate must reject
+            monkeypatch.setattr(
+                "sparknet_tpu.serve.model_manager.quantize_params",
+                corrupted_quantize)
+            _save_trainstate_like(f32p, d, step=3, scale=0.8)
+            fails = srv.manager.swap_failures
+            _wait(lambda: srv.manager.swap_failures > fails)
+            assert srv.manager.step == 2  # still the good one
+            assert "quantization rejected" in srv.manager.last_error
+            # (3) with honest quantization back, the NEXT step serves
+            monkeypatch.setattr(
+                "sparknet_tpu.serve.model_manager.quantize_params",
+                real_quantize)
+            _save_trainstate_like(f32p, d, step=4, scale=0.8)
+            _wait(lambda: srv.manager.step == 4)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not bad, bad[:3]
+        assert len(answered) > 10
+        assert srv.manager.swaps == 2
+        assert srv.manager.swap_failures == 1
+        assert srv.status()["requests_failed"] == 0
+
+
+def _wait(cond, timeout=30.0):
+    t0 = time.monotonic()
+    while not cond():
+        assert time.monotonic() - t0 < timeout, "condition never held"
+        time.sleep(0.02)
+
+
+def test_serve_cli_quant_and_buckets_from(tmp_path, capsys):
+    """The sparknet-serve wiring end to end: a --quant int8 demo records
+    a serve JSONL; a second launch derives its bucket ladder from that
+    JSONL via --buckets-from and serves on it."""
+    from sparknet_tpu.serve.app import main
+
+    main(["--model", "lenet", "--outputs", "prob", "--max-batch", "4",
+          "--quant", "int8", "--demo", "6", "--workdir", str(tmp_path)])
+    status = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert status["requests_ok"] == 6 and status["requests_failed"] == 0
+    assert status["quant"] == "int8"
+    jsonls = list(tmp_path.glob("serving_metrics_*.jsonl"))
+    assert jsonls, "demo wrote no serve JSONL"
+    # hand the recorded traffic back as the ladder source
+    main(["--model", "lenet", "--outputs", "prob", "--max-batch", "4",
+          "--buckets-from"] + [str(p) for p in jsonls] +
+         ["--buckets-k", "2", "--demo", "4", "--workdir", str(tmp_path)])
+    status2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert status2["requests_ok"] == 4
+    b = status2["buckets"]
+    assert b[-1] == 4 and len(b) <= 2  # a derived <=2-rung ladder
+
+
+# -- bucket-ladder derivation ------------------------------------------------
+
+def test_derive_buckets_optimal_vs_exhaustive():
+    """The DP matches exhaustive search over all <=k ladders on skewed
+    histograms (the padded-slots objective, top rung pinned)."""
+    r = np.random.default_rng(5)
+    for trial in range(6):
+        sizes = {int(s): int(r.integers(1, 60))
+                 for s in r.choice(np.arange(1, 17), size=6,
+                                   replace=False)}
+        for k in (1, 2, 3, 4):
+            got = derive_buckets(sizes, 16, k=k)
+            assert len(got) <= k and got[-1] == 16
+            cand = sorted(set(sizes) - {16})
+            best = min(
+                padded(sizes, tuple(sorted(set(c) | {16})))
+                for n in range(0, k)           # n lower rungs + the top
+                for c in itertools.combinations(cand, n))
+            assert padded(sizes, got) == best, (trial, k, sizes, got)
+
+
+def padded(sizes, buckets):
+    return sum(next(b for b in buckets if b >= s) * n
+               for s, n in sizes.items())
+
+
+def test_derive_buckets_edges():
+    assert derive_buckets({}, 8, k=4) == (8,)
+    assert derive_buckets({16: 5}, 8, k=4) == (8,)    # clipped to max
+    assert derive_buckets({"2": "7"}, 8, k=2) == (2, 8)
+    assert derive_buckets({1: 100, 8: 1}, 8, k=2) == (1, 8)
+    with pytest.raises(ValueError):
+        derive_buckets({1: 1}, 0)
+    with pytest.raises(ValueError):
+        derive_buckets({1: 1}, 8, k=0)
+    # fill_ratio agrees with hand math: 50x1 on rung 1 + 1x8 on rung 8
+    assert fill_ratio({1: 50, 8: 1}, (1, 8)) == pytest.approx(58 / 58)
+    assert fill_ratio({1: 50, 8: 1}, (8,)) == pytest.approx(58 / 408)
+
+
+def test_size_hist_from_jsonl_last_row_wins(tmp_path):
+    p = tmp_path / "serve.jsonl"
+    rows = [
+        {"step": 1, "model": "m", "batch_size_hist": {"1": 2}},
+        {"step": 2, "model": "m", "batch_size_hist": {"1": 5, "4": 1}},
+        {"step": 1, "model": "n", "batch_size_hist": {"2": 3}},
+    ]
+    import json
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    hists = size_hist_from_jsonl([str(p)])
+    assert hists["m"] == {1: 5, 4: 1}  # cumulative: last row per model
+    assert hists["n"] == {2: 3}
+    assert size_hist_from_jsonl([str(p)], model="m") == {
+        "m": {1: 5, 4: 1}}
+
+
+def test_fillmeter_size_hist():
+    fm = FillMeter()
+    fm.add(3, 4)
+    fm.add(3, 4)
+    fm.add(1, 1)
+    assert fm.size_hist() == {3: 2, 1: 1}
+    fm.reset()
+    assert fm.size_hist() == {}
+
+
+# -- ServeConfig validation (satellite) --------------------------------------
+
+def test_serve_config_validates_buckets_at_construction():
+    ServeConfig(max_batch=8, buckets=(1, 4, 8))  # fine
+    with pytest.raises(ValueError, match="strictly increasing"):
+        ServeConfig(max_batch=8, buckets=(4, 1, 8))
+    with pytest.raises(ValueError, match="strictly increasing"):
+        ServeConfig(max_batch=8, buckets=(1, 4, 4, 8))
+    with pytest.raises(ValueError, match="positive"):
+        ServeConfig(max_batch=8, buckets=(0, 8))
+    with pytest.raises(ValueError, match="largest bucket"):
+        ServeConfig(max_batch=8, buckets=(1, 4))
+    with pytest.raises(ValueError, match="non-empty"):
+        ServeConfig(max_batch=8, buckets=())
+    with pytest.raises(ValueError, match="max_batch"):
+        ServeConfig(max_batch=0)
+    # quant coercion rides the same construction-time gate
+    with pytest.raises(ValueError, match="quant mode"):
+        ServeConfig(quant="int4")
+
+
+# -- compile-cache verdicts --------------------------------------------------
+
+def test_track_compiles_verdicts():
+    """A region with a FRESH XLA compile reads as a miss (no cache, or
+    first sight with one); a region with no fresh XLA work reads as a
+    hit. The thread-local counting attributes compiles to the region
+    that ran them."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparknet_tpu.utils.compile_cache import track_compiles
+
+    salt = time.time_ns()  # a jit signature no other test compiled
+    f = jax.jit(lambda x: x * 2 + (salt % 97))
+    with track_compiles() as cold:
+        f(jnp.ones((3,)))
+    assert cold.xla_compiles >= 1
+    assert cold.cache_hit is False  # fresh XLA work, nothing served it
+    with track_compiles() as warm:
+        f(jnp.ones((3,)))          # same executable: no compile at all
+    assert warm.xla_compiles == 0
+    assert warm.cache_hit is True
+
+
+def test_spec_compile_memo_records_cache_hit():
+    """Identical NetSpecs compile once: the second CompiledNet.compile
+    is a memo hit recorded as cache_hit=true, and returns the SAME
+    object."""
+    from sparknet_tpu.model.net import CompiledNet
+    from sparknet_tpu.obs.device import compile_stats
+
+    spec = lenet(batch=3)
+    a = CompiledNet.compile(spec)
+    before = compile_stats()["net"]
+    b = CompiledNet.compile(lenet(batch=3))
+    after = compile_stats()["net"]
+    assert b is a
+    assert after["events"] == before["events"] + 1
+    assert after["cache_hits"] == before["cache_hits"] + 1

@@ -596,6 +596,35 @@ def test_future_type(server):
     assert isinstance(server.submit(_example(0)), Future)
 
 
+def test_status_and_jsonl_carry_batch_size_hist(net, tmp_path):
+    """The formed-batch size histogram (the bucket-ladder derivation
+    input) lands in /status and — cumulative, with the model name — in
+    the metrics JSONL at the metrics cadence."""
+    from sparknet_tpu.serve import size_hist_from_jsonl
+    from sparknet_tpu.utils.logger import Logger
+
+    jsonl = str(tmp_path / "serve.jsonl")
+    log = Logger(str(tmp_path / "l.txt"), echo=False, jsonl_path=jsonl)
+    cfg = ServeConfig(max_batch=4, max_wait_ms=5.0, buckets=(1, 4),
+                      outputs=("prob",), metrics_every_batches=1)
+    with InferenceServer(net, cfg, logger=log) as srv:
+        srv.infer(_example(0))                    # one size-1 batch
+        for f in [srv.submit(_example(i)) for i in range(4)]:
+            f.result(timeout=30.0)                # one size-4 batch
+        st = srv.status()
+        hist = st["batch_size_hist"]
+        assert hist.get("1", 0) >= 1          # the lone first request
+        # every real row is accounted for (burst formation may split)
+        assert sum(int(k) * v for k, v in hist.items()) == 5
+        assert sum(int(v) for v in hist.values()) == st["batches"]
+        # the live meter agrees with the status copy
+        assert srv.fill.size_hist() == {int(k): v
+                                        for k, v in hist.items()}
+    log.close()
+    hists = size_hist_from_jsonl([jsonl])
+    assert hists["default"] == {int(k): v for k, v in hist.items()}
+
+
 def test_manager_loads_sharded_manifest_checkpoints(net, tmp_path):
     """r8: serve hot-swap reads SHARD-MANIFEST checkpoints — the layout
     training writes by default now — through the same restore_flat path,
