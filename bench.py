@@ -744,22 +744,35 @@ def serve_bench(out_path: str | None = "BENCH_SERVE.json",
       - saturate: many closed-loop clients keep the queue full — the
         batcher must run full buckets (fill >= 0.8 acceptance; in
         practice ~1.0).
-      - http_open_*: OPEN-LOOP rows through the real HTTP/1.1 data plane
-        (keep-alive client connections, npz wire format) at `http_rps`
-        target rates. Shed requests must be ANSWERED 429/503 (+
-        Retry-After semantics — mapped to typed client errors), never
-        hung; p99 of the served ones is judged against `slo_p99_ms` at
-        the sustainable rate. On hardware that cannot sustain the target
-        (this CPU bench at 10k) the row is stamped structure_proof: the
-        protocol behaved, the rate needs the pod.
+      - http_open_* / binary_open_*: OPEN-LOOP rows through the real
+        data planes — HTTP/1.1 (keep-alive, npz wire) and the binary
+        frame transport (event loop, length-prefixed tensor frames) —
+        at `http_rps` target rates, BOTH behind the same server. Shed
+        requests must be ANSWERED 429/503 (+ Retry-After semantics —
+        mapped to typed client errors), never hung; p99 of the served
+        ones is judged against `slo_p99_ms` at the sustainable rate. On
+        hardware that cannot sustain the target (this CPU bench at 10k)
+        the row is stamped structure_proof: the protocol behaved, the
+        rate needs the pod.
+      - ab_small_http / ab_small_binary: the r10 driver-cost A/B —
+        closed-loop small requests through each wire, wall p50/p99 plus
+        PROCESS CPU seconds per 1k requests (same forward, same
+        process: the delta is npz/zip + http.server parsing vs struct
+        pack + np.frombuffer views).
+      - transport_parity: one request through both wires — same
+        replica, same bucket — must return BITWISE-identical tensors.
+      - binary_stream_blob: a featurizer-shaped multi-MB response with
+        FLAG_STREAM — first-byte vs full-response latency, and the
+        server's per-connection COPIED buffering bounded by the chunk
+        size (never the blob size).
       - http_chaos_swap_drain: mid-traffic checkpoint hot-swap on the
         local replica PLUS a replica drain that shifts routing to a
         remote replica (a second router behind its own frontend) — zero
         dropped or corrupted responses is the acceptance bar.
 
-    The jit-cache pin closes the bench: after every arm, each model's
-    bucket-compile counter still equals len(buckets) — the new network
-    path added zero compile churn.
+    The jit-cache pin closes the bench: after every arm — including the
+    MIXED-transport traffic — each model's bucket-compile counter still
+    equals len(buckets): the new network paths added zero compile churn.
 
     `keep`: directory to retain the serve JSONL artifacts in (CI uploads
     them on failure)."""
@@ -811,20 +824,20 @@ def serve_bench(out_path: str | None = "BENCH_SERVE.json",
         s["achieved_rps"] = round(len(futures) / secs, 1)
         return s
 
-    def run_http_open(address, model_name: str, rps: float, secs: float,
+    def run_wire_open(infer_fn, rps: float, secs: float,
                       deadline_s: float = 0.25) -> dict:
-        """Open-loop over the REAL HTTP data plane: N sender threads on
-        keep-alive connections fire at a fixed aggregate rate without
-        waiting for capacity (a sender that falls behind schedule drops
-        the backlog rather than converting open-loop into closed-loop).
-        Every request must be ANSWERED: 200, or a typed shed (429 queue
-        full / 503 deadline-or-drain); connection errors are drops."""
+        """Open-loop over a REAL wire data plane (`infer_fn(req,
+        deadline_s, timeout)` — http_infer or binary_infer, both on
+        thread-cached keep-alive connections): N sender threads fire at
+        a fixed aggregate rate without waiting for capacity (a sender
+        that falls behind schedule drops the backlog rather than
+        converting open-loop into closed-loop). Every request must be
+        ANSWERED: 200, or a typed shed (429 queue full / 503
+        deadline-or-drain); connection errors are drops."""
         from sparknet_tpu.serve import (DeadlineExpiredError,
-                                        NoReplicaError, QueueFullError,
-                                        http_infer)
+                                        NoReplicaError, QueueFullError)
 
         conns = int(min(64, max(8, rps // 100)))
-        url = f"http://{address[0]}:{address[1]}"
         counts = {"ok": 0, "shed_429": 0, "shed_503": 0, "dropped": 0,
                   "timed_out": 0, "errors_other": 0}
         lats: list = []
@@ -844,8 +857,7 @@ def serve_bench(out_path: str | None = "BENCH_SERVE.json",
                     continue
                 t0 = time.perf_counter()
                 try:
-                    http_infer(url, model_name, req,
-                               deadline_s=deadline_s, timeout=10.0)
+                    infer_fn(req, deadline_s, 10.0)
                     dt = time.perf_counter() - t0
                     with lock:
                         counts["ok"] += 1
@@ -897,6 +909,162 @@ def serve_bench(out_path: str | None = "BENCH_SERVE.json",
                 # PROTOCOL (typed sheds, zero drops) — rerun on the pod
                 "structure_proof": not sustained,
                 "deadline_ms": deadline_s * 1e3}
+
+    def run_http_open(address, model_name: str, rps: float, secs: float,
+                      deadline_s: float = 0.25) -> dict:
+        from sparknet_tpu.serve import http_infer
+
+        url = f"http://{address[0]}:{address[1]}"
+        return run_wire_open(
+            lambda r, d, t: http_infer(url, model_name, r,
+                                       deadline_s=d, timeout=t),
+            rps, secs, deadline_s)
+
+    def run_binary_open(address, model_name: str, rps: float,
+                        secs: float, deadline_s: float = 0.25) -> dict:
+        from sparknet_tpu.serve import binary_infer
+
+        return run_wire_open(
+            lambda r, d, t: binary_infer(address, model_name, r,
+                                         deadline_s=d, timeout=t),
+            rps, secs, deadline_s)
+
+    def run_transport_ab(infer_fn, n_clients: int, secs: float) -> dict:
+        """Closed-loop small-request driver cost: wall latencies plus
+        PROCESS CPU seconds per 1k requests. Client and server share
+        this process and the forward is identical across transports, so
+        the per-transport DELTA in cpu_s_per_1k is pure wire cost —
+        npz/zip encode + http.server parsing vs struct pack +
+        np.frombuffer views."""
+        from sparknet_tpu.serve import (DeadlineExpiredError,
+                                        NoReplicaError, QueueFullError)
+
+        lats: list = []
+        counts = {"ok": 0, "shed": 0, "dropped": 0, "errors_other": 0}
+        lock = threading.Lock()
+        for _ in range(3):
+            infer_fn(req, 5.0, 30.0)  # warm the connection + bucket
+        stop = time.perf_counter() + secs
+        cpu0 = time.process_time()
+
+        def client(j):
+            while time.perf_counter() < stop:
+                t0 = time.perf_counter()
+                try:
+                    infer_fn(req, 5.0, 30.0)
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        counts["ok"] += 1
+                        lats.append(dt)
+                except (QueueFullError, DeadlineExpiredError,
+                        NoReplicaError):
+                    with lock:
+                        counts["shed"] += 1
+                except ConnectionError:
+                    with lock:
+                        counts["dropped"] += 1
+                except Exception:
+                    with lock:
+                        counts["errors_other"] += 1
+
+        ts = [threading.Thread(target=client, args=(j,))
+              for j in range(n_clients)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=secs + 30.0)
+        cpu_s = time.process_time() - cpu0
+        hung = sum(t.is_alive() for t in ts)
+        lats.sort()
+        n = counts["ok"]
+        return {"requests": n, "clients": n_clients,
+                "achieved_rps": round(n / secs, 1), **counts,
+                "hung_clients": hung,
+                "p50_ms": (round(lats[len(lats) // 2] * 1e3, 3)
+                           if lats else None),
+                "p99_ms": (round(lats[min(len(lats) - 1,
+                                          int(0.99 * len(lats)))] * 1e3,
+                                 3) if lats else None),
+                "cpu_s_per_1k": (round(cpu_s / n * 1e3, 4) if n
+                                 else None)}
+
+    def binary_stream_arm() -> dict:
+        """The large-blob streaming row: a featurizer-shaped net (1x1
+        max-pool identity — the per-example output is a multi-MB blob,
+        the fc7-embedding shape class) served over the binary transport
+        with FLAG_STREAM. Measures first-byte vs full-response latency
+        and the server's per-connection COPIED buffering (the npz door
+        serializes the whole blob into a second buffer before byte
+        one; the frame door copies only headers)."""
+        from sparknet_tpu.model.spec import (InputSpec, LayerSpec,
+                                             NetSpec, PoolingParam)
+        from sparknet_tpu.serve import (BinaryClient, BinaryFrontend,
+                                        HttpFrontend, InferenceServer,
+                                        ServeConfig, http_infer)
+        from sparknet_tpu.serve.server import net_input_specs
+
+        chunk = 256 << 10
+        spec = NetSpec(
+            name="blobber",
+            inputs=(InputSpec("data", (1, 8, 512, 512)),),  # 8 MB/row
+            layers=(LayerSpec(name="feat", type="Pooling",
+                              bottoms=("data",), tops=("feat",),
+                              pool=PoolingParam(pool="MAX",
+                                                kernel_size=1,
+                                                stride=1)),))
+        net2 = JaxNet(spec)
+        cfg2 = ServeConfig(model_name="featurizer", max_batch=1,
+                           buckets=(1,), max_wait_ms=1.0,
+                           outputs=("feat",), metrics_every_batches=0)
+        rng2 = np.random.default_rng(7)
+        shape, dt = net_input_specs(net2)["data"]
+        req2 = {"data": rng2.standard_normal(shape).astype(dt)}
+        with InferenceServer(net2, cfg2, logger=logger) as s2:
+            bfe = BinaryFrontend(s2, port=0, chunk_bytes=chunk)
+            hfe = HttpFrontend(s2, port=0)
+            cli = BinaryClient(*bfe.address, timeout=120.0)
+            try:
+                cli.infer(req2, model="featurizer",
+                          deadline_s=120.0)  # compile + warm
+                full = cli.infer(req2, model="featurizer",
+                                 deadline_s=30.0)
+                t_full = dict(cli.last_timing)
+                streamed = cli.infer(req2, model="featurizer",
+                                     deadline_s=30.0, stream=True)
+                t_stream = dict(cli.last_timing)
+                assert np.array_equal(full["feat"], streamed["feat"])
+                blob_bytes = int(np.asarray(full["feat"]).nbytes)
+                # the HTTP/npz comparator: full-body serialize + buffer
+                t0 = time.perf_counter()
+                http_infer(f"http://{hfe.address[0]}:{hfe.address[1]}",
+                           "featurizer", req2, deadline_s=30.0)
+                http_full_ms = (time.perf_counter() - t0) * 1e3
+                first = t_stream["t_first_byte_s"] * 1e3
+                complete = t_stream["t_complete_s"] * 1e3
+                return {
+                    "load": "binary_stream_blob",
+                    "blob_mb": round(blob_bytes / 2**20, 2),
+                    "chunk_kb": chunk >> 10,
+                    "stream_first_byte_ms": round(first, 3),
+                    "stream_complete_ms": round(complete, 3),
+                    "binary_full_ms":
+                        round(t_full["t_complete_s"] * 1e3, 3),
+                    "http_npz_full_ms": round(http_full_ms, 3),
+                    # first byte lands while the blob is still in
+                    # flight: decoupled from blob size
+                    "first_byte_decoupled": first < complete,
+                    "peak_conn_buffered_bytes":
+                        int(bfe.peak_buffered_bytes),
+                    # the bounded-buffer acceptance: COPIED bytes per
+                    # connection bounded by the chunk size, not the blob
+                    "buffer_bounded_by_chunk":
+                        bfe.peak_buffered_bytes < chunk,
+                    "bitwise_equal_stream_vs_full": True,
+                }
+            finally:
+                cli.close()
+                bfe.stop()
+                hfe.stop()
 
     def http_chaos_swap_drain(secs: float) -> dict:
         """Mid-traffic hot-swap + replica drain through the router:
@@ -1025,24 +1193,59 @@ def serve_bench(out_path: str | None = "BENCH_SERVE.json",
                      "fill_target": 0.8,
                      "fill_ok": s["batch_fill_ratio"] >= 0.8})
 
-        # the open-loop HTTP rows, through the real front door
-        from sparknet_tpu.serve import HttpFrontend
+        # the open-loop rows, through the real front doors — HTTP and
+        # the binary frame transport behind the SAME server
+        from sparknet_tpu.serve import (BinaryFrontend, HttpFrontend,
+                                        binary_infer, http_infer)
         fe = HttpFrontend(srv, port=0, logger=logger)
+        bfe = BinaryFrontend(srv, port=0, logger=logger)
+        url = f"http://{fe.address[0]}:{fe.address[1]}"
         try:
             for rps in http_rps:
                 srv.reset_counters()
                 rows.append({"load": f"http_open_{int(rps)}rps",
                              **run_http_open(fe.address, model, rps,
                                              duration_s)})
+            for rps in http_rps:
+                srv.reset_counters()
+                rows.append({"load": f"binary_open_{int(rps)}rps",
+                             **run_binary_open(bfe.address, model, rps,
+                                               duration_s)})
+            # the small-request driver-cost A/B (closed loop, same
+            # forward, same process: the delta is wire cost)
+            srv.reset_counters()
+            rows.append({"load": "ab_small_http", **run_transport_ab(
+                lambda r, d, t: http_infer(url, model, r, deadline_s=d,
+                                           timeout=t),
+                n_clients=2, secs=duration_s)})
+            srv.reset_counters()
+            rows.append({"load": "ab_small_binary", **run_transport_ab(
+                lambda r, d, t: binary_infer(bfe.address, model, r,
+                                             deadline_s=d, timeout=t),
+                n_clients=2, secs=duration_s)})
+            # parity pin: one request through BOTH wires — same replica,
+            # same bucket — must return bitwise-identical tensors
+            out_h = http_infer(url, model, req, deadline_s=30.0)
+            out_b = binary_infer(bfe.address, model, req,
+                                 deadline_s=30.0)
+            rows.append({
+                "load": "transport_parity",
+                "blobs": sorted(out_h),
+                "bitwise_equal": all(
+                    np.array_equal(out_h[k], out_b[k]) for k in out_h),
+            })
         finally:
             fe.stop()
-        # jit-cache pin: the HTTP path added ZERO compile churn — the
-        # bucket-compile counter still reads exactly len(buckets)
+            bfe.stop()
+        # jit-cache pin: MIXED-transport traffic added ZERO compile
+        # churn — the bucket-compile counter still reads exactly
+        # len(buckets) after the HTTP rows, the binary rows, and the A/B
         compiles = srv.registry.counter(
             "sparknet_serve_bucket_compiles_total",
             labels=("model",)).value(model=model)
         jit_cache_ok = compiles == len(srv.buckets)
 
+    rows.append(binary_stream_arm())
     rows.append(http_chaos_swap_drain(max(duration_s, 1.5)))
 
     for r in rows:  # drop non-scalar noise from the artifact rows
@@ -1051,6 +1254,11 @@ def serve_bench(out_path: str | None = "BENCH_SERVE.json",
         r.pop("models", None)
     sat = next(r for r in rows if r["load"] == "saturate")
     http_rows = [r for r in rows if r["load"].startswith("http_open")]
+    bin_rows = [r for r in rows if r["load"].startswith("binary_open")]
+    ab_http = next(r for r in rows if r["load"] == "ab_small_http")
+    ab_bin = next(r for r in rows if r["load"] == "ab_small_binary")
+    parity = next(r for r in rows if r["load"] == "transport_parity")
+    stream = next(r for r in rows if r["load"] == "binary_stream_blob")
     chaos = rows[-1]
     out = {
         "metric": "serve_saturated_batch_fill_ratio",
@@ -1074,11 +1282,51 @@ def serve_bench(out_path: str | None = "BENCH_SERVE.json",
             "hung_clients": r["hung_clients"],
             "structure_proof": r["structure_proof"]}
             for r in http_rows},
+        "binary_open": {r["load"]: {
+            "achieved_rps": r["achieved_rps"],
+            "p99_ms": r["p99_ms"],
+            "p99_within_slo": r["p99_within_slo"],
+            "sheds_answered": r["shed_429"] + r["shed_503"],
+            "dropped": r["dropped"], "timed_out": r["timed_out"],
+            "hung_clients": r["hung_clients"],
+            "structure_proof": r["structure_proof"]}
+            for r in bin_rows},
         # "zero dropped" means every request ANSWERED: no connection
         # drops, no silent client-timeout stalls, no hung senders
         "http_zero_dropped": all(
             r["dropped"] == 0 and r["timed_out"] == 0
             and r["hung_clients"] == 0 for r in http_rows),
+        "binary_zero_dropped": all(
+            r["dropped"] == 0 and r["timed_out"] == 0
+            and r["hung_clients"] == 0 for r in bin_rows),
+        # the small-request driver-cost A/B: same forward, same
+        # process — the delta is the wire (npz/http.server vs
+        # struct + frombuffer). On a CPU host the forward itself rides
+        # the same cores as the drivers, so the RATIO is a structure
+        # proof; rerun on the pod for the at-rate numbers.
+        "transport_ab": {
+            "http": {k: ab_http[k] for k in
+                     ("requests", "p50_ms", "p99_ms", "cpu_s_per_1k",
+                      "dropped", "hung_clients")},
+            "binary": {k: ab_bin[k] for k in
+                       ("requests", "p50_ms", "p99_ms", "cpu_s_per_1k",
+                        "dropped", "hung_clients")},
+            "binary_beats_http_p50":
+                (ab_bin["p50_ms"] or 1e9) <= (ab_http["p50_ms"] or 0),
+            "binary_beats_http_cpu":
+                (ab_bin["cpu_s_per_1k"] or 1e9)
+                <= (ab_http["cpu_s_per_1k"] or 0),
+            "ab_zero_dropped": all(
+                r["dropped"] == 0 and r["hung_clients"] == 0
+                and r["errors_other"] == 0 for r in (ab_http, ab_bin)),
+            "structure_proof": True,  # CPU host — pod rerun for rates
+        },
+        "transport_parity_bitwise": parity["bitwise_equal"],
+        "stream": {k: stream[k] for k in
+                   ("blob_mb", "chunk_kb", "stream_first_byte_ms",
+                    "stream_complete_ms", "http_npz_full_ms",
+                    "first_byte_decoupled", "peak_conn_buffered_bytes",
+                    "buffer_bounded_by_chunk")},
         "chaos_zero_dropped": chaos["zero_dropped"],
         "chaos_hot_swap_ok": chaos["swap_ok"],
         "jit_cache_ok": jit_cache_ok,

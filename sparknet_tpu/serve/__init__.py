@@ -23,20 +23,32 @@ makes it reachable:
     buckets/SLOs/metric labels, health-aware replica routing (drain on
     stale heartbeat / hot-swap cooldown, zero dropped in-flight).
   - `HttpFrontend` (http_frontend.py): the HTTP/1.1 inference endpoint —
-    keep-alive, JSON/npz decode on the accept threads, 429/503 +
-    Retry-After admission control and deadline shedding; `http_infer`
-    is the matching keep-alive client.
+    keep-alive with idle-timeout + connection-cap hygiene, JSON/npz
+    decode on the accept threads, 429/503 + Retry-After admission
+    control and deadline shedding; `http_infer` is the matching
+    keep-alive client.
+  - `BinaryFrontend` (binary_frontend.py + wire.py): the binary data
+    plane — a `selectors` event loop (no thread-per-connection) speaking
+    length-prefixed tensor frames (zero-parse `np.frombuffer` decode),
+    request pipelining, and flag-gated chunked response streaming;
+    `BinaryClient` / `binary_infer` are the matching clients.
+  - `TenantAdmission` (admission.py): per-tenant token buckets ahead of
+    the 429 path on both frontends (X-Tenant header / frame tenant
+    field) — one hot tenant cannot starve the rest.
   - `sparknet-serve` (app.py): the console entry point.
 """
 from ..model.quant import QuantConfig
+from .admission import TenantAdmission, TenantLimitError
 from .batcher import (DeadlineExpiredError, DynamicBatcher,
                       QueueFullError, ServeRequest)
+from .binary_frontend import BinaryClient, BinaryFrontend, binary_infer
 from .buckets import derive_buckets, fill_ratio, size_hist_from_jsonl
-from .http_frontend import HttpFrontend, http_infer
+from .http_frontend import BackendAdapter, HttpFrontend, http_infer
 from .model_manager import ModelManager, ServeModelError
 from .router import (ModelRouter, NoReplicaError, Replica, RouterConfig,
                      UnknownModelError, heartbeat_health)
 from .server import InferenceServer, ServeConfig, parity_batch, zeros_batch
+from .wire import WireError
 
 __all__ = [
     "DynamicBatcher", "QueueFullError", "DeadlineExpiredError",
@@ -46,5 +58,7 @@ __all__ = [
     "QuantConfig", "derive_buckets", "fill_ratio", "size_hist_from_jsonl",
     "ModelRouter", "RouterConfig", "Replica", "NoReplicaError",
     "UnknownModelError", "heartbeat_health",
-    "HttpFrontend", "http_infer",
+    "HttpFrontend", "http_infer", "BackendAdapter",
+    "BinaryFrontend", "BinaryClient", "binary_infer", "WireError",
+    "TenantAdmission", "TenantLimitError",
 ]

@@ -1,0 +1,95 @@
+"""Per-tenant admission control: token buckets AHEAD of the queue.
+
+The 429 path (QueueFullError backpressure) is capacity-fair, not
+CLIENT-fair: one hot tenant can keep the queue at its cap and starve
+every quiet tenant into 429s. This module sits in the frontends — HTTP
+reads an `X-Tenant` header, the binary wire carries a tenant field in
+the request frame — and answers the flood BEFORE it occupies queue
+slots: each tenant owns a token bucket (`rate_rps` steady, `burst`
+depth), and a request that finds its tenant's bucket empty is shed
+typed (`tenant_limit`, HTTP 429 / binary error frame 429) and counted
+on `sparknet_serve_shed_total{model,reason="tenant_limit"}` — the same
+family the batcher's deadline sheds ride, so one scrape shows who is
+shedding whom and why.
+
+Requests with no tenant share the "" bucket (an anonymous flood must
+not out-compete named tenants by dropping the header). The tracked-
+tenant table is bounded: past `max_tenants`, the stalest bucket is
+evicted — an eviction forgives at most one burst, it never grows
+memory without bound under a tenant-id spray.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from .batcher import QueueFullError
+
+
+class TenantLimitError(QueueFullError):
+    """This tenant's token bucket is empty — shed ahead of the queue
+    (HTTP 429 / binary error frame, error_kind "tenant_limit"). A
+    QueueFullError subclass: clients that already back off on 429 keep
+    working unchanged."""
+
+
+class _Bucket:
+    __slots__ = ("tokens", "t")
+
+    def __init__(self, tokens: float, t: float):
+        self.tokens = tokens
+        self.t = t
+
+
+class TenantAdmission:
+    """Token-bucket admission keyed on tenant id (header / frame field).
+
+    `allow(tenant)` refills that tenant's bucket at `rate_rps` up to
+    `burst`, then spends one token — False means shed. Thread-safe (the
+    frontends call it from accept threads / io loops concurrently)."""
+
+    def __init__(self, rate_rps: float, burst: Optional[float] = None,
+                 max_tenants: int = 4096):
+        if rate_rps <= 0:
+            raise ValueError(f"tenant rate must be > 0 (got {rate_rps})")
+        self.rate_rps = float(rate_rps)
+        self.burst = float(burst if burst is not None
+                           else max(2.0 * rate_rps, 1.0))
+        if self.burst < 1.0:
+            raise ValueError(f"burst must be >= 1 (got {self.burst})")
+        self.max_tenants = int(max_tenants)
+        self._buckets: Dict[str, _Bucket] = {}
+        self._lock = threading.Lock()
+        self.shed = 0  # lifetime tenant_limit sheds (all tenants)
+
+    def allow(self, tenant: Optional[str]) -> bool:
+        key = tenant or ""
+        now = time.monotonic()
+        with self._lock:
+            # pop + reinsert keeps dict order == recency order, so
+            # eviction is O(1) next(iter(...)) — a tenant-id SPRAY (the
+            # attack max_tenants bounds) must not turn each allow()
+            # into a full-table scan under the shared lock
+            b = self._buckets.pop(key, None)
+            if b is None:
+                if len(self._buckets) >= self.max_tenants:
+                    # evict the least-recently-seen bucket (bounded
+                    # memory; the evictee regains at most one burst)
+                    del self._buckets[next(iter(self._buckets))]
+                b = _Bucket(self.burst, now)
+            else:
+                b.tokens = min(self.burst,
+                               b.tokens + (now - b.t) * self.rate_rps)
+                b.t = now
+            self._buckets[key] = b
+            if b.tokens >= 1.0:
+                b.tokens -= 1.0
+                return True
+            self.shed += 1
+            return False
+
+    def snapshot(self) -> Dict[str, float]:
+        """{tenant: tokens} — a consistent copy (status/debugging)."""
+        with self._lock:
+            return {k: b.tokens for k, b in self._buckets.items()}

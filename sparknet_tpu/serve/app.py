@@ -175,6 +175,24 @@ def main(argv=None) -> None:
     p.add_argument("--http-host", default="127.0.0.1",
                    help='bind host for --http-port ("0.0.0.0" for '
                    "cross-host clients)")
+    p.add_argument("--binary-port", type=int, default=None,
+                   help="serve the binary frame data plane (length-"
+                   "prefixed tensor frames over a selectors event loop; "
+                   "serve/wire.py format) on this port (0 = ephemeral)")
+    p.add_argument("--binary-host", default="127.0.0.1",
+                   help='bind host for --binary-port ("0.0.0.0" for '
+                   "cross-host clients)")
+    p.add_argument("--io-threads", type=int, default=2,
+                   help="event-loop io threads for --binary-port")
+    p.add_argument("--tenant-rate", type=float, default=None,
+                   help="per-tenant admission: token-bucket refill rate "
+                   "(requests/sec) keyed on the X-Tenant header / "
+                   "binary-frame tenant field, shed 429 "
+                   "error_kind=tenant_limit ahead of the queue; shared "
+                   "across both data planes (default: off)")
+    p.add_argument("--tenant-burst", type=float, default=None,
+                   help="per-tenant bucket depth for --tenant-rate "
+                   "(default: 2x the rate)")
     p.add_argument("--status-port", type=int, default=None,
                    help="serve /healthz and /metrics on this port "
                    "(0 = ephemeral)")
@@ -238,6 +256,26 @@ def main(argv=None) -> None:
 
     from ..obs import trace as obs_trace
 
+    def make_frontends(backend):
+        """The data planes the flags asked for: HTTP and/or binary,
+        sharing ONE per-tenant admission budget (a tenant's rate is a
+        property of the tenant, not of the wire it arrived on)."""
+        from .admission import TenantAdmission
+        from .binary_frontend import BinaryFrontend
+        tenants = (TenantAdmission(args.tenant_rate, args.tenant_burst)
+                   if args.tenant_rate else None)
+        fes = []
+        if args.http_port is not None:
+            fes.append(HttpFrontend(backend, args.http_port,
+                                    args.http_host, tenants=tenants,
+                                    logger=log))
+        if args.binary_port is not None:
+            fes.append(BinaryFrontend(backend, args.binary_port,
+                                      args.binary_host,
+                                      io_threads=args.io_threads,
+                                      tenants=tenants, logger=log))
+        return fes
+
     with obs_trace.tracing(args.trace_out) if args.trace_out \
             else contextlib.nullcontext():
         if args.models:
@@ -254,16 +292,14 @@ def main(argv=None) -> None:
                               args.n_classes, args.crop),
                     cfg=lane_cfg(name, ck))
             with router:
-                frontend = (HttpFrontend(router, args.http_port,
-                                         args.http_host, logger=log)
-                            if args.http_port is not None else None)
+                frontends = make_frontends(router)
                 try:
                     _serve_until_done(router.status, args, log,
                                       run_fn=lambda:
                                       run_router_demo(router, args.demo))
                 finally:
-                    if frontend is not None:
-                        frontend.stop()
+                    for fe in frontends:
+                        fe.stop()
             return
 
         net = build_net(args.model, args.graph, args.weights,
@@ -273,16 +309,14 @@ def main(argv=None) -> None:
         cfg.heartbeat_path = args.heartbeat
         server = InferenceServer(net, cfg, logger=log)
         with server:
-            frontend = (HttpFrontend(server, args.http_port,
-                                     args.http_host, logger=log)
-                        if args.http_port is not None else None)
+            frontends = make_frontends(server)
             try:
                 _serve_until_done(server.status, args, log,
                                   run_fn=lambda:
                                   run_demo(server, args.demo))
             finally:
-                if frontend is not None:
-                    frontend.stop()
+                for fe in frontends:
+                    fe.stop()
 
 
 def _serve_until_done(status_fn, args, log: Logger, run_fn) -> None:

@@ -1,0 +1,818 @@
+"""The binary data plane: a `selectors` event-loop front door speaking
+the length-prefixed frame protocol (serve/wire.py), behind the SAME
+`InferenceServer`/`ModelRouter` backends as the HTTP frontend.
+
+Why a second wire: the HTTP/1.1 door costs one OS thread per connection
+(ThreadingHTTPServer), full-body buffering on both sides, and an
+npz/JSON re-encode of every tensor. At 10k rps those per-request costs
+dominate once the forward is cheap. This frontend removes all three:
+
+  - EVENT LOOP, NOT THREAD-PER-CONNECTION: one acceptor (io loop 0's
+    listener) plus a small FIXED set of io threads, each running its own
+    `selectors` loop over a share of the connections (new connections
+    are dealt round-robin). Reads, frame decode (one `np.frombuffer`
+    view per tensor — zero parse), submit, and writes for a connection
+    all happen on its io thread; 10k idle connections cost file
+    descriptors, not threads.
+  - PIPELINING: a connection may have MANY request-ids in flight;
+    replies are written in COMPLETION order (each response future's
+    done-callback enqueues its frames the moment the forward resolves —
+    a slow request never convoys the fast ones behind it).
+  - CHUNKED RESPONSE STREAMING (flag-gated): a request with FLAG_STREAM
+    gets its response as a descriptor-table frame followed by sized
+    CHUNK frames written zero-copy from the forward's output buffers —
+    first-byte latency decouples from blob size, and the only bytes the
+    transport ever COPIES per connection are frame headers (the npz door
+    serializes the whole blob into a second buffer before byte one).
+
+Shed-not-hang carries over wholesale: every error path answers a TYPED
+error frame (wire.py's table mirrors the HTTP codes one-for-one), a
+malformed frame (bad magic/version, oversized) fails ITS connection
+alone after a typed answer, and a wedged forward is reaped by the io
+loop's timeout sweep — a client of this transport never hangs.
+
+`BinaryClient` / `binary_infer` at the bottom are the matching client
+(keep-alive, pipelined submits, streaming reassembly, thread-cached) —
+`ModelRouter.add_remote_replica(..., transport="binary")` proxies over
+it, so cross-replica hops drop the HTTP tax too.
+"""
+from __future__ import annotations
+
+import itertools
+import selectors
+import socket
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logger import Logger
+from . import wire
+from .admission import TenantAdmission, TenantLimitError
+from .batcher import DeadlineExpiredError, QueueFullError
+from .http_frontend import (BackendAdapter, lru_cache_drop,
+                            lru_cache_get, register_transport_metrics)
+from .router import NoReplicaError, UnknownModelError
+
+_DEFAULT_WAIT_S = 30.0  # reply bound for requests with no deadline
+
+
+def _exception_to_err(e: BaseException) -> Tuple[Tuple[int, str], str]:
+    """Serve exception -> (wire error (code, kind), message). The exact
+    mapping the HTTP frontend's except-ladder implements."""
+    if isinstance(e, TenantLimitError):
+        return wire.ERR_TENANT_LIMIT, str(e)
+    if isinstance(e, QueueFullError):
+        return wire.ERR_QUEUE_FULL, str(e)
+    if isinstance(e, DeadlineExpiredError):
+        return wire.ERR_DEADLINE, str(e)
+    if isinstance(e, NoReplicaError):
+        return wire.ERR_NO_REPLICA, str(e)
+    if isinstance(e, UnknownModelError):
+        return wire.ERR_UNKNOWN_MODEL, str(e)
+    if isinstance(e, (ValueError, KeyError, TypeError, wire.WireError)):
+        return wire.ERR_BAD_REQUEST, str(e)
+    return wire.ERR_INTERNAL, f"{type(e).__name__}: {e}"
+
+
+def raise_for_error(code: int, kind: str, msg: str) -> None:
+    """Wire error frame -> the SAME typed exception the local submit
+    path (and http_infer) raises — transport-blind remote replicas.
+    Protocol violations (bad magic/version, oversized frame) stay
+    WireError: they mean OUR framing was wrong, not the request."""
+    if kind in ("bad_magic", "bad_version", "too_large"):
+        raise wire.WireError(f"server rejected the frame: {kind}: {msg}")
+    if kind == "tenant_limit":
+        raise TenantLimitError(msg)
+    if code == 429:
+        raise QueueFullError(msg)
+    if kind == "deadline":
+        raise DeadlineExpiredError(msg)
+    if code == 503:
+        raise NoReplicaError(msg or f"replica shed ({kind})")
+    if code == 404:
+        raise UnknownModelError(msg)
+    if code == 400:
+        raise ValueError(f"binary_infer: {kind}: {msg}")
+    raise RuntimeError(f"binary_infer: {code} {kind}: {msg}")
+
+
+class _Conn:
+    """One client connection: owned by exactly one io loop. The outbox
+    is the only cross-thread surface (response done-callbacks append
+    under `lock`; the io thread drains)."""
+
+    __slots__ = ("sock", "loop", "rbuf", "outbox", "lock", "wview",
+                 "wcopied", "closed", "close_after_flush", "inflight",
+                 "copied_pending", "peak_copied", "reject_until")
+
+    def __init__(self, sock, loop):
+        self.sock = sock
+        self.loop = loop
+        self.rbuf = bytearray()
+        self.outbox: deque = deque()
+        self.lock = threading.Lock()
+        self.wview: Optional[memoryview] = None
+        self.wcopied = False
+        self.closed = False
+        self.close_after_flush = False
+        # reject mode (over capacity): the typed error frame is queued,
+        # incoming bytes are discarded (closing with unread request
+        # bytes would RST the socket and destroy the answer in flight),
+        # and the reaper closes the connection at this deadline if the
+        # client hasn't hung up first
+        self.reject_until: Optional[float] = None
+        # req_id -> absolute reply bound (monotonic); popped on
+        # completion, or by the reaper (which answers a timeout frame)
+        self.inflight: Dict[int, float] = {}
+        self.copied_pending = 0   # bytes of COPIED (header) data queued
+        self.peak_copied = 0      # its high-water mark
+
+
+class _IoLoop(threading.Thread):
+    """One selectors loop over a share of the connections. `call_soon`
+    is the only way other threads touch loop state."""
+
+    def __init__(self, frontend: "BinaryFrontend", idx: int):
+        super().__init__(name=f"serve-bin-io-{idx}", daemon=True)
+        self.frontend = frontend
+        self.idx = idx
+        self.sel = selectors.DefaultSelector()
+        self._rsock, self._wsock = socket.socketpair()
+        self._rsock.setblocking(False)
+        self._wsock.setblocking(False)
+        self.sel.register(self._rsock, selectors.EVENT_READ, "wake")
+        self._pending: List[Any] = []
+        self._plock = threading.Lock()
+        self.conns: set = set()
+        self.running = True
+        self._next_reap = 0.0
+
+    def call_soon(self, fn) -> None:
+        with self._plock:
+            self._pending.append(fn)
+        try:
+            self._wsock.send(b"\0")
+        except (BlockingIOError, OSError):
+            pass  # pipe full = a wake is already queued
+
+    def stop(self) -> None:
+        self.running = False
+        self.call_soon(lambda: None)
+
+    def adopt(self, conn: _Conn) -> None:
+        """Register a freshly-accepted connection (loop thread only)."""
+        if not self.running:
+            conn.sock.close()
+            self.frontend._conn_closed()
+            return
+        self.conns.add(conn)
+        self.sel.register(conn.sock, selectors.EVENT_READ, conn)
+        self.arm_write(conn)  # an outbox queued pre-adopt (the reject
+        #                       path's error frame) must still flush
+
+    def arm_write(self, conn: _Conn) -> None:
+        """(Re)compute the interest set (loop thread only)."""
+        if conn.closed:
+            return
+        events = selectors.EVENT_READ
+        with conn.lock:
+            if conn.outbox or conn.wview is not None:
+                events |= selectors.EVENT_WRITE
+        try:
+            self.sel.modify(conn.sock, events, conn)
+        except (KeyError, ValueError):
+            pass  # already closed/unregistered
+
+    def run(self) -> None:
+        try:
+            while self.running:
+                events = self.sel.select(timeout=0.25)
+                with self._plock:
+                    pending, self._pending = self._pending, []
+                for fn in pending:
+                    fn()
+                for key, mask in events:
+                    data = key.data
+                    if data == "wake":
+                        try:
+                            while self._rsock.recv(4096):
+                                pass
+                        except (BlockingIOError, OSError):
+                            pass
+                    elif data == "accept":
+                        self.frontend._accept()
+                    else:
+                        if mask & selectors.EVENT_READ:
+                            self._read(data)
+                        if mask & selectors.EVENT_WRITE and \
+                                not data.closed:
+                            self._write(data)
+                now = time.monotonic()
+                if now >= self._next_reap:
+                    self._next_reap = now + 1.0
+                    self._reap(now)
+        finally:
+            for conn in list(self.conns):
+                self.close_conn(conn)
+            self.sel.close()
+            self._rsock.close()
+            self._wsock.close()
+
+    # -- per-connection io (loop thread only) --------------------------------
+
+    def _read(self, conn: _Conn) -> None:
+        try:
+            data = conn.sock.recv(1 << 18)
+        except BlockingIOError:
+            return
+        except OSError:
+            self.close_conn(conn)
+            return
+        if not data:
+            self.close_conn(conn)
+            return
+        conn.rbuf += data
+        self.frontend._process(conn)
+
+    def _write(self, conn: _Conn) -> None:
+        while True:
+            if conn.wview is None:
+                with conn.lock:
+                    if not conn.outbox:
+                        break
+                    conn.wview, conn.wcopied = conn.outbox.popleft()
+            try:
+                n = conn.sock.send(conn.wview)
+            except BlockingIOError:
+                break
+            except OSError:
+                self.close_conn(conn)
+                return
+            if conn.wcopied:
+                with conn.lock:
+                    conn.copied_pending -= n
+            conn.wview = conn.wview[n:] if n < len(conn.wview) else None
+        self.arm_write(conn)
+        with conn.lock:
+            drained = not conn.outbox and conn.wview is None
+        if drained and conn.close_after_flush:
+            self.close_conn(conn)
+
+    def _reap(self, now: float) -> None:
+        """Answer (typed) any in-flight request past its reply bound —
+        a wedged worker must never leave a binary client hanging. Also
+        closes reject-mode connections whose client never hung up."""
+        for conn in list(self.conns):
+            if conn.reject_until is not None:
+                if now >= conn.reject_until:
+                    self.close_conn(conn)
+                continue
+            expired: List[int] = []
+            with conn.lock:
+                for rid, bound in list(conn.inflight.items()):
+                    if now >= bound:
+                        expired.append(rid)
+                        del conn.inflight[rid]
+            for rid in expired:
+                self.frontend._answer_error(
+                    conn, rid, wire.ERR_TIMEOUT,
+                    "response wait timed out")
+
+    def close_conn(self, conn: _Conn) -> None:
+        if conn.closed:
+            return
+        conn.closed = True
+        with conn.lock:
+            conn.inflight.clear()  # late completions become no-ops
+            conn.outbox.clear()
+        try:
+            self.sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        self.conns.discard(conn)
+        self.frontend._conn_closed()
+
+
+class BinaryFrontend:
+    """The event-loop binary-frame inference endpoint over an
+    InferenceServer or ModelRouter. Port 0 binds ephemeral; the bound
+    address is `.address`."""
+
+    transport = "binary"
+
+    def __init__(self, backend, port: int = 0, host: str = "127.0.0.1",
+                 io_threads: int = 2,
+                 max_frame_bytes: int = 64 << 20,
+                 chunk_bytes: int = 256 << 10,
+                 default_deadline_s: Optional[float] = None,
+                 max_connections: int = 4096,
+                 tenants: Optional[TenantAdmission] = None,
+                 logger: Optional[Logger] = None):
+        assert io_threads >= 1
+        self.backend = backend
+        self.adapter = BackendAdapter(backend)
+        self.default_deadline_s = default_deadline_s
+        self.max_frame_bytes = int(max_frame_bytes)
+        self.chunk_bytes = int(chunk_bytes)
+        self.max_connections = int(max_connections)
+        self.tenants = tenants
+        self.log = logger
+        self.registry = backend.registry
+        self._c_req, self._c_conns, self._g_active, self._c_shed = \
+            register_transport_metrics(self.registry, self.transport)
+        self.connections = 0       # lifetime accepted
+        self.requests = 0          # lifetime request frames
+        self.rejected_over_cap = 0
+        self._active = 0
+        self._active_lock = threading.Lock()
+        self._g_active.set_fn(lambda: self._active,
+                              transport=self.transport)
+        self.peak_buffered_bytes = 0  # max COPIED bytes queued per conn
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(1024)
+        self._listener.setblocking(False)
+        self._loops = [_IoLoop(self, i) for i in range(io_threads)]
+        self._loops[0].sel.register(self._listener,
+                                    selectors.EVENT_READ, "accept")
+        self._rr = itertools.count()
+        for lp in self._loops:
+            lp.start()
+        if logger is not None:
+            logger.log(f"serve: binary data plane at "
+                       f"spkn://{self.address[0]}:{self.address[1]} "
+                       f"({io_threads} io threads)")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._listener.getsockname()[:2]
+
+    def stop(self) -> None:
+        for lp in self._loops:
+            lp.stop()
+        for lp in self._loops:
+            lp.join(timeout=5.0)
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def _conn_closed(self) -> None:
+        with self._active_lock:
+            self._active -= 1
+
+    # -- accept (io loop 0) ---------------------------------------------------
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                sock, _addr = self._listener.accept()
+            except (BlockingIOError, OSError):
+                return
+            sock.setblocking(False)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            self.connections += 1
+            self._c_conns.inc(transport=self.transport)
+            with self._active_lock:
+                over = self._active >= self.max_connections
+                self._active += 1  # rejects count too (close is
+                #                    symmetric for both kinds)
+            lp = self._loops[next(self._rr) % len(self._loops)]
+            conn = _Conn(sock, lp)
+            if over:
+                # answered, not refused — but the client is mid-send of
+                # its request, so the connection enters REJECT mode:
+                # queue the typed frame, discard its input, and let the
+                # client hang up after reading the answer (closing now,
+                # with unread request bytes queued, would RST the
+                # socket and destroy the answer in flight). The reaper
+                # bounds a client that never hangs up.
+                self.rejected_over_cap += 1
+                conn.reject_until = time.monotonic() + 10.0
+            lp.call_soon(lambda c=conn, l=lp: l.adopt(c))
+            if over:
+                # after adopt is queued: the enqueue's write-arm must
+                # find the socket registered
+                self._answer_error(conn, 0, wire.ERR_OVER_CAPACITY,
+                                   "server at connection capacity")
+
+    # -- frame processing (a conn's io thread) --------------------------------
+
+    def _process(self, conn: _Conn) -> None:
+        if conn.reject_until is not None:
+            conn.rbuf.clear()  # reject mode: input is discarded
+            return
+        while not conn.closed and not conn.close_after_flush:
+            if len(conn.rbuf) < wire.HEADER_LEN:
+                return
+            try:
+                ftype, flags, req_id, meta_len, payload_len = \
+                    wire.parse_header(conn.rbuf)
+            except wire.WireError as e:
+                err = (wire.ERR_BAD_MAGIC if "magic" in str(e)
+                       else wire.ERR_BAD_VERSION)
+                self._answer_error(conn, 0, err, str(e), close=True)
+                return
+            if meta_len + payload_len > self.max_frame_bytes:
+                # the 413 analog: typed answer, then close THIS
+                # connection (we will not read our way through an
+                # oversized frame to stay in sync)
+                self._answer_error(
+                    conn, req_id, wire.ERR_TOO_LARGE,
+                    f"frame of {meta_len + payload_len} bytes exceeds "
+                    f"the {self.max_frame_bytes}-byte cap", close=True)
+                return
+            frame_len = wire.HEADER_LEN + meta_len + payload_len
+            if len(conn.rbuf) < frame_len:
+                return  # length-prefixed: wait for the rest
+            meta = bytes(conn.rbuf[wire.HEADER_LEN:
+                                   wire.HEADER_LEN + meta_len])
+            payload = bytes(conn.rbuf[wire.HEADER_LEN + meta_len:
+                                      frame_len])
+            del conn.rbuf[:frame_len]
+            if ftype != wire.T_REQUEST:
+                self._answer_error(
+                    conn, req_id, wire.ERR_BAD_REQUEST,
+                    f"unexpected frame type {ftype} (server accepts "
+                    f"REQUEST frames)")
+                continue
+            self._handle_request(conn, flags, req_id, meta, payload)
+
+    def _handle_request(self, conn: _Conn, flags: int, req_id: int,
+                        meta: bytes, payload: bytes) -> None:
+        self.requests += 1
+        stream = bool(flags & wire.FLAG_STREAM)
+        with conn.lock:
+            dup = req_id in conn.inflight
+        if dup:
+            # a duplicate id would overwrite the first entry and leave
+            # one of the two completions unanswered — reject it before
+            # anything is submitted (one io thread serves a connection,
+            # so this check cannot race a concurrent insert)
+            self._answer_error(
+                conn, req_id, wire.ERR_BAD_REQUEST,
+                f"request id {req_id} is already in flight on this "
+                f"connection")
+            return
+        try:
+            model_s, tenant, deadline_ms, descs = \
+                wire.unpack_request_meta(meta)
+            # admission runs BEFORE tensor decode / model resolution
+            # (the HTTP rule): a shed tenant's flood must not buy
+            # io-thread decode time, and a malformed request still
+            # spends its tenant's token
+            if self.tenants is not None and \
+                    not self.tenants.allow(tenant or None):
+                self._c_shed.inc(model=model_s or "",
+                                 reason="tenant_limit")
+                self._answer_error(conn, req_id, wire.ERR_TENANT_LIMIT,
+                                   "tenant rate limit exceeded")
+                return
+            inputs = wire.tensors_from(descs, payload)
+            model = self.adapter.resolve(model_s or None)
+            self.adapter.coerce(model, inputs)
+            deadline_s = (deadline_ms / 1e3 if deadline_ms is not None
+                          else self.default_deadline_s)
+            fut = self.adapter.submit(model, inputs, deadline_s)
+        except BaseException as e:
+            self._answer_error(conn, req_id, *_exception_to_err(e))
+            return
+        bound = time.monotonic() + (
+            deadline_s + 5.0 if deadline_s is not None
+            else _DEFAULT_WAIT_S)
+        with conn.lock:
+            if conn.closed:
+                return
+            conn.inflight[req_id] = bound
+        fut.add_done_callback(
+            lambda f, c=conn, r=req_id, s=stream, m=model:
+            self._complete(c, r, s, m, f))
+
+    # -- completion (forward-worker / proxy threads) --------------------------
+
+    def _complete(self, conn: _Conn, req_id: int, stream: bool,
+                  model: str, fut) -> None:
+        with conn.lock:
+            live = conn.inflight.pop(req_id, None) is not None
+        if not live:
+            return  # reaped (already answered) or connection gone
+        exc = fut.exception()
+        if exc is not None:
+            self._answer_error(conn, req_id, *_exception_to_err(exc))
+            return
+        out = {k: np.asarray(v) for k, v in fut.result().items()}
+        items = wire.pack_response(req_id, model,
+                                   self.adapter.step(model), out,
+                                   stream=stream,
+                                   chunk_bytes=self.chunk_bytes)
+        self._c_req.inc(code="200", transport=self.transport)
+        self._enqueue(conn, items)
+
+    # -- reply plumbing (any thread) ------------------------------------------
+
+    def _answer_error(self, conn: _Conn, req_id: int,
+                      code_kind: Tuple[int, str], msg: str,
+                      close: bool = False) -> None:
+        self._c_req.inc(code=str(code_kind[0]), transport=self.transport)
+        if close:
+            conn.close_after_flush = True
+        self._enqueue(conn, [(wire.pack_error(req_id, code_kind, msg),
+                              None)])
+
+    def _enqueue(self, conn: _Conn,
+                 items: List[Tuple[bytes, Optional[memoryview]]]) -> None:
+        if conn.closed:
+            return
+        with conn.lock:
+            for head, view in items:
+                if head:
+                    conn.outbox.append((memoryview(head), True))
+                    conn.copied_pending += len(head)
+                if view is not None and len(view):
+                    conn.outbox.append((view, False))
+            conn.peak_copied = max(conn.peak_copied, conn.copied_pending)
+            peak = conn.peak_copied
+        # the bench's buffer_bounded_by_chunk acceptance reads this
+        # high-water mark: the max-update must not lose a racing larger
+        # sample to an unsynchronized read-compare-write
+        with self._active_lock:
+            if peak > self.peak_buffered_bytes:
+                self.peak_buffered_bytes = peak
+        conn.loop.call_soon(lambda c=conn: c.loop.arm_write(c))
+
+
+# ---------------------------------------------------------------------------
+# the matching client
+# ---------------------------------------------------------------------------
+
+def _parse_address(address) -> Tuple[str, int]:
+    """(host, port) | 'host:port' | 'spkn://host:port' -> (host, port)."""
+    if isinstance(address, (tuple, list)):
+        return str(address[0]), int(address[1])
+    s = str(address)
+    for scheme in ("spkn://", "tcp://", "http://"):
+        if s.startswith(scheme):
+            s = s[len(scheme):]
+    s = s.rstrip("/")
+    host, _, port = s.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"binary address {address!r} is not host:port")
+    return host, int(port)
+
+
+class BinaryClient:
+    """Keep-alive, pipelined client for the binary frame transport.
+
+    `submit` writes a request frame and returns its request-id without
+    waiting; `collect` reads frames (in whatever completion order the
+    server chose) until that id resolves — so N submits followed by N
+    collects is a pipelined burst on one connection. `infer` is the
+    one-shot convenience and records `last_timing` (first-byte /
+    complete, seconds from submit) — the streaming bench reads it.
+
+    Thread-safety: one connection, one user thread (the thread-cached
+    `binary_infer` below gives each thread its own client)."""
+
+    def __init__(self, host, port: Optional[int] = None,
+                 timeout: float = 30.0):
+        if port is None:
+            host, port = _parse_address(host)
+        self.addr = (host, int(port))
+        self.timeout = float(timeout)
+        self.sock = socket.create_connection(self.addr, timeout=timeout)
+        try:
+            self.sock.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        self._rbuf = bytearray()
+        self._ids = itertools.count(1)
+        # req_id -> reassembly state (supports out-of-order completion)
+        self._pending: Dict[int, Dict[str, Any]] = {}
+        self.last_timing: Optional[Dict[str, float]] = None
+        self.closed = False
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    # -- submit side ---------------------------------------------------------
+
+    def submit(self, payload: Dict[str, np.ndarray],
+               model: str = "", deadline_s: Optional[float] = None,
+               tenant: Optional[str] = None,
+               stream: bool = False) -> int:
+        rid = next(self._ids)
+        head, views = wire.pack_request(
+            rid, model, {k: np.asarray(v) for k, v in payload.items()},
+            deadline_ms=None if deadline_s is None else deadline_s * 1e3,
+            tenant=tenant, stream=stream)
+        self._pending[rid] = {"t_submit": time.perf_counter(),
+                              "t_first": None, "done": False,
+                              "outputs": None, "exc": None,
+                              "buf": None, "descs": None, "got": 0,
+                              "total": 0, "model": None, "step": None}
+        # _fill shrinks the socket timeout toward a deadline; a cached
+        # client's NEXT send must not inherit that sliver
+        self.sock.settimeout(self.timeout)
+        self.sock.sendall(head)
+        for v in views:
+            self.sock.sendall(v)
+        return rid
+
+    # -- receive side --------------------------------------------------------
+
+    def _fill(self, n: int, deadline: float) -> None:
+        """Block until the read buffer holds >= n bytes."""
+        while len(self._rbuf) < n:
+            budget = deadline - time.perf_counter()
+            if budget <= 0:
+                raise TimeoutError(
+                    f"binary_infer: no reply within the timeout "
+                    f"({self.timeout:.1f}s)")
+            self.sock.settimeout(min(budget, self.timeout))
+            try:
+                data = self.sock.recv(1 << 18)
+            except socket.timeout:
+                continue
+            if not data:
+                raise ConnectionError(
+                    "binary transport: server closed the connection")
+            self._rbuf += data
+
+    def _read_frame(self, deadline: float) -> None:
+        self._fill(wire.HEADER_LEN, deadline)
+        ftype, flags, rid, meta_len, payload_len = \
+            wire.parse_header(self._rbuf)
+        inline = 0 if (ftype == wire.T_RESPONSE
+                       and flags & wire.FLAG_STREAM) else payload_len
+        self._fill(wire.HEADER_LEN + meta_len + inline, deadline)
+        meta = bytes(self._rbuf[wire.HEADER_LEN:
+                                wire.HEADER_LEN + meta_len])
+        payload = bytes(self._rbuf[wire.HEADER_LEN + meta_len:
+                                   wire.HEADER_LEN + meta_len + inline])
+        del self._rbuf[:wire.HEADER_LEN + meta_len + inline]
+        now = time.perf_counter()
+        if ftype == wire.T_ERROR:
+            code, kind, msg = wire.unpack_error_meta(meta)
+            if rid == 0:
+                # connection-level: the stream is done for — but the
+                # error is still the server's TYPED answer (e.g. 503
+                # over_capacity must surface as NoReplicaError exactly
+                # as it would over HTTP, so router proxies stay
+                # transport-blind)
+                self.close()
+                raise_for_error(code, kind, msg)
+            st = self._pending.get(rid)
+            if st is not None:
+                st["exc"] = (code, kind, msg)
+                st["done"] = True
+                if st["t_first"] is None:
+                    st["t_first"] = now
+            return
+        st = self._pending.get(rid)
+        if st is None:
+            return  # reply to an abandoned id: drop it
+        if st["t_first"] is None:
+            st["t_first"] = now
+        if ftype == wire.T_RESPONSE:
+            model, step, descs = wire.unpack_response_meta(meta)
+            st["model"], st["step"], st["descs"] = model, step, descs
+            if flags & wire.FLAG_STREAM:
+                st["total"] = payload_len
+                st["buf"] = bytearray(payload_len)
+                if payload_len == 0:
+                    st["outputs"] = wire.tensors_from(descs, b"")
+                    st["done"] = True
+            else:
+                st["outputs"] = wire.tensors_from(descs, payload)
+                st["done"] = True
+        elif ftype == wire.T_CHUNK:
+            off = wire.unpack_chunk_meta(meta)
+            if st["buf"] is None or off + len(payload) > st["total"]:
+                raise wire.WireError(
+                    f"chunk for request {rid} outside its announced "
+                    f"payload")
+            st["buf"][off:off + len(payload)] = payload
+            st["got"] += len(payload)
+            if st["got"] >= st["total"] or flags & wire.FLAG_LAST:
+                if st["got"] < st["total"]:
+                    raise wire.WireError(
+                        f"stream for request {rid} ended {st['total'] - st['got']} "
+                        f"bytes short")
+                # frombuffer views the bytearray directly — no full-blob
+                # copy on the client side of the zero-copy wire either
+                st["outputs"] = wire.tensors_from(st["descs"],
+                                                  st["buf"])
+                st["done"] = True
+        # any other type from a server is a protocol error
+        else:
+            raise wire.WireError(f"unexpected frame type {ftype} "
+                                 f"from server")
+
+    def collect(self, rid: int, timeout: Optional[float] = None
+                ) -> Dict[str, np.ndarray]:
+        """Read until request `rid` resolves (other ids' replies are
+        absorbed into their own pending states — pipelining)."""
+        deadline = time.perf_counter() + (timeout if timeout is not None
+                                          else self.timeout)
+        while True:
+            st = self._pending.get(rid)
+            if st is None:
+                raise KeyError(f"unknown request id {rid}")
+            if st["done"]:
+                self._pending.pop(rid)
+                self.last_timing = {
+                    "t_first_byte_s": st["t_first"] - st["t_submit"],
+                    "t_complete_s":
+                        time.perf_counter() - st["t_submit"]}
+                if st["exc"] is not None:
+                    raise_for_error(*st["exc"])
+                return st["outputs"]
+            self._read_frame(deadline)
+
+    def infer(self, payload: Dict[str, np.ndarray], model: str = "",
+              deadline_s: Optional[float] = None,
+              tenant: Optional[str] = None, stream: bool = False,
+              timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+        rid = self.submit(payload, model=model, deadline_s=deadline_s,
+                          tenant=tenant, stream=stream)
+        return self.collect(rid, timeout=timeout)
+
+
+# -- thread-cached convenience client (the proxy/bench entry point) ----------
+
+_client_cache = threading.local()
+MAX_CACHED_CLIENTS = 8  # per thread; LRU-evicted past this
+
+
+def _cached_client(host: str, port: int, timeout: float) -> BinaryClient:
+    cli = lru_cache_get(
+        _client_cache, "clients", (host, port),
+        lambda: BinaryClient(host, port, timeout=timeout),
+        MAX_CACHED_CLIENTS)
+    cli.timeout = float(timeout)
+    return cli
+
+
+def _drop_client(host: str, port: int) -> None:
+    lru_cache_drop(_client_cache, "clients", (host, port))
+
+
+def binary_infer(address, model: str,
+                 payload: Dict[str, np.ndarray],
+                 deadline_s: Optional[float] = None,
+                 timeout: float = 30.0,
+                 tenant: Optional[str] = None,
+                 stream: bool = False) -> Dict[str, np.ndarray]:
+    """One inference request over the binary transport (thread-cached
+    keep-alive client — the `http_infer` counterpart the router's
+    binary remote replicas and the bench drivers ride). The http_infer
+    cache rules apply: ANY failure mid-exchange evicts this address's
+    cached client (never re-use a stream in an unknown state); a stale
+    server-closed socket gets ONE retry on a fresh connection."""
+    host, port = _parse_address(address)
+    for attempt in (0, 1):
+        cli = _cached_client(host, port, timeout)
+        try:
+            return cli.infer(payload, model=model, deadline_s=deadline_s,
+                             tenant=tenant, stream=stream,
+                             timeout=timeout)
+        except (TenantLimitError, QueueFullError, DeadlineExpiredError,
+                NoReplicaError, UnknownModelError, ValueError):
+            # typed sheds arrived ON the stream, which is usually still
+            # good — except a connection-level frame (rid 0, e.g.
+            # over_capacity), whose delivery closed the client
+            if cli.closed:
+                _drop_client(host, port)
+            raise
+        except TimeoutError:
+            _drop_client(host, port)
+            raise  # a slow server is not a stale socket: no retry
+        except ConnectionError as e:
+            # a server-closed cached connection: retry once fresh
+            _drop_client(host, port)
+            if attempt:
+                raise ConnectionError(
+                    f"binary_infer to {address}: {e}") from e
+        except BaseException:
+            _drop_client(host, port)
+            raise

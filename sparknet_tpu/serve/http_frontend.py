@@ -4,7 +4,9 @@
 Before this module requests entered through in-process
 `InferenceServer.submit` and the HTTP layer was status-only; this is the
 open-loop-measurable path — persistent connections, wire decode on the
-accept threads, admission control, deadline-aware shedding.
+accept threads, admission control, deadline-aware shedding. (The binary
+frame transport in `binary_frontend.py` is the second wire behind the
+same backends; `BackendAdapter` below is the seam both ride.)
 
 Wire protocol (all under `/v1`):
 
@@ -24,11 +26,17 @@ Wire protocol (all under `/v1`):
 Error codes (every shed is ANSWERED — a client never hangs):
   400  undecodable body / not a net input / wrong shape
   404  unknown model or route
+  408  socket timed out mid-body-read (the stream is desynced — the
+       reply closes the connection)
   413  body over the size cap
-  429  queue at capacity (QueueFullError backpressure) + Retry-After
+  429  queue at capacity (QueueFullError backpressure) or the tenant's
+       token bucket is empty (error_kind "tenant_limit" — per-tenant
+       admission via the X-Tenant header, serve/admission.py) +
+       Retry-After
   503  request shed: client deadline expired before a forward
-       (DeadlineExpiredError), no routable replica (NoReplicaError), or
-       response-wait timeout — all + Retry-After
+       (DeadlineExpiredError), no routable replica (NoReplicaError),
+       response-wait timeout, or the server is at its connection cap
+       (error_kind "over_capacity") — all + Retry-After
   500  anything else (the error text rides the JSON body)
 
 Design rules carried from the serving core:
@@ -39,9 +47,21 @@ Design rules carried from the serving core:
     connections persistent; the connection/request counters let tests
     assert reuse (10k rps is unreachable through per-request TCP+TLS
     handshakes).
+  - CONNECTION HYGIENE: thread-per-connection means every idle
+    keep-alive connection pins one OS thread — so idle connections are
+    closed after `idle_timeout_s`, the live set is capped at
+    `max_connections` (excess answered 503 + Connection: close, never
+    silently refused), and `http_connections_active{transport}` gauges
+    the live count.
   - ADMISSION CONTROL: QueueFullError maps to 429 with Retry-After;
     expired deadlines are rejected at the door (never enqueued) and shed
-    from the queue by the batcher before they pad into a bucket.
+    from the queue by the batcher before they pad into a bucket;
+    per-tenant token buckets (when configured) shed a hot tenant's flood
+    BEFORE it occupies queue slots.
+
+Transport-labeled metrics: this frontend and the binary one register the
+SAME request/connection families with `transport="http"` /
+`transport="binary"`, so one scrape compares the two wires per code.
 
 `http_infer` at the bottom is the matching client (thread-cached
 keep-alive connections, npz wire format) — the router's remote-replica
@@ -63,11 +83,27 @@ from urllib.parse import urlsplit
 import numpy as np
 
 from ..utils.logger import Logger
+from .admission import TenantAdmission, TenantLimitError
 from .batcher import DeadlineExpiredError, QueueFullError
 from .router import ModelRouter, NoReplicaError, UnknownModelError
 from .server import InferenceServer, net_input_specs
 
 NPZ_CONTENT_TYPE = "application/x-npz"
+
+# a tenant-limited request's body is drained (keep-alive survives the
+# 429) only up to this size; past it the reply closes the connection —
+# shedding must not buy the flood full-body socket reads
+TENANT_SHED_DRAIN_BYTES = 64 << 10
+
+
+class _BodyReadTimeout(Exception):
+    """The connection's socket timed out (or died) mid-body-read. The
+    stream is DESYNCED — unread body bytes would be parsed as the next
+    request line — so the reply must close the connection. A dedicated
+    type because socket.timeout aliases shift across Python versions
+    (3.10: distinct from futures.TimeoutError; 3.11+: the same class),
+    and the except-ladder must not confuse a half-read body with a
+    response-wait timeout."""
 
 
 def _encode_npz(arrays: Dict[str, np.ndarray]) -> bytes:
@@ -81,49 +117,159 @@ def _decode_npz(body: bytes) -> Dict[str, np.ndarray]:
         return {k: z[k] for k in z.files}
 
 
+class BackendAdapter:
+    """Normalizes an `InferenceServer` or a `ModelRouter` behind one
+    resolve/submit/coerce surface. Both wire frontends (HTTP here, the
+    binary frame transport in binary_frontend.py) ride this seam, so a
+    request behaves identically whichever wire carried it."""
+
+    def __init__(self, backend):
+        self.backend = backend
+        self.is_router = isinstance(backend, ModelRouter) or \
+            hasattr(backend, "lanes")
+        # per-model input dtype coercion table (JSON floats arrive as
+        # float64; coerce on the TRANSPORT thread so the worker never
+        # pays)
+        self.specs: Dict[str, Dict[str, np.dtype]] = {}
+        for name, lane in self.lanes().items():
+            self.specs[name] = {
+                k: np.dtype(dt)
+                for k, (_, dt) in net_input_specs(lane.net).items()}
+
+    def lanes(self) -> Dict[str, InferenceServer]:
+        if self.is_router:
+            return self.backend.lanes
+        return {self.backend.model_name: self.backend}
+
+    def model_names(self) -> Tuple[str, ...]:
+        if self.is_router:
+            return tuple(sorted(set(self.backend.lanes)
+                                | set(self.backend.replicas)))
+        return (self.backend.model_name,)
+
+    def resolve(self, model: Optional[str]) -> str:
+        """None -> the sole served model; ambiguous None raises."""
+        if model is not None:
+            return model
+        names = self.model_names()
+        if len(names) != 1:
+            raise UnknownModelError(
+                f"the default-model route is ambiguous: this endpoint "
+                f"serves {list(names)}; name the model explicitly")
+        return names[0]
+
+    def submit(self, model: str, payload: Dict[str, np.ndarray],
+               deadline_s: Optional[float]):
+        if self.is_router:
+            return self.backend.submit(model, payload,
+                                       deadline_s=deadline_s)
+        if model != self.backend.model_name:
+            raise UnknownModelError(model)
+        return self.backend.submit(payload, deadline_s=deadline_s)
+
+    def coerce(self, model: Optional[str],
+               payload: Dict[str, np.ndarray]) -> None:
+        """Cast inputs to the net's schema dtypes IN PLACE, on the
+        calling (transport) thread."""
+        names = self.model_names()
+        specs = self.specs.get(
+            model if model is not None
+            else (names[0] if len(names) == 1 else ""), {})
+        for k, dt in specs.items():
+            if k in payload and payload[k].dtype != dt:
+                payload[k] = payload[k].astype(dt)
+
+    def step(self, model: str) -> Optional[int]:
+        lane = self.lanes().get(model)
+        return None if lane is None else lane.manager.step
+
+    def healthy(self) -> bool:
+        return (self.backend.healthy()
+                if hasattr(self.backend, "healthy") else True)
+
+
+def register_transport_metrics(registry, transport: str):
+    """The shared data-plane families, `transport`-labeled so HTTP and
+    binary render side by side in one scrape. Returns (requests counter,
+    connections counter, active-connections gauge, shed counter)."""
+    c_req = registry.counter(
+        "sparknet_serve_http_requests_total",
+        "data-plane requests by status code and wire transport",
+        labels=("code", "transport"))
+    c_conn = registry.counter(
+        "sparknet_serve_http_connections_total",
+        "data-plane connections accepted (requests/connections >> 1 "
+        "means keep-alive/pipelining reuse is working)",
+        labels=("transport",))
+    g_active = registry.gauge(
+        "sparknet_serve_http_connections_active",
+        "currently-open data-plane connections", labels=("transport",))
+    c_shed = registry.counter(
+        "sparknet_serve_shed_total",
+        "requests shed before a forward, by reason (deadline = "
+        "client deadline expired before batch formation)",
+        labels=("model", "reason"))
+    return c_req, c_conn, g_active, c_shed
+
+
 class HttpFrontend:
     """HTTP/1.1 inference endpoint over an InferenceServer or a
     ModelRouter (the `backend`). Port 0 binds ephemeral; the bound
     address is `.address`."""
 
+    transport = "http"
+
     def __init__(self, backend, port: int = 0, host: str = "127.0.0.1",
                  default_deadline_s: Optional[float] = None,
                  retry_after_s: float = 1.0,
                  max_body_bytes: int = 64 << 20,
+                 idle_timeout_s: float = 60.0,
+                 max_connections: int = 256,
+                 tenants: Optional[TenantAdmission] = None,
                  logger: Optional[Logger] = None):
         self.backend = backend
-        self.is_router = isinstance(backend, ModelRouter) or \
-            hasattr(backend, "lanes")
+        self.adapter = BackendAdapter(backend)
+        self.is_router = self.adapter.is_router
         self.default_deadline_s = default_deadline_s
         self.retry_after_s = float(retry_after_s)
         self.max_body_bytes = int(max_body_bytes)
+        self.idle_timeout_s = float(idle_timeout_s)
+        self.max_connections = int(max_connections)
+        self.tenants = tenants
         self.log = logger
         self.registry = backend.registry
-        self._c_http = self.registry.counter(
-            "sparknet_serve_http_requests_total",
-            "HTTP data-plane requests by status code", labels=("code",))
-        self._c_conns = self.registry.counter(
-            "sparknet_serve_http_connections_total",
-            "HTTP connections accepted (requests/connections >> 1 means "
-            "keep-alive reuse is working)")
+        self._c_http, self._c_conns, self._g_active, self._c_shed = \
+            register_transport_metrics(self.registry, self.transport)
         self.connections = 0
+        self.rejected_over_cap = 0
         self.requests = 0
-        # per-model input dtype coercion table (JSON floats arrive as
-        # float64; coerce on the ACCEPT thread so the worker never pays)
-        self._specs: Dict[str, Dict[str, np.dtype]] = {}
-        for name, lane in self._lanes().items():
-            self._specs[name] = {
-                k: np.dtype(dt)
-                for k, (_, dt) in net_input_specs(lane.net).items()}
+        self._active = 0
+        self._active_lock = threading.Lock()
+        self._g_active.set_fn(lambda: self._active,
+                              transport=self.transport)
         owner = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # the per-connection socket timeout: an idle keep-alive
+            # connection times out its blocking readline, which
+            # handle_one_request treats as close_connection — the
+            # pinned thread is released instead of held forever by an
+            # idle-connection flood
+            timeout = owner.idle_timeout_s
 
             def setup(self):  # one Handler instance == one connection
                 super().setup()
                 owner.connections += 1
-                owner._c_conns.inc()
+                owner._c_conns.inc(transport=owner.transport)
+                with owner._active_lock:
+                    owner._active += 1
+                    self._over_cap = owner._active > owner.max_connections
+
+            def finish(self):
+                with owner._active_lock:
+                    owner._active -= 1
+                super().finish()
 
             def do_POST(self):  # noqa: N802 (stdlib casing)
                 owner._handle_post(self)
@@ -143,46 +289,62 @@ class HttpFrontend:
             logger.log(f"serve: HTTP data plane at "
                        f"http://{self.address[0]}:{self.address[1]}/v1")
 
-    # -- backend normalization ----------------------------------------------
+    # -- backend normalization (adapter passthroughs) ------------------------
 
     def _lanes(self) -> Dict[str, InferenceServer]:
-        if self.is_router:
-            return self.backend.lanes
-        return {self.backend.model_name: self.backend}
+        return self.adapter.lanes()
 
     def _model_names(self) -> Tuple[str, ...]:
-        if self.is_router:
-            return tuple(sorted(set(self.backend.lanes)
-                                | set(self.backend.replicas)))
-        return (self.backend.model_name,)
+        return self.adapter.model_names()
 
     def _submit(self, model: Optional[str],
                 payload: Dict[str, np.ndarray],
                 deadline_s: Optional[float]):
-        names = self._model_names()
-        if model is None:
-            if len(names) != 1:
-                raise UnknownModelError(
-                    f"/v1/infer is ambiguous: this endpoint serves "
-                    f"{list(names)}; POST /v1/models/<name>/infer")
-            model = names[0]
-        if self.is_router:
-            return model, self.backend.submit(model, payload,
-                                              deadline_s=deadline_s)
-        if model != self.backend.model_name:
-            raise UnknownModelError(model)
-        return model, self.backend.submit(payload, deadline_s=deadline_s)
+        model = self.adapter.resolve(model)
+        return model, self.adapter.submit(model, payload, deadline_s)
 
     def _step(self, model: str) -> Optional[int]:
-        lane = self._lanes().get(model)
-        return None if lane is None else lane.manager.step
+        return self.adapter.step(model)
 
     # -- request handling (accept threads) -----------------------------------
+
+    def _read_body(self, h, length: int) -> bytes:
+        """Read the request body on the accept thread; a socket timeout
+        (or death) mid-read leaves the keep-alive stream desynced, so it
+        surfaces as the typed _BodyReadTimeout whose reply closes."""
+        try:
+            return h.rfile.read(length)
+        except (socket.timeout, OSError) as e:
+            raise _BodyReadTimeout(str(e)) from e
+
+    def _reject_over_cap(self, h, drain_len: int = 0) -> None:
+        """503 + Connection: close for a connection accepted past the
+        cap — answered through the normal reply path AFTER draining the
+        request body (replying before the client finishes sending would
+        RST the socket and destroy the answer in flight). Answered, not
+        refused: the client learns WHY and backs off; `close=True`
+        releases the pinned thread immediately after."""
+        if 0 <= drain_len <= self.max_body_bytes:
+            try:
+                h.rfile.read(drain_len)
+            except (socket.timeout, OSError):
+                pass  # the reply below closes either way
+        self.rejected_over_cap += 1
+        self._reply(h, 503, {"error": "server at connection capacity",
+                             "error_kind": "over_capacity"},
+                    retry_after=True, close=True)
 
     def _handle_post(self, h: BaseHTTPRequestHandler) -> None:
         self.requests += 1
         t0 = time.perf_counter()
         try:
+            if getattr(h, "_over_cap", False):
+                try:
+                    drain = int(h.headers.get("Content-Length") or 0)
+                except ValueError:
+                    drain = 0
+                self._reject_over_cap(h, drain)
+                return
             model = self._route_model(h.path)
             if model is NOT_AN_INFER_ROUTE:
                 self._reply(h, 404, {"error": f"no route {h.path!r}",
@@ -207,7 +369,27 @@ class HttpFrontend:
                                      "error_kind": "bad_request"},
                             close=True)
                 return
-            body = h.rfile.read(length)
+            if self.tenants is not None and \
+                    not self.tenants.allow(h.headers.get("X-Tenant")):
+                # shed the flood before DECODING or touching a queue
+                # slot. A small body is drained so keep-alive survives
+                # the 429; past the threshold we close instead — a
+                # tenant flooding huge bodies must not buy full-body
+                # socket reads on pinned accept threads either
+                drain = length <= TENANT_SHED_DRAIN_BYTES
+                if drain:
+                    self._read_body(h, length)
+                # label with the model the CLIENT named; a default-route
+                # request belongs to "" (blaming the alphabetically
+                # first model would misattribute tenant floods)
+                self._c_shed.inc(model=model or "",
+                                 reason="tenant_limit")
+                self._reply(h, 429, {
+                    "error": "tenant rate limit exceeded",
+                    "error_kind": "tenant_limit"}, retry_after=True,
+                    close=not drain)
+                return
+            body = self._read_body(h, length)
             ctype = (h.headers.get("Content-Type") or "").split(";")[0]
             want_npz = ctype == NPZ_CONTENT_TYPE or \
                 NPZ_CONTENT_TYPE in (h.headers.get("Accept") or "")
@@ -234,9 +416,19 @@ class HttpFrontend:
                         (time.perf_counter() - t0) * 1e3, 3),
                     "outputs": {k: np.asarray(v).tolist()
                                 for k, v in out.items()}})
+        except _BodyReadTimeout:
+            # half-read body: the stream is desynced — answer AND close
+            self._reply(h, 408, {"error": "timed out reading the "
+                                 "request body",
+                                 "error_kind": "request_timeout"},
+                        close=True)
         except UnknownModelError as e:
             self._reply(h, 404, {"error": str(e),
                                  "error_kind": "unknown_model"})
+        except TenantLimitError as e:
+            self._reply(h, 429, {"error": str(e),
+                                 "error_kind": "tenant_limit"},
+                        retry_after=True)
         except QueueFullError as e:
             self._reply(h, 429, {"error": str(e),
                                  "error_kind": "queue_full"},
@@ -262,6 +454,9 @@ class HttpFrontend:
 
     def _handle_get(self, h: BaseHTTPRequestHandler) -> None:
         try:
+            if getattr(h, "_over_cap", False):
+                self._reject_over_cap(h)
+                return
             if h.path.startswith("/v1/models"):
                 rows = {name: lane.model_row()
                         for name, lane in self._lanes().items()}
@@ -269,8 +464,7 @@ class HttpFrontend:
                     rows.setdefault(name, {"remote_only": True})
                 self._reply(h, 200, {"models": rows})
             elif h.path.startswith("/healthz"):
-                ok = (self.backend.healthy()
-                      if hasattr(self.backend, "healthy") else True)
+                ok = self.adapter.healthy()
                 self._reply(h, 200 if ok else 503,
                             {"status": "ok" if ok else "unhealthy"})
             else:
@@ -316,13 +510,7 @@ class HttpFrontend:
         # dtype coercion per the net's input schema (JSON numbers land
         # float64/int64; the worker-side stack would cast anyway, but
         # HERE the cast runs on the accept thread)
-        names = self._model_names()
-        specs = self._specs.get(
-            model if model is not None
-            else (names[0] if len(names) == 1 else ""), {})
-        for k, dt in specs.items():
-            if k in payload and payload[k].dtype != dt:
-                payload[k] = payload[k].astype(dt)
+        self.adapter.coerce(model, payload)
         return payload, deadline_ms
 
     # -- replies -------------------------------------------------------------
@@ -336,11 +524,14 @@ class HttpFrontend:
     def _reply_bytes(self, h, code: int, data: bytes, ctype: str,
                      retry_after: bool = False, close: bool = False,
                      extra: Optional[Dict[str, str]] = None) -> None:
-        self._c_http.inc(code=str(code))
+        self._c_http.inc(code=str(code), transport=self.transport)
         try:
             h.send_response(code)
             h.send_header("Content-Type", ctype)
             h.send_header("Content-Length", str(len(data)))
+            if extra:
+                for k, v in extra.items():
+                    h.send_header(k, v)
             if retry_after:
                 # RFC 9110 delta-seconds (integer); sub-second backpressure
                 # still says "1" — the body's error_kind carries the why
@@ -377,38 +568,78 @@ NOT_AN_INFER_ROUTE = _NotAnInferRoute()
 # ---------------------------------------------------------------------------
 
 _conn_cache = threading.local()
+MAX_CACHED_CONNECTIONS = 8  # per thread; LRU-evicted past this
+
+
+def lru_cache_get(tl: threading.local, attr: str, key, factory,
+                  max_cached: int):
+    """Thread-local keep-alive object cache with LRU bounding (dict
+    insertion order is the LRU order; re-insertion moves to the tail).
+    Shared by http_infer's connection cache and binary_infer's client
+    cache — ONE copy of the cache-hygiene rules. Evictees get
+    `.close()`d, exceptions swallowed (a dying socket must not fail the
+    request that merely aged it out)."""
+    cache = getattr(tl, attr, None)
+    if cache is None:
+        cache = {}
+        setattr(tl, attr, cache)
+    obj = cache.pop(key, None)
+    if obj is None:
+        obj = factory()
+    cache[key] = obj
+    while len(cache) > max_cached:
+        oldest = next(k for k in cache if k != key)
+        old = cache.pop(oldest)
+        try:
+            old.close()
+        except Exception:
+            pass
+    return obj
+
+
+def lru_cache_drop(tl: threading.local, attr: str, key) -> None:
+    """Evict + close one cached object (ANY-transport-error hygiene:
+    never re-use a stream in an unknown state)."""
+    obj = getattr(tl, attr, {}).pop(key, None)
+    if obj is not None:
+        try:
+            obj.close()
+        except Exception:
+            pass
 
 
 def _connection(host: str, port: int, timeout: float):
     """Thread-cached keep-alive HTTPConnection (one per (host, port) per
     thread — the open-loop bench and the router's proxy both need
-    connection reuse to mean anything)."""
-    cache = getattr(_conn_cache, "conns", None)
-    if cache is None:
-        cache = _conn_cache.conns = {}
-    key = (host, port)
-    conn = cache.get(key)
-    if conn is None:
-        conn = cache[key] = http.client.HTTPConnection(
-            host, port, timeout=timeout)
+    connection reuse to mean anything). LRU-BOUNDED: a client sweeping
+    many replicas must not accumulate one socket per address it ever
+    touched."""
+    conn = lru_cache_get(
+        _conn_cache, "conns", (host, port),
+        lambda: http.client.HTTPConnection(host, port, timeout=timeout),
+        MAX_CACHED_CONNECTIONS)
     conn.timeout = timeout
     return conn
 
 
 def _drop_connection(host: str, port: int) -> None:
-    cache = getattr(_conn_cache, "conns", {})
-    conn = cache.pop((host, port), None)
-    if conn is not None:
-        conn.close()
+    lru_cache_drop(_conn_cache, "conns", (host, port))
 
 
 def http_infer(base_url: str, model: str,
                payload: Dict[str, np.ndarray],
                deadline_s: Optional[float] = None,
-               timeout: float = 30.0) -> Dict[str, np.ndarray]:
+               timeout: float = 30.0,
+               tenant: Optional[str] = None) -> Dict[str, np.ndarray]:
     """POST one inference request (npz wire format, keep-alive) and
     return the output arrays. Maps the frontend's shed codes back to the
-    serve exceptions, so a remote replica behaves like a local lane."""
+    serve exceptions, so a remote replica behaves like a local lane.
+
+    Cache hygiene: ANY error between request and full response read —
+    transport or otherwise — evicts this (host, port)'s thread-cached
+    connection. A half-read reply left on a cached socket would desync
+    every later request on it; better a fresh TCP handshake than a
+    poisoned stream."""
     u = urlsplit(base_url if "//" in base_url else f"http://{base_url}")
     host, port = u.hostname, u.port or 80
     path = f"{u.path.rstrip('/')}/v1/models/{model}/infer"
@@ -416,6 +647,8 @@ def http_infer(base_url: str, model: str,
                "Accept": NPZ_CONTENT_TYPE}
     if deadline_s is not None:
         headers["X-Deadline-Ms"] = f"{deadline_s * 1e3:.3f}"
+    if tenant is not None:
+        headers["X-Tenant"] = tenant
     body = _encode_npz(payload)
     for attempt in (0, 1):
         conn = _connection(host, port, timeout)
@@ -434,13 +667,27 @@ def http_infer(base_url: str, model: str,
             if attempt:
                 raise ConnectionError(
                     f"http_infer to {base_url}: {e}") from e
+        except BaseException:
+            # ANY other failure mid-exchange (decode error raised by a
+            # lower layer, KeyboardInterrupt, ...) leaves the socket in
+            # an unknown read state: never re-use it
+            _drop_connection(host, port)
+            raise
     if resp.status == 200:
-        return _decode_npz(data)
+        try:
+            return _decode_npz(data)
+        except Exception:
+            # the reply was fully read, but undecodable — the stream
+            # itself may be desynced; drop it before raising
+            _drop_connection(host, port)
+            raise
     try:
         err = json.loads(data)
     except Exception:
         err = {"error": data[:200].decode("utf-8", "replace")}
     kind, msg = err.get("error_kind"), err.get("error", "")
+    if resp.status == 429 and kind == "tenant_limit":
+        raise TenantLimitError(msg)
     if resp.status == 429:
         raise QueueFullError(msg)
     if resp.status == 503 and kind == "deadline":

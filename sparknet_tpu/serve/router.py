@@ -75,17 +75,23 @@ def heartbeat_health(path: str, stale_after_s: float = 60.0,
 
 class Replica:
     """One serving copy of a model: the local lane, or a remote frontend
-    base URL. `health_fn` (remote) answers "is it alive" — typically
-    `heartbeat_health` over the replica's pod heartbeat."""
+    address. `health_fn` (remote) answers "is it alive" — typically
+    `heartbeat_health` over the replica's pod heartbeat. `transport`
+    picks the remote wire: "http" (http_infer) or "binary" (the
+    length-prefixed frame protocol via binary_infer — cross-replica
+    proxy hops drop the npz/JSON re-encode tax)."""
 
     def __init__(self, name: str, lane: Optional[InferenceServer] = None,
                  url: Optional[str] = None,
-                 health_fn: Optional[Callable[[], bool]] = None):
+                 health_fn: Optional[Callable[[], bool]] = None,
+                 transport: str = "http"):
         assert (lane is None) != (url is None), \
             "a replica is exactly one of: local lane, remote url"
+        assert transport in ("http", "binary"), transport
         self.name = name
         self.lane = lane
         self.url = url.rstrip("/") if url else None
+        self.transport = transport
         self.health_fn = health_fn
         self._draining = False
 
@@ -105,7 +111,8 @@ class Replica:
         return {"replica": self.name,
                 "kind": "local" if self.lane is not None else "remote",
                 "draining": self._draining,
-                **({"url": self.url} if self.url else {})}
+                **({"url": self.url, "transport": self.transport}
+                   if self.url else {})}
 
 
 @dataclass
@@ -190,16 +197,22 @@ class ModelRouter:
 
     def add_remote_replica(self, model: str, url: str,
                            health_fn: Optional[Callable[[], bool]] = None,
-                           heartbeat_path: Optional[str] = None
+                           heartbeat_path: Optional[str] = None,
+                           transport: Optional[str] = None
                            ) -> Replica:
-        """Register another pod worker's HTTP frontend as a replica of
-        `model`. Health comes from `health_fn`, or from `heartbeat_path`
-        through the shared staleness rule; with neither, the replica is
-        trusted until drained."""
+        """Register another pod worker's frontend as a replica of
+        `model`. `url` is an HTTP base URL, or `spkn://host:port` for
+        the binary frame transport (`transport` overrides; the scheme
+        decides otherwise). Health comes from `health_fn`, or from
+        `heartbeat_path` through the shared staleness rule; with
+        neither, the replica is trusted until drained."""
         if health_fn is None and heartbeat_path is not None:
             health_fn = heartbeat_health(heartbeat_path,
                                          self.cfg.stale_after_s)
-        rep = Replica(f"remote:{url}", url=url, health_fn=health_fn)
+        if transport is None:
+            transport = "binary" if url.startswith("spkn://") else "http"
+        rep = Replica(f"remote:{url}", url=url, health_fn=health_fn,
+                      transport=transport)
         self.replicas.setdefault(model, []).append(rep)
         self._rr.setdefault(model, itertools.count())
         return rep
@@ -341,10 +354,16 @@ class ModelRouter:
     def _proxy_call(self, rep: Replica, model: str,
                     payload: Dict[str, Any],
                     deadline_s: Optional[float], fut: Future) -> None:
-        from .http_frontend import http_infer  # import cycle guard
         try:
-            fut.set_result(http_infer(
-                rep.url, model, payload, deadline_s=deadline_s))
+            if rep.transport == "binary":
+                from .binary_frontend import binary_infer  # cycle guard
+                out = binary_infer(rep.url, model, payload,
+                                   deadline_s=deadline_s)
+            else:
+                from .http_frontend import http_infer  # cycle guard
+                out = http_infer(rep.url, model, payload,
+                                 deadline_s=deadline_s)
+            fut.set_result(out)
         except Exception as e:
             fut.set_exception(e)
 

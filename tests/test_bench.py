@@ -93,7 +93,9 @@ def test_serve_bench_smoke(tmp_path):
     rows = out["rows"]
     assert [r["load"] for r in rows] == [
         "trickle", "open_50rps", "open_200rps", "saturate",
-        "http_open_200rps", "http_chaos_swap_drain"]
+        "http_open_200rps", "binary_open_200rps", "ab_small_http",
+        "ab_small_binary", "transport_parity", "binary_stream_blob",
+        "http_chaos_swap_drain"]
     for r in rows[:4]:
         assert r["requests_failed"] == 0
         assert r["requests_ok"] > 0
@@ -104,23 +106,41 @@ def test_serve_bench_smoke(tmp_path):
     # test_serve's lone-request bound; here: the artifact records it)
     assert rows[0]["old_poll_quantum_ms"] == 50.0
     assert "p99_below_old_quantum" in rows[0]
-    # the HTTP open-loop row: every request answered, none dropped,
-    # silently timed out, or hung
-    http = rows[4]
-    assert http["ok"] > 0
-    assert http["dropped"] == 0 and http["hung_clients"] == 0
-    assert http["timed_out"] == 0
-    assert http["answered"] == http["ok"] + http["shed_429"] + \
-        http["shed_503"] + http["errors_other"]
-    assert http["errors_other"] == 0
+    # the open-loop rows, both transports: every request answered, none
+    # dropped, silently timed out, or hung
+    for row in (rows[4], rows[5]):
+        assert row["ok"] > 0
+        assert row["dropped"] == 0 and row["hung_clients"] == 0
+        assert row["timed_out"] == 0
+        assert row["answered"] == row["ok"] + row["shed_429"] + \
+            row["shed_503"] + row["errors_other"]
+        assert row["errors_other"] == 0
+    # the driver-cost A/B rows carry the accounting the headline gates on
+    for row in (rows[6], rows[7]):
+        assert row["requests"] > 0
+        assert row["dropped"] == 0 and row["hung_clients"] == 0
+        assert row["errors_other"] == 0
+        assert row["cpu_s_per_1k"] is not None
+    # identical tensors through both wires (same replica, same bucket)
+    assert rows[8]["bitwise_equal"] is True
+    # the streaming row: multi-MB blob, bounded per-connection buffering
+    stream = rows[9]
+    assert stream["blob_mb"] >= 2.0
+    assert stream["buffer_bounded_by_chunk"] is True
+    assert stream["first_byte_decoupled"] is True
+    assert stream["bitwise_equal_stream_vs_full"] is True
     # chaos: mid-traffic swap + drain with zero dropped/corrupted
-    chaos = rows[5]
+    chaos = rows[10]
     assert chaos["zero_dropped"] and chaos["swap_ok"]
     assert chaos["bad"] == 0
     art = json.load(open(tmp_path / "BENCH_SERVE.json"))
     assert art["headline"]["metric"] == "serve_saturated_batch_fill_ratio"
     assert art["headline"]["jit_cache_ok"] is True
     assert art["headline"]["http_zero_dropped"] is True
+    assert art["headline"]["binary_zero_dropped"] is True
+    assert art["headline"]["transport_parity_bitwise"] is True
+    assert art["headline"]["transport_ab"]["ab_zero_dropped"] is True
+    assert art["headline"]["stream"]["buffer_bounded_by_chunk"] is True
     assert art["headline"]["chaos_zero_dropped"] is True
     # the serve JSONL artifact landed for CI upload-on-failure
     assert (tmp_path / "keep" / "serve_bench.jsonl").exists()

@@ -1,0 +1,454 @@
+"""The binary data plane (serve/wire.py + serve/binary_frontend.py):
+length-prefixed frame roundtrips bitwise-identical to HTTP, keep-alive
+pipelining over one connection, flag-gated chunked response streaming
+with bounded per-connection buffering, typed error frames for every shed,
+malformed-wire robustness (oversized / truncated / bad magic / mid-stream
+disconnect each fail their OWN connection while the server keeps
+serving), per-tenant admission on the frame tenant field, and the
+router's remote replicas riding the binary transport.
+
+Tier-1: CPU backend, lenet shapes, ephemeral ports.
+"""
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.net_api import JaxNet
+from sparknet_tpu.model.spec import (InputSpec, LayerSpec, NetSpec,
+                                     PoolingParam)
+from sparknet_tpu.serve import (BinaryClient, BinaryFrontend,
+                                DeadlineExpiredError, HttpFrontend,
+                                InferenceServer, ModelRouter,
+                                NoReplicaError, RouterConfig,
+                                ServeConfig, TenantAdmission,
+                                TenantLimitError, UnknownModelError,
+                                binary_infer, http_infer, zeros_batch)
+from sparknet_tpu.serve import wire
+from sparknet_tpu.zoo import lenet
+
+
+def _example(i: int) -> dict:
+    r = np.random.default_rng(3000 + i)
+    return {"data": r.standard_normal((28, 28, 1)).astype(np.float32)}
+
+
+def blob_net(batch: int = 1, c: int = 8, hw: int = 256) -> JaxNet:
+    """A featurizer-shaped net whose per-example output is a multi-MB
+    blob (1x1 max-pool = identity): the streaming tests' food."""
+    spec = NetSpec(
+        name="blobber",
+        inputs=(InputSpec("data", (batch, c, hw, hw)),),
+        layers=(LayerSpec(name="feat", type="Pooling",
+                          bottoms=("data",), tops=("feat",),
+                          pool=PoolingParam(pool="MAX", kernel_size=1,
+                                            stride=1)),))
+    return JaxNet(spec)
+
+
+@pytest.fixture(scope="module")
+def net():
+    return JaxNet(lenet(batch=4))
+
+
+@pytest.fixture()
+def srv(net):
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      metrics_every_batches=0)
+    with InferenceServer(net, cfg) as s:
+        yield s
+
+
+# -- wire unit ----------------------------------------------------------------
+
+def test_wire_request_roundtrip():
+    r = np.random.default_rng(0)
+    payload = {"data": r.standard_normal((3, 4)).astype(np.float32),
+               "label": np.arange(2, dtype=np.int32)}
+    head, views = wire.pack_request(7, "m", payload, deadline_ms=125.0,
+                                    tenant="t1", stream=True)
+    buf = head + b"".join(bytes(v) for v in views)
+    ftype, flags, rid, meta_len, payload_len = wire.parse_header(buf)
+    assert (ftype, rid) == (wire.T_REQUEST, 7)
+    assert flags & wire.FLAG_STREAM
+    meta = buf[wire.HEADER_LEN:wire.HEADER_LEN + meta_len]
+    model, tenant, deadline_ms, descs = wire.unpack_request_meta(meta)
+    assert (model, tenant, deadline_ms) == ("m", "t1", 125.0)
+    out = wire.tensors_from(descs,
+                            buf[wire.HEADER_LEN + meta_len:])
+    assert set(out) == {"data", "label"}
+    np.testing.assert_array_equal(out["data"], payload["data"])
+    np.testing.assert_array_equal(out["label"], payload["label"])
+    assert out["data"].dtype == np.float32
+    assert out["label"].dtype == np.int32
+
+
+def test_wire_bad_magic_and_version_raise_typed():
+    head, _ = wire.pack_request(1, "m", {})
+    with pytest.raises(wire.WireError, match="magic"):
+        wire.parse_header(b"XXXX" + head[4:])
+    with pytest.raises(wire.WireError, match="version"):
+        wire.parse_header(head[:4] + bytes([99]) + head[5:])
+
+
+def test_wire_truncated_meta_raises_not_crashes():
+    with pytest.raises(wire.WireError, match="truncated"):
+        wire.unpack_request_meta(b"\x05ab")  # str8 claims 5, has 2
+
+
+def test_wire_streamed_response_chunks_cover_payload():
+    arrs = {"a": np.arange(1000, dtype=np.float32),
+            "b": np.arange(17, dtype=np.int32)}
+    items = wire.pack_response(9, "m", 3, arrs, stream=True,
+                               chunk_bytes=512)
+    head0, view0 = items[0]
+    ftype, flags, rid, meta_len, total = wire.parse_header(head0)
+    assert ftype == wire.T_RESPONSE and flags & wire.FLAG_STREAM
+    assert view0 is None and total == 4000 + 68
+    buf = bytearray(total)
+    saw_last = False
+    for head, view in items[1:]:
+        ftype, flags, rid, meta_len, plen = wire.parse_header(head)
+        assert ftype == wire.T_CHUNK and rid == 9
+        assert plen <= 512  # the bound the server promises
+        off = wire.unpack_chunk_meta(head[wire.HEADER_LEN:])
+        buf[off:off + plen] = bytes(view)
+        saw_last |= bool(flags & wire.FLAG_LAST)
+    assert saw_last
+    model, step, descs = wire.unpack_response_meta(
+        head0[wire.HEADER_LEN:])
+    out = wire.tensors_from(descs, bytes(buf))
+    np.testing.assert_array_equal(out["a"], arrs["a"])
+    np.testing.assert_array_equal(out["b"], arrs["b"])
+
+
+# -- transport roundtrip + parity --------------------------------------------
+
+def test_binary_bitwise_identical_to_http_same_bucket(net, srv):
+    """The parity pin: one request through BOTH wires hits the same
+    replica and the same bucket — the tensors must be BITWISE equal
+    (the transports carry raw f32 bytes; neither may perturb them)."""
+    bfe = BinaryFrontend(srv, port=0)
+    hfe = HttpFrontend(srv, port=0)
+    try:
+        x = _example(0)
+        out_b = binary_infer(bfe.address, "default", x, deadline_s=30.0)
+        out_h = http_infer(f"http://{hfe.address[0]}:{hfe.address[1]}",
+                           "default", x, deadline_s=30.0)
+        assert out_b["prob"].dtype == np.float32
+        np.testing.assert_array_equal(out_b["prob"], out_h["prob"])
+        # and against the direct forward at the same bucket
+        direct = net.forward({**zeros_batch(net, 1),
+                              "data": x["data"][None]},
+                             blob_names=["prob"])
+        np.testing.assert_array_equal(out_b["prob"],
+                                      np.asarray(direct["prob"][0]))
+    finally:
+        bfe.stop()
+        hfe.stop()
+
+
+def test_pipelined_burst_one_connection(net, srv):
+    """Eight requests submitted before any reply is read — all answered
+    on ONE connection (keep-alive + pipelining asserted via the server's
+    connection/request counters), every output correct."""
+    bfe = BinaryFrontend(srv, port=0)
+    cli = BinaryClient(*bfe.address)
+    try:
+        xs = [_example(i) for i in range(8)]
+        rids = [cli.submit(x, model="default", deadline_s=30.0)
+                for x in xs]
+        outs = [cli.collect(rid) for rid in rids]
+        direct = net.forward(
+            {**zeros_batch(net, 8),
+             "data": np.stack([x["data"] for x in xs])},
+            blob_names=["prob"])
+        for i, out in enumerate(outs):
+            np.testing.assert_allclose(out["prob"],
+                                       np.asarray(direct["prob"][i]),
+                                       rtol=1e-4, atol=1e-5)
+        assert bfe.connections == 1, "pipelining opened extra connections"
+        assert bfe.requests == 8
+    finally:
+        cli.close()
+        bfe.stop()
+
+
+def test_streaming_blob_bounded_buffering():
+    """A multi-MB featurizer-style response with FLAG_STREAM: the
+    reassembled tensors equal the non-streamed ones, and the server's
+    per-connection COPIED buffering stays bounded by the chunk size —
+    never the blob size (the npz door would buffer the whole blob)."""
+    net2 = blob_net(batch=1, c=8, hw=256)  # 2 MB/row
+    cfg = ServeConfig(model_name="featurizer", max_batch=1, buckets=(1,),
+                      max_wait_ms=1.0, outputs=("feat",),
+                      metrics_every_batches=0)
+    chunk = 128 << 10
+    with InferenceServer(net2, cfg) as s2:
+        bfe = BinaryFrontend(s2, port=0, chunk_bytes=chunk)
+        cli = BinaryClient(*bfe.address, timeout=60.0)
+        try:
+            from sparknet_tpu.serve.server import net_input_specs
+            shape, dt = net_input_specs(net2)["data"]
+            r = np.random.default_rng(1)
+            req = {"data": r.standard_normal(shape).astype(dt)}
+            full = cli.infer(req, model="featurizer", deadline_s=60.0)
+            streamed = cli.infer(req, model="featurizer",
+                                 deadline_s=60.0, stream=True)
+            assert streamed["feat"].nbytes > 1 << 20  # genuinely multi-MB
+            np.testing.assert_array_equal(streamed["feat"], full["feat"])
+            t = cli.last_timing
+            assert t["t_first_byte_s"] <= t["t_complete_s"]
+            # the bounded-buffer pin: only frame headers are ever copied
+            assert bfe.peak_buffered_bytes < chunk, (
+                f"per-connection buffering {bfe.peak_buffered_bytes} is "
+                f"not bounded by the chunk size {chunk}")
+        finally:
+            cli.close()
+            bfe.stop()
+
+
+# -- typed error frames -------------------------------------------------------
+
+def test_error_frames_map_to_typed_exceptions(srv):
+    bfe = BinaryFrontend(srv, port=0)
+    try:
+        # unknown model -> 404 frame -> UnknownModelError
+        with pytest.raises(UnknownModelError):
+            binary_infer(bfe.address, "nope", _example(0),
+                         deadline_s=30.0)
+        # already-expired deadline -> 503 deadline frame
+        with pytest.raises(DeadlineExpiredError):
+            binary_infer(bfe.address, "default", _example(0),
+                         deadline_s=-1.0)
+        # not a net input -> 400 frame -> ValueError, field named
+        with pytest.raises(ValueError, match="bogus"):
+            binary_infer(bfe.address, "default",
+                         {"bogus": np.zeros(3, np.float32)},
+                         deadline_s=30.0)
+        # the connection survived every typed shed (all on one socket)
+        assert bfe.connections == 1
+        out = binary_infer(bfe.address, "default", _example(1),
+                           deadline_s=30.0)
+        assert out["prob"].shape == (10,)
+        assert bfe.connections == 1
+    finally:
+        bfe.stop()
+
+
+# -- malformed-wire robustness ------------------------------------------------
+
+def _recv_frame(sock, timeout=10.0):
+    sock.settimeout(timeout)
+    buf = b""
+    while len(buf) < wire.HEADER_LEN:
+        d = sock.recv(4096)
+        if not d:
+            return None
+        buf += d
+    ftype, flags, rid, meta_len, plen = wire.parse_header(buf)
+    want = wire.HEADER_LEN + meta_len + plen
+    while len(buf) < want:
+        d = sock.recv(4096)
+        if not d:
+            return None
+        buf += d
+    return ftype, flags, rid, buf[wire.HEADER_LEN:
+                                  wire.HEADER_LEN + meta_len]
+
+
+def _serves_fine(bfe):
+    out = binary_infer(bfe.address, "default", _example(9),
+                       deadline_s=30.0)
+    assert out["prob"].shape == (10,)
+
+
+def test_bad_magic_answered_typed_then_closed(srv):
+    bfe = BinaryFrontend(srv, port=0)
+    try:
+        s = socket.create_connection(bfe.address, timeout=10)
+        s.sendall(b"JUNKJUNKJUNKJUNK" + b"\0" * 16)
+        ftype, flags, rid, meta = _recv_frame(s)
+        assert ftype == wire.T_ERROR and rid == 0
+        code, kind, msg = wire.unpack_error_meta(meta)
+        assert (code, kind) == (400, "bad_magic")
+        assert s.recv(4096) == b""  # server closed THIS connection
+        s.close()
+        _serves_fine(bfe)  # ...and only this one
+    finally:
+        bfe.stop()
+
+
+def test_bad_version_answered_typed(srv):
+    bfe = BinaryFrontend(srv, port=0)
+    try:
+        head, _ = wire.pack_request(1, "default", {})
+        s = socket.create_connection(bfe.address, timeout=10)
+        s.sendall(head[:4] + bytes([42]) + head[5:])
+        ftype, flags, rid, meta = _recv_frame(s)
+        code, kind, _ = wire.unpack_error_meta(meta)
+        assert ftype == wire.T_ERROR and (code, kind) == \
+            (400, "bad_version")
+        assert s.recv(4096) == b""
+        s.close()
+        _serves_fine(bfe)
+    finally:
+        bfe.stop()
+
+
+def test_oversized_frame_is_the_413_analog(srv):
+    """A frame whose announced size exceeds the cap: typed too_large
+    error frame carrying the REQUEST id, that connection alone closed,
+    server keeps serving."""
+    bfe = BinaryFrontend(srv, port=0, max_frame_bytes=1 << 20)
+    try:
+        hdr = wire.HEADER.pack(wire.MAGIC, wire.VERSION, wire.T_REQUEST,
+                               0, 77, 0, (1 << 20) + 1)
+        s = socket.create_connection(bfe.address, timeout=10)
+        s.sendall(hdr)
+        ftype, flags, rid, meta = _recv_frame(s)
+        assert ftype == wire.T_ERROR and rid == 77
+        code, kind, _ = wire.unpack_error_meta(meta)
+        assert (code, kind) == (413, "too_large")
+        assert s.recv(4096) == b""
+        s.close()
+        _serves_fine(bfe)
+    finally:
+        bfe.stop()
+
+
+def test_truncated_header_and_midstream_disconnect(srv):
+    """A client that dies mid-frame (10 header bytes) or mid-streamed-
+    reply costs the server nothing but that connection."""
+    bfe = BinaryFrontend(srv, port=0)
+    try:
+        # truncated header, then vanish
+        s = socket.create_connection(bfe.address, timeout=10)
+        head, _ = wire.pack_request(1, "default", _example(0))
+        s.sendall(head[:10])
+        s.close()
+        # full request submitted, client vanishes before reading the
+        # reply (the write path eats the reset, not the io thread)
+        s2 = socket.create_connection(bfe.address, timeout=10)
+        head2, views2 = wire.pack_request(2, "default", _example(1),
+                                          deadline_ms=30000.0,
+                                          stream=True)
+        s2.sendall(head2)
+        for v in views2:
+            s2.sendall(v)
+        s2.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                      struct.pack("ii", 1, 0))  # RST on close
+        s2.close()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                _serves_fine(bfe)
+                break
+            except ConnectionError:
+                time.sleep(0.05)
+        _serves_fine(bfe)
+        # every io thread is still alive
+        assert all(lp.is_alive() for lp in bfe._loops)
+    finally:
+        bfe.stop()
+
+
+def test_over_capacity_is_typed_no_replica_not_a_reset(srv):
+    """A connection past the cap gets the TYPED over_capacity frame —
+    delivered reliably (the server drains instead of closing into the
+    client's mid-send request, which would RST the answer away) and
+    mapped to NoReplicaError exactly as HTTP's 503 would be. The
+    under-cap connection keeps serving."""
+    bfe = BinaryFrontend(srv, port=0, max_connections=1)
+    try:
+        cli = BinaryClient(*bfe.address)
+        out = cli.infer(_example(0), model="default", deadline_s=30.0)
+        assert out["prob"].shape == (10,)
+        for i in range(3):  # reliably typed, not a coin-flip reset
+            with pytest.raises(NoReplicaError, match="capacity"):
+                binary_infer(bfe.address, "default", _example(i),
+                             deadline_s=10.0)
+        assert bfe.rejected_over_cap == 3
+        # the under-cap connection still serves
+        out = cli.infer(_example(1), model="default", deadline_s=30.0)
+        assert out["prob"].shape == (10,)
+        cli.close()
+    finally:
+        bfe.stop()
+
+
+# -- per-tenant admission -----------------------------------------------------
+
+def test_binary_tenant_field_shed_typed(srv):
+    """The frame tenant field feeds the same token buckets the HTTP
+    X-Tenant header does: a flood past the rate sheds typed
+    (tenant_limit, a QueueFullError subclass) and the shed counter
+    carries reason="tenant_limit"."""
+    bfe = BinaryFrontend(srv, port=0,
+                         tenants=TenantAdmission(rate_rps=5.0, burst=2))
+    try:
+        ok, shed = 0, 0
+        for i in range(10):
+            try:
+                binary_infer(bfe.address, "default", _example(i),
+                             deadline_s=30.0, tenant="hot")
+                ok += 1
+            except TenantLimitError:
+                shed += 1
+        assert ok >= 2 and shed > 0  # burst served, flood shed
+        c = srv.registry.counter("sparknet_serve_shed_total",
+                                 labels=("model", "reason"))
+        assert c.value(model="default", reason="tenant_limit") == shed
+    finally:
+        bfe.stop()
+
+
+# -- router integration -------------------------------------------------------
+
+def test_router_remote_replica_over_binary_transport(net):
+    """`add_remote_replica(..., "spkn://...")` proxies over the binary
+    wire: drain the local replica and traffic keeps flowing through the
+    remote router's BinaryFrontend, zero dropped."""
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      metrics_every_batches=0)
+    rb = ModelRouter(RouterConfig(workers=1))
+    rb.add_model("m", JaxNet(lenet(batch=4)), cfg=cfg)
+    ra = ModelRouter(RouterConfig(workers=1))
+    ra.add_model("m", net, cfg=cfg)
+    with rb:
+        fe_b = BinaryFrontend(rb, port=0)
+        with ra:
+            rep = ra.add_remote_replica(
+                "m", f"spkn://{fe_b.address[0]}:{fe_b.address[1]}")
+            assert rep.transport == "binary"
+            ra.infer("m", _example(0), timeout=30.0)  # local, compiles
+            ra.drain("m", "local:m")
+            outs = [ra.infer("m", _example(i), timeout=30.0)
+                    for i in range(5)]
+            for out in outs:
+                p = np.asarray(out["prob"])
+                assert p.shape == (10,) and np.isfinite(p).all()
+            routed = ra.registry.counter(
+                "sparknet_serve_routed_total",
+                labels=("model", "replica"))
+            assert routed.value(
+                model="m", replica=rep.name) >= 5
+            # the remote hop really rode the binary wire
+            assert fe_b.requests >= 5
+        fe_b.stop()
+
+
+def test_serve_cli_binary_port_demo(tmp_path, capsys):
+    """`sparknet-serve --binary-port 0 --demo`: the binary front door
+    starts alongside the server and shuts down cleanly."""
+    from sparknet_tpu.serve.app import main
+    main(["--model", "lenet", "--outputs", "prob", "--max-batch", "4",
+          "--binary-port", "0", "--tenant-rate", "1000",
+          "--demo", "4", "--workdir", str(tmp_path)])
+    import json
+    status = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert status["requests_ok"] == 4

@@ -442,6 +442,261 @@ def test_router_no_replica_is_503_shed(net):
             fe.stop()
 
 
+# -- connection hygiene -------------------------------------------------------
+
+def test_idle_keepalive_timeout_releases_connection(net):
+    """Thread-per-connection means an idle keep-alive connection pins an
+    OS thread: past idle_timeout_s the server must close it (and the
+    active-connections gauge must drop back), instead of holding it
+    forever."""
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      metrics_every_batches=0)
+    with InferenceServer(net, cfg) as srv:
+        fe = HttpFrontend(srv, port=0, idle_timeout_s=0.3)
+        try:
+            conn = http.client.HTTPConnection(*fe.address, timeout=10)
+            body = json.dumps(
+                {"inputs": {"data": _example(0)["data"].tolist()}}
+            ).encode()
+            resp, data = _post(conn, "/v1/infer", body)
+            assert resp.status == 200
+            gauge = srv.registry.gauge(
+                "sparknet_serve_http_connections_active",
+                labels=("transport",))
+            assert gauge.value(transport="http") == 1
+            # idle past the timeout: the server hangs up
+            deadline = time.monotonic() + 10
+            while gauge.value(transport="http") != 0 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert gauge.value(transport="http") == 0, (
+                "idle connection still pinning its thread")
+            conn.close()
+        finally:
+            fe.stop()
+
+
+def test_max_connections_cap_answers_503(net):
+    """Connections past the cap are ANSWERED 503 (error_kind
+    over_capacity) + Connection: close — not silently refused, and the
+    capped connections release immediately (the flood cannot pin
+    threads)."""
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      metrics_every_batches=0)
+    with InferenceServer(net, cfg) as srv:
+        fe = HttpFrontend(srv, port=0, max_connections=2,
+                          idle_timeout_s=30.0)
+        try:
+            body = json.dumps(
+                {"inputs": {"data": _example(0)["data"].tolist()}}
+            ).encode()
+            held = []
+            for i in range(2):  # occupy the cap with keep-alive conns
+                c = http.client.HTTPConnection(*fe.address, timeout=10)
+                resp, _ = _post(c, "/v1/infer", body)
+                assert resp.status == 200
+                held.append(c)
+            over = http.client.HTTPConnection(*fe.address, timeout=10)
+            resp, data = _post(over, "/v1/infer", body)
+            assert resp.status == 503
+            assert json.loads(data)["error_kind"] == "over_capacity"
+            assert resp.getheader("Connection") == "close"
+            assert resp.getheader("Retry-After") is not None
+            over.close()
+            assert fe.rejected_over_cap == 1
+            # the held connections still serve (cap != collapse)
+            resp, _ = _post(held[0], "/v1/infer", body)
+            assert resp.status == 200
+            for c in held:
+                c.close()
+        finally:
+            fe.stop()
+
+
+def test_mid_body_read_timeout_answers_408_and_closes(net):
+    """A client that stalls mid-body: the server's read times out, and
+    the reply must be a typed 408 that CLOSES the connection — the
+    unread body bytes have desynced the keep-alive stream, and leaving
+    it open would parse them as the next request line."""
+    import socket as socketlib
+
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      metrics_every_batches=0)
+    with InferenceServer(net, cfg) as srv:
+        fe = HttpFrontend(srv, port=0, idle_timeout_s=0.3)
+        try:
+            s = socketlib.create_connection(fe.address, timeout=10)
+            s.sendall(b"POST /v1/infer HTTP/1.1\r\nHost: x\r\n"
+                      b"Content-Type: application/json\r\n"
+                      b"Content-Length: 1000\r\n\r\n"
+                      b'{"inputs"')  # 991 bytes never arrive
+            data = b""
+            while True:  # server must answer then close (EOF)
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                data += chunk
+            s.close()
+            assert b" 408 " in data.split(b"\r\n")[0], data[:80]
+            assert b"request_timeout" in data
+            assert b"Connection: close" in data
+            # ...and the server is still serving new connections
+            conn = http.client.HTTPConnection(*fe.address, timeout=10)
+            body = json.dumps(
+                {"inputs": {"data": _example(0)["data"].tolist()}}
+            ).encode()
+            resp, _ = _post(conn, "/v1/infer", body)
+            assert resp.status == 200
+            conn.close()
+        finally:
+            fe.stop()
+
+
+# -- client cache hygiene -----------------------------------------------------
+
+class _MidReplyCloser(threading.Thread):
+    """A server that reads the request then closes MID-REPLY (announces
+    100 body bytes, sends 5) — the poisoned-stream regression food."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        import socket
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.address = self.sock.getsockname()
+        self.running = True
+
+    def run(self):
+        while self.running:
+            try:
+                c, _ = self.sock.accept()
+            except OSError:
+                return
+            try:
+                c.settimeout(5.0)
+                buf = b""
+                while b"\r\n\r\n" not in buf:
+                    buf += c.recv(4096)
+                c.sendall(b"HTTP/1.1 200 OK\r\n"
+                          b"Content-Type: application/x-npz\r\n"
+                          b"Content-Length: 100\r\n\r\nxxxxx")
+            except OSError:
+                pass
+            finally:
+                c.close()
+
+    def stop(self):
+        self.running = False
+        self.sock.close()
+
+
+def test_http_infer_evicts_cached_conn_on_mid_reply_close():
+    """A server that dies mid-reply must not leave a poisoned connection
+    in the thread cache: http_infer raises (after its one fresh-socket
+    retry) AND the cache holds nothing for that address — the next call
+    starts clean instead of desyncing on a half-read stream."""
+    from sparknet_tpu.serve.http_frontend import _conn_cache
+
+    srv = _MidReplyCloser()
+    srv.start()
+    try:
+        host, port = srv.address
+        with pytest.raises((ConnectionError, OSError)):
+            http_infer(f"http://{host}:{port}", "m", _example(0),
+                       timeout=5.0)
+        cache = getattr(_conn_cache, "conns", {})
+        assert (host, port) not in cache, (
+            "half-read connection left in the thread cache")
+    finally:
+        srv.stop()
+
+
+def test_http_infer_connection_cache_is_bounded():
+    """The per-thread keep-alive cache is LRU-bounded: sweeping many
+    addresses (a router proxying to a large fleet) must not accumulate
+    one socket per address forever."""
+    from sparknet_tpu.serve.http_frontend import (_conn_cache,
+                                                  _connection,
+                                                  MAX_CACHED_CONNECTIONS)
+
+    for p in range(20000, 20040):  # never connected: construction only
+        _connection("127.0.0.1", p, timeout=1.0)
+    cache = getattr(_conn_cache, "conns", {})
+    n = sum(1 for (h, p) in cache if 20000 <= p < 20040)
+    assert n <= MAX_CACHED_CONNECTIONS
+    # most-recently-used survives the sweep (LRU, not random)
+    assert ("127.0.0.1", 20039) in cache
+
+
+# -- per-tenant admission -----------------------------------------------------
+
+@pytest.mark.chaos
+def test_hot_tenant_cannot_starve_quiet_tenant(net):
+    """Token buckets AHEAD of the 429 path: a hot tenant flooding far
+    past its rate is shed typed (429 error_kind tenant_limit, counted
+    reason="tenant_limit") while a quiet tenant's paced requests ALL
+    serve — the hot flood never occupies the queue slots the quiet
+    tenant needs."""
+    from sparknet_tpu.serve import TenantAdmission, TenantLimitError
+
+    cfg = ServeConfig(max_batch=2, max_wait_ms=1.0, max_queue=4,
+                      outputs=("prob",), metrics_every_batches=0)
+    slow = SlowNet(net, 0.02)
+    with InferenceServer(slow, cfg) as srv:
+        srv.submit(_example(0)).result(timeout=30)  # compile outside
+        fe = HttpFrontend(srv, port=0,
+                          tenants=TenantAdmission(rate_rps=5.0,
+                                                  burst=2))
+        try:
+            url = f"http://{fe.address[0]}:{fe.address[1]}"
+            hot = {"ok": 0, "tenant_limit": 0, "queue_full": 0,
+                   "other": 0}
+            stop = threading.Event()
+
+            def hot_client():
+                while not stop.is_set():
+                    try:
+                        http_infer(url, "default", _example(1),
+                                   deadline_s=5.0, tenant="hot")
+                        hot["ok"] += 1
+                    except TenantLimitError:
+                        hot["tenant_limit"] += 1
+                    except QueueFullError:
+                        hot["queue_full"] += 1
+                    except Exception:
+                        hot["other"] += 1
+
+            ts = [threading.Thread(target=hot_client, daemon=True)
+                  for _ in range(2)]
+            for t in ts:
+                t.start()
+            try:
+                time.sleep(0.1)  # the flood is flowing
+                quiet_ok = 0
+                for i in range(6):
+                    out = http_infer(url, "default", _example(i),
+                                     deadline_s=10.0, tenant="quiet")
+                    assert np.asarray(out["prob"]).shape == (10,)
+                    quiet_ok += 1
+                    time.sleep(0.22)  # ~4 rps, under the 5 rps rate
+            finally:
+                stop.set()
+                for t in ts:
+                    t.join(timeout=30)
+            assert quiet_ok == 6, "a hot tenant starved the quiet one"
+            assert hot["tenant_limit"] > 0, (
+                "the flood was never shed by the tenant bucket")
+            c = srv.registry.counter("sparknet_serve_shed_total",
+                                     labels=("model", "reason"))
+            # registry count is exact; the client-side tally may lose
+            # racing += updates across the two hot threads
+            assert c.value(model="default",
+                           reason="tenant_limit") >= hot["tenant_limit"]
+        finally:
+            fe.stop()
+
+
 def test_serve_cli_router_demo(tmp_path, capsys):
     """`sparknet-serve --models a=lenet,b=lenet --demo` end to end: the
     router CLI self-drives requests across both lanes and prints the
