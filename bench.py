@@ -1341,6 +1341,506 @@ def serve_bench(out_path: str | None = "BENCH_SERVE.json",
     return {"headline": out, "rows": rows}
 
 
+def fleet_bench(out_path: str | None = "BENCH_FLEET.json",
+                duration_s: float = 2.0, max_batch: int = 8,
+                keep: str | None = None) -> dict:
+    """The r11 fleet-control-plane audit (writes BENCH_FLEET.json): the
+    FleetController closing the loop from serve signals to serve
+    actions, end to end through the REAL stack — ModelRouter + binary
+    front door + subprocess replicas (`sparknet-serve` children over
+    spkn://, sharing one persistent compile cache).
+
+    Arms:
+      - flood_grow: a step-load flood at ~4x measured capacity. The
+        controller must scale the fleet up (SLO burn / queue pressure,
+        audit-named), every request must be ANSWERED (typed 429/503
+        sheds; dropped == timed_out == hung == 0 is the hard gate), and
+        the tail p99 after the last grow is compared to the SLO. On the
+        CPU box extra REPLICA PROCESSES share the same cores, so
+        p99-re-enters-SLO is stamped structure_proof when it does not
+        hold here — the claim needs per-replica hardware (the pod).
+      - quiet_shrink: the flood stops, a closed-loop trickle continues.
+        The controller must give the grown replicas back (drain ->
+        grace -> retire, audit-named "quiet") with ZERO trickle errors
+        — the drain path's zero-dropped contract under the shrink.
+      - chaos_kill: min_replicas=2 brings a child up; mid-flood it is
+        kill -9'd. The heartbeat goes stale (fast beats + a tight
+        staleness rule), the router routes around it (conn-fail
+        demotion catches the window before staleness), and the
+        controller evicts it (reason="dead", replica NAMED in the
+        audit) and regrows (reason="replace"). Detection + replacement
+        times land in the row.
+      - priority_shed: a local-only router behind PriorityAdmission,
+        pressure driven by the controller from SLO burn
+        (pressure_start BELOW the objective: the door tightens before
+        the SLO is violated, not after). A sustainable high-priority
+        load runs alongside a low-priority flood at ~4x capacity:
+        low must shed TYPED (shed_total{reason="priority"} > 0, zero
+        for the high class) and the high tail p99 over the settled
+        second half must stay inside the SLO.
+
+    `keep`: directory to retain the fleet JSONL + replica logs in (CI
+    uploads them on failure)."""
+    import shutil
+    import signal
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from sparknet_tpu.fleet import (FleetConfig, FleetController,
+                                    FleetPolicy,
+                                    SubprocessReplicaProvider)
+    from sparknet_tpu.net_api import JaxNet
+    from sparknet_tpu.serve import (BinaryFrontend, DeadlineExpiredError,
+                                    ModelRouter, NoReplicaError,
+                                    PriorityAdmission, PriorityShedError,
+                                    QueueFullError, RouterConfig,
+                                    ServeConfig, TenantLimitError,
+                                    binary_infer)
+    from sparknet_tpu.utils.logger import Logger
+    from sparknet_tpu.zoo import lenet
+
+    model = "lenet"
+    slo_ms = 60.0
+    workdir = keep or tempfile.mkdtemp(prefix="fleet-bench-")
+    os.makedirs(workdir, exist_ok=True)
+    cache = os.path.join(workdir, "compile-cache")
+    logger = Logger(path=os.path.join(workdir, "fleet_bench.log"),
+                    echo=False,
+                    jsonl_path=os.path.join(workdir,
+                                            "fleet_bench.jsonl"))
+    rng = np.random.default_rng(0)
+    req = {"data": rng.standard_normal((28, 28, 1)).astype(np.float32)}
+
+    def lane_cfg() -> ServeConfig:
+        return ServeConfig(model_name=model, max_batch=max_batch,
+                           max_wait_ms=5.0, outputs=("prob",),
+                           slo_p99_ms=slo_ms, metrics_every_batches=0,
+                           compile_cache_dir=cache)
+
+    def router_cfg(workers: int = 2) -> RouterConfig:
+        # tight staleness + fast probe refresh: the chaos arm's
+        # heartbeat-health detection must land in seconds, not the
+        # 60 s pod default
+        return RouterConfig(workers=workers, stale_after_s=1.5,
+                            health_refresh_s=0.2,
+                            conn_fail_cooldown_s=2.0)
+
+    def provider() -> SubprocessReplicaProvider:
+        return SubprocessReplicaProvider(
+            {model: "lenet"}, workdir=os.path.join(workdir, "replicas"),
+            max_batch=max_batch, compile_cache_dir=cache,
+            heartbeat_every_s=0.3)
+
+    def calibrate(addr) -> float:
+        """Closed-loop single-client rps — the capacity yardstick the
+        flood rates scale from."""
+        for _ in range(3):
+            binary_infer(addr, model, req, deadline_s=30.0)
+        n, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < 1.0:
+            binary_infer(addr, model, req, deadline_s=30.0)
+            n += 1
+        return n / (time.perf_counter() - t0)
+
+    def open_load(addr, rps: float, secs: float,
+                  deadline_s: float = 0.25,
+                  priority: str | None = None,
+                  tenant: str | None = None):
+        """Open-loop senders over the binary wire; returns (counts,
+        [(t_done, dt)] for served requests, hung). Every shed must be
+        TYPED; connection errors are drops and fail the arm's gate."""
+        conns = int(min(32, max(4, rps // 25)))
+        counts = {"ok": 0, "shed_429": 0, "shed_503": 0,
+                  "shed_priority": 0, "dropped": 0, "timed_out": 0,
+                  "errors_other": 0}
+        lats: list = []
+        lock = threading.Lock()
+        t_start = time.perf_counter()
+        t_stop = t_start + secs
+        period = conns / rps
+
+        def sender(j):
+            t_next = t_start + (j / conns) * period
+            while True:
+                now = time.perf_counter()
+                if now >= t_stop:
+                    return
+                if now < t_next:
+                    time.sleep(min(t_next - now, t_stop - now))
+                    continue
+                t0 = time.perf_counter()
+                try:
+                    binary_infer(addr, model, req, deadline_s=deadline_s,
+                                 timeout=10.0, priority=priority,
+                                 tenant=tenant)
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        counts["ok"] += 1
+                        lats.append((time.perf_counter() - t_start, dt))
+                except PriorityShedError:
+                    with lock:
+                        counts["shed_priority"] += 1
+                except (TenantLimitError, QueueFullError):
+                    with lock:
+                        counts["shed_429"] += 1
+                except (DeadlineExpiredError, NoReplicaError):
+                    with lock:
+                        counts["shed_503"] += 1
+                except TimeoutError:
+                    with lock:
+                        counts["timed_out"] += 1
+                except ConnectionError:
+                    with lock:
+                        counts["dropped"] += 1
+                except Exception:
+                    with lock:
+                        counts["errors_other"] += 1
+                t_next += period
+                if t_next < time.perf_counter() - 5 * period:
+                    t_next = time.perf_counter()  # behind: shed schedule
+        ts = [threading.Thread(target=sender, args=(j,))
+              for j in range(conns)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=secs + 30.0)
+        hung = sum(t.is_alive() for t in ts)
+        return counts, lats, hung
+
+    def p99_ms(lats, t_from: float = 0.0):
+        xs = sorted(dt for t, dt in lats if t >= t_from)
+        if not xs:
+            return None
+        return round(xs[min(len(xs) - 1, int(0.99 * len(xs)))] * 1e3, 3)
+
+    rows = []
+
+    # -- arm 1+2: flood -> grow, quiet -> shrink ------------------------------
+    prov = provider()
+    router = ModelRouter(router_cfg(), logger=logger)
+    router.add_model(model, JaxNet(lenet(batch=max_batch)),
+                     cfg=lane_cfg())
+    fc = FleetController(
+        router, provider=prov,
+        cfg=FleetConfig(interval_s=0.25, window_s=6.0, min_replicas=1,
+                        max_replicas=3, up_cooldown_s=1.5,
+                        down_cooldown_s=1.5, drain_grace_s=1.5,
+                        dead_ticks=2, status_row_every=4,
+                        policy=FleetPolicy(up_ticks=2, down_ticks=6,
+                                           min_window_n=16)),
+        logger=logger)
+    with router:
+        bfe = BinaryFrontend(router, port=0, logger=logger)
+        try:
+            base_rps = calibrate(bfe.address)
+            flood_rps = min(300.0, max(60.0, 4.0 * base_rps))
+            flood_secs = max(10.0, 5.0 * duration_s)
+            fc.start()
+            counts, lats, hung = open_load(bfe.address, flood_rps,
+                                           flood_secs)
+            ups = [a for a in fc.audit if a["direction"] == "up"]
+            replicas_flood = len(router.replicas[model])
+            tail_from = 0.75 * flood_secs
+            p99_tail = p99_ms(lats, tail_from)
+            p99_head = p99_ms(lats, 0.0)
+            reentered = p99_tail is not None and p99_tail <= slo_ms
+            rows.append({
+                "load": "flood_grow", "offered_rps": round(flood_rps, 1),
+                "base_rps": round(base_rps, 1), "secs": flood_secs,
+                **counts, "hung_clients": hung,
+                "p99_ms": p99_head, "p99_tail_ms": p99_tail,
+                "slo_p99_ms": slo_ms,
+                "scale_up_events": len(ups),
+                "scale_up_reasons": sorted({a["reason"] for a in ups}),
+                "replicas_after_flood": replicas_flood,
+                "p99_reentered_slo": reentered,
+                # shared-core caveat: more replica PROCESSES on one CPU
+                # do not add capacity — the SLO-reentry number needs
+                # per-replica hardware
+                "structure_proof": not reentered,
+                "zero_dropped": (counts["dropped"] == 0
+                                 and counts["timed_out"] == 0
+                                 and hung == 0),
+            })
+
+            # quiet: closed-loop trickle while the controller shrinks.
+            # The budget covers: the 6 s latency window aging out the
+            # flood's tail, then per grown replica ~1.5 s of cold ticks
+            # + the down cooldown + the drain grace
+            shrink_secs = 30.0
+            trickle = {"ok": 0, "errors": 0}
+            stop_ev = threading.Event()
+
+            def trickler():
+                while not stop_ev.is_set():
+                    try:
+                        binary_infer(bfe.address, model, req,
+                                     deadline_s=5.0, timeout=10.0)
+                        trickle["ok"] += 1
+                    except Exception:
+                        trickle["errors"] += 1
+                    time.sleep(0.05)
+            tt = threading.Thread(target=trickler)
+            tt.start()
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < shrink_secs and \
+                    (len(router.replicas[model]) > 1
+                     or fc._owned.get(model)):
+                time.sleep(0.25)
+            stop_ev.set()
+            tt.join(timeout=15.0)
+            downs = [a for a in fc.audit if a["direction"] == "down"]
+            rows.append({
+                "load": "quiet_shrink",
+                "replicas_final": len(router.replicas[model]),
+                "owned_final": len(fc._owned.get(model, [])),
+                "scale_down_events": len(downs),
+                "scale_down_reasons": sorted({a["reason"]
+                                              for a in downs}),
+                "trickle_ok": trickle["ok"],
+                "trickle_errors": trickle["errors"],
+                "zero_dropped": trickle["errors"] == 0,
+                "scaled_down_to_min": len(router.replicas[model]) == 1,
+            })
+        finally:
+            fc.stop()
+            bfe.stop()
+    prov.stop()
+
+    # -- arm 3: kill -9 a replica mid-flood -----------------------------------
+    prov = provider()
+    router = ModelRouter(router_cfg(), logger=logger)
+    router.add_model(model, JaxNet(lenet(batch=max_batch)),
+                     cfg=lane_cfg())
+    fc = FleetController(
+        router, provider=prov,
+        cfg=FleetConfig(interval_s=0.25, window_s=6.0, min_replicas=2,
+                        max_replicas=3, up_cooldown_s=1.0,
+                        down_cooldown_s=30.0, drain_grace_s=1.0,
+                        dead_ticks=2,
+                        policy=FleetPolicy(up_ticks=2, down_ticks=20,
+                                           min_window_n=16)),
+        logger=logger)
+    with router:
+        bfe = BinaryFrontend(router, port=0, logger=logger)
+        try:
+            calibrate(bfe.address)
+            fc.start()
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 60 and \
+                    len(router.replicas[model]) < 2:
+                time.sleep(0.1)  # min_bound grow brings the child up
+            assert len(router.replicas[model]) == 2, \
+                "min_replicas=2 never grew a child"
+            victim_rep, victim_handle = fc._owned[model][0]
+            chaos = {"counts": None, "lats": None, "hung": None}
+
+            def flood():
+                chaos["counts"], chaos["lats"], chaos["hung"] = \
+                    open_load(bfe.address, 40.0, 12.0)
+            ft = threading.Thread(target=flood)
+            ft.start()
+            time.sleep(2.0)
+            victim_handle.meta["proc"].send_signal(signal.SIGKILL)
+            t_kill = time.monotonic()
+            hb_dead_s = routed_around_s = replaced_s = None
+            deadline = t_kill + 20.0
+            while time.monotonic() < deadline:
+                now = time.monotonic() - t_kill
+                if hb_dead_s is None:
+                    try:
+                        if not victim_rep.health_fn():
+                            hb_dead_s = round(now, 2)
+                    except Exception:
+                        hb_dead_s = round(now, 2)
+                if routed_around_s is None and \
+                        not router._replica_routable(victim_rep):
+                    routed_around_s = round(now, 2)
+                if any(a["reason"] == "replace" for a in fc.audit):
+                    replaced_s = round(now, 2)
+                    break
+                time.sleep(0.1)
+            ft.join(timeout=60.0)
+            if chaos["counts"] is None:
+                # fail NAMED, not with a TypeError off a None unpack —
+                # a hung load thread is exactly what this arm polices
+                raise RuntimeError(
+                    "chaos arm: the flood load thread never finished "
+                    "(senders hung past their join bound)")
+            dead_events = [a for a in fc.audit
+                           if a["reason"] == "dead"]
+            replace_events = [a for a in fc.audit
+                              if a["reason"] == "replace"]
+            counts = chaos["counts"]
+            rows.append({
+                "load": "chaos_kill",
+                **counts, "hung_clients": chaos["hung"],
+                "p99_ms": p99_ms(chaos["lats"]),
+                "heartbeat_dead_detect_s": hb_dead_s,
+                "routed_around_s": routed_around_s,
+                "replaced_s": replaced_s,
+                "dead_eviction_named": bool(
+                    dead_events
+                    and dead_events[0].get("replica")
+                    == victim_rep.name),
+                "evicted_replica": (dead_events[0].get("replica")
+                                    if dead_events else None),
+                "replaced": bool(replace_events),
+                "replicas_final": len(router.replicas[model]),
+                "answered": sum(counts[k] for k in
+                                ("ok", "shed_429", "shed_503",
+                                 "shed_priority")),
+            })
+        finally:
+            fc.stop()
+            bfe.stop()
+    prov.stop()
+
+    # -- arm 4: mixed priorities under overload -------------------------------
+    admission = PriorityAdmission()  # priority door; no tenant buckets
+    router = ModelRouter(router_cfg(), logger=logger)
+    router.add_model(model, JaxNet(lenet(batch=max_batch)),
+                     cfg=lane_cfg())
+    fc = FleetController(
+        router, provider=None,
+        cfg=FleetConfig(interval_s=0.2, window_s=3.0,
+                        # tighten BEFORE the objective: pressure ramps
+                        # from 60% of the SLO and saturates AT it
+                        policy=FleetPolicy(up_ticks=2, down_ticks=6,
+                                           min_window_n=16,
+                                           pressure_start=0.6,
+                                           pressure_full=1.0)),
+        admission=admission, logger=logger)
+    with router:
+        bfe = BinaryFrontend(router, port=0, logger=logger,
+                             tenants=admission)
+        try:
+            base_rps = calibrate(bfe.address)
+            high_rps = max(5.0, 0.3 * base_rps)
+            low_rps = min(300.0, max(40.0, 4.0 * base_rps))
+            secs = max(12.0, 6.0 * duration_s)
+            fc.start()
+            res = {}
+
+            def run_class(name, rps, prio):
+                res[name] = open_load(bfe.address, rps, secs,
+                                      priority=prio, tenant=name)
+            th = threading.Thread(target=run_class,
+                                  args=("high", high_rps, "high"))
+            tl = threading.Thread(target=run_class,
+                                  args=("low", low_rps, "low"))
+            th.start()
+            tl.start()
+            th.join(timeout=secs + 60.0)
+            tl.join(timeout=secs + 60.0)
+            if "high" not in res or "low" not in res:
+                raise RuntimeError(
+                    f"priority arm: a load class never finished "
+                    f"(got {sorted(res)}; senders hung past their "
+                    f"join bound)")
+            hc, hl, hh = res["high"]
+            lc, ll, lh = res["low"]
+            high_p99_tail = p99_ms(hl, secs / 2.0)
+            shed_ctr = router.registry.counter(
+                "sparknet_serve_shed_total",
+                labels=("model", "reason"))
+            prio_shed_metric = shed_ctr.value(model=model,
+                                              reason="priority") or 0
+            high_ok = (high_p99_tail is not None
+                       and high_p99_tail <= slo_ms)
+            rows.append({
+                "load": "priority_shed",
+                "high_rps": round(high_rps, 1),
+                "low_rps": round(low_rps, 1), "secs": secs,
+                "high": {**hc, "hung_clients": hh,
+                         "p99_ms": p99_ms(hl),
+                         "p99_tail_ms": high_p99_tail},
+                "low": {**lc, "hung_clients": lh,
+                        "p99_ms": p99_ms(ll)},
+                "slo_p99_ms": slo_ms,
+                "pressure_final": fc.pressure,
+                "low_shed_typed": lc["shed_priority"] > 0,
+                "shed_total_priority_metric": prio_shed_metric,
+                "high_never_priority_shed":
+                    hc["shed_priority"] == 0,
+                "high_p99_within_slo": high_ok,
+                # a single shared-core box runs clients AND server on
+                # the same cores; the SLO number is pod truth
+                "structure_proof": not high_ok,
+                "zero_dropped": (hc["dropped"] == 0
+                                 and hc["timed_out"] == 0
+                                 and lc["dropped"] == 0
+                                 and lc["timed_out"] == 0
+                                 and hh == 0 and lh == 0),
+            })
+        finally:
+            fc.stop()
+            bfe.stop()
+
+    logger.close()
+    if not keep:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    flood = rows[0]
+    shrink = rows[1]
+    chaos_row = next(r for r in rows if r["load"] == "chaos_kill")
+    prio = next(r for r in rows if r["load"] == "priority_shed")
+    out = {
+        "metric": "fleet_controller_closed_loop",
+        "value": flood["scale_up_events"],
+        "unit": "scale-up events under a 4x step-load flood "
+                "(>= 1 required; signals -> actions loop closed)",
+        "slo_p99_ms": slo_ms,
+        "flood": {k: flood[k] for k in
+                  ("offered_rps", "base_rps", "scale_up_events",
+                   "scale_up_reasons", "replicas_after_flood",
+                   "p99_ms", "p99_tail_ms", "p99_reentered_slo",
+                   "structure_proof", "zero_dropped")},
+        "shrink": {k: shrink[k] for k in
+                   ("replicas_final", "scale_down_events",
+                    "scale_down_reasons", "trickle_ok",
+                    "trickle_errors", "zero_dropped",
+                    "scaled_down_to_min")},
+        "chaos": {k: chaos_row[k] for k in
+                  ("heartbeat_dead_detect_s", "routed_around_s",
+                   "replaced_s", "dead_eviction_named",
+                   "evicted_replica", "replaced", "replicas_final",
+                   "answered", "dropped")},
+        "priority": {
+            "low_shed_typed": prio["low_shed_typed"],
+            "shed_total_priority_metric":
+                prio["shed_total_priority_metric"],
+            "high_never_priority_shed":
+                prio["high_never_priority_shed"],
+            "high_p99_tail_ms": prio["high"]["p99_tail_ms"],
+            "high_p99_within_slo": prio["high_p99_within_slo"],
+            "structure_proof": prio["structure_proof"],
+            "zero_dropped": prio["zero_dropped"],
+        },
+    }
+    # the structural gates (the CPU box proves these; rate/SLO numbers
+    # may stamp structure_proof per the standing caveat)
+    assert flood["scale_up_events"] >= 1, "flood never scaled up"
+    assert flood["zero_dropped"], f"flood dropped requests: {flood}"
+    assert shrink["scaled_down_to_min"], f"shrink incomplete: {shrink}"
+    assert shrink["zero_dropped"], f"shrink dropped requests: {shrink}"
+    assert chaos_row["dead_eviction_named"], \
+        f"dead replica not named in the audit: {chaos_row}"
+    assert chaos_row["replaced"], f"dead replica not replaced: {chaos_row}"
+    assert prio["low_shed_typed"], f"low priority never shed: {prio}"
+    assert prio["high_never_priority_shed"], \
+        f"high priority was admission-shed: {prio}"
+    if out_path:
+        from sparknet_tpu.obs import run_metadata
+        with open(out_path, "w") as f:
+            json.dump({"headline": out, "rows": rows,
+                       "meta": run_metadata()}, f, indent=1)
+    print(json.dumps(out))
+    return {"headline": out, "rows": rows}
+
+
 def econ_coldstart_child(cache_dir: str) -> None:
     """The --econ cold-start CHILD: a fresh process that builds a lenet
     server against `cache_dir` as its persistent compile cache, serves
@@ -2622,6 +3122,11 @@ def main() -> None:
                    "vs latency/throughput/batch-fill; writes BENCH_SERVE")
     p.add_argument("--serve-secs", type=float, default=2.0,
                    help="seconds per load level for --serve")
+    p.add_argument("--fleet", action="store_true",
+                   help="r11 fleet-control-plane audit: step-load flood "
+                   "-> replica scale-up, quiet shrink (zero-dropped "
+                   "drain), kill -9 replica replacement, mixed-priority "
+                   "overload with SLO-burn shedding; writes BENCH_FLEET")
     p.add_argument("--econ", action="store_true",
                    help="r9 inference-economics audit: quantized-vs-f32 "
                    "serve throughput + parity, cold-start with a warm "
@@ -2687,6 +3192,9 @@ def main() -> None:
                    max_batch=args.batch or 8, keep=args.keep)
     elif args.serve:
         serve_bench(duration_s=args.serve_secs,
+                    max_batch=args.batch or 8, keep=args.keep)
+    elif args.fleet:
+        fleet_bench(duration_s=args.serve_secs,
                     max_batch=args.batch or 8, keep=args.keep)
     elif args.obs:
         obs_bench()

@@ -1,0 +1,36 @@
+"""`sparknet_tpu.fleet` — the serve control plane: signal-driven replica
+autoscaling, priority-aware admission pressure, SLO-burn shedding.
+
+SparkNet shipped cluster provisioning inside the framework (the L7 EC2
+launcher); this package is our analog over the serve stack. It closes
+the loop from the signals the obs stack already exports (windowed p99
+vs SLO, queue depth, shed rate, replica heartbeat health) to actions on
+a `ModelRouter` fleet:
+
+  - `FleetController` (controller.py): the fixed-cadence loop — SLO
+    burn per model, admission pressure (the fast lever, into
+    `serve.admission.PriorityAdmission`), replica grow/retire and
+    shared-pool resize (the slow levers) under hysteresis, cooldowns,
+    and per-model min/max bounds; dead-replica replacement; the
+    scale-event audit trail behind `/fleet/status`.
+  - `FleetPolicy` / `ModelSignals` (policy.py): the pure decision
+    logic — thresholds, hysteresis shape, burn→pressure curve.
+  - `ReplicaProvider` (provider.py): where capacity comes from —
+    `SubprocessReplicaProvider` spawns real `sparknet-serve` children
+    over spkn:// (CPU truth: tests + `bench.py --fleet`);
+    `PodReplicaProvider` is the `tpu_pod_launch.sh`-protocol stub for
+    TPU VMs.
+
+Enable from the CLI with `sparknet-serve --models ... --autoscale`.
+"""
+from .controller import FleetConfig, FleetController
+from .policy import FleetPolicy, ModelSignals, slo_burn
+from .provider import (PodReplicaProvider, ReplicaHandle,
+                       ReplicaProvider, SubprocessReplicaProvider)
+
+__all__ = [
+    "FleetController", "FleetConfig",
+    "FleetPolicy", "ModelSignals", "slo_burn",
+    "ReplicaProvider", "ReplicaHandle",
+    "SubprocessReplicaProvider", "PodReplicaProvider",
+]

@@ -20,6 +20,11 @@ freshly written JSONL (the CI step: the tooling cannot rot against the
 live schema); `--selfcheck-workers 2` runs one per worker id and checks
 the POD view below; `--keep DIR` retains the artifacts for CI upload.
 
+**Fleet view**: when the records carry the fleet controller's rows
+(`event="fleet_scale"` + periodic `fleet_replicas` counts), the summary
+adds the scale-event audit trail and the per-model replica count over
+time — the post-hoc answer to "when did the fleet grow, and why".
+
 **Pod view**: when the merged records span >= 2 workers (the `worker`
 field every multi-host run stamps, falling back to one-file-per-worker
 input order), the summary adds a per-worker step-time breakdown table
@@ -121,7 +126,50 @@ def summarize(recs: List[Dict[str, Any]], tail: int = 10) -> Dict[str, Any]:
     serve = _serve_view(recs)
     if serve is not None:
         out["serve"] = serve
+    fleet = _fleet_view(recs)
+    if fleet is not None:
+        out["fleet"] = fleet
     return out
+
+
+def _fleet_view(recs: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """The fleet controller's record: the scale-event audit trail
+    (`event="fleet_scale"` rows — model, direction, reason, replica)
+    plus the per-model replica count OVER TIME (the periodic
+    `fleet_replicas` rows). None when the records carry no fleet rows."""
+    events = [r for r in recs if r.get("event") == "fleet_scale"]
+    series: Dict[str, List[Any]] = {}
+    pressures: List[float] = []
+    for r in recs:
+        if isinstance(r.get("fleet_replicas"), dict):
+            for m, n in r["fleet_replicas"].items():
+                series.setdefault(str(m), []).append(
+                    {"step": r.get("step"), "ts": r.get("ts"),
+                     "replicas": n})
+            if r.get("fleet_pressure") is not None:
+                pressures.append(float(r["fleet_pressure"]))
+    if not events and not series:
+        return None
+    models: Dict[str, Any] = {}
+    for m, rows in series.items():
+        counts = [row["replicas"] for row in rows]
+        models[m] = {"rows": len(rows), "replicas_first": counts[0],
+                     "replicas_max": max(counts),
+                     "replicas_last": counts[-1],
+                     "tail": rows[-10:]}
+    by_dir: Dict[str, int] = {}
+    for e in events:
+        key = f"{e.get('direction', '?')}/{e.get('reason', '?')}"
+        by_dir[key] = by_dir.get(key, 0) + 1
+    return {
+        "scale_events": len(events),
+        "events_by_kind": dict(sorted(by_dir.items())),
+        "audit": [{k: v for k, v in e.items()
+                   if k not in ("t", "ts", "event")}
+                  for e in events[-20:]],
+        "models": models,
+        "pressure_max": max(pressures) if pressures else None,
+    }
 
 
 def _serve_view(recs: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
@@ -307,6 +355,28 @@ def format_text(s: Dict[str, Any]) -> str:
             for sz, n in hist.items():
                 bar = "#" * max(1, round(24 * n / peak)) if peak else ""
                 lines.append(f"    batch size {sz:>4}  {n:>8}  {bar}")
+    fleet = s.get("fleet")
+    if fleet:
+        lines.append("")
+        lines.append(f"fleet view ({fleet['scale_events']} scale "
+                     f"events):")
+        for m, row in sorted(fleet["models"].items()):
+            lines.append(f"  model {m}: replicas "
+                         f"{row['replicas_first']} -> "
+                         f"{row['replicas_last']} "
+                         f"(max {row['replicas_max']}, over "
+                         f"{row['rows']} rows)")
+        if fleet["events_by_kind"]:
+            kinds = "  ".join(f"{k}={n}" for k, n
+                              in fleet["events_by_kind"].items())
+            lines.append(f"  events: {kinds}")
+        for e in fleet["audit"]:
+            rest = " ".join(f"{k}={v}" for k, v in e.items()
+                            if k not in ("model", "direction", "reason",
+                                         "step"))
+            lines.append(f"    {e.get('model', '?')}: "
+                         f"{e.get('direction', '?')} "
+                         f"({e.get('reason', '?')}) {rest}".rstrip())
     if s["event_trail"]:
         lines.append("")
         lines.append("health/event audit trail:")
@@ -365,7 +435,83 @@ def _selfcheck_jsonl(n_workers: int = 1,
             log.close()
         paths.append(jsonl)
     paths.append(_selfcheck_serve_jsonl(root))
+    paths.append(_selfcheck_fleet_jsonl(root))
     return paths
+
+
+def _selfcheck_fleet_jsonl(root: str) -> str:
+    """Run a tiny live ModelRouter under a FleetController with an
+    in-process replica provider, push a burning latency window through
+    the policy, and return the fleet JSONL it wrote — so the fleet view
+    (scale-event audit + replica-count-over-time) cannot rot against
+    the controller's live record schema without failing the
+    selfcheck."""
+    import os
+
+    from dataclasses import replace as dc_replace
+
+    import numpy as np
+
+    from ..fleet import (FleetConfig, FleetController, FleetPolicy,
+                         ReplicaHandle, ReplicaProvider)
+    from ..net_api import JaxNet
+    from ..serve import (BinaryFrontend, InferenceServer, ModelRouter,
+                         RouterConfig, ServeConfig)
+    from ..utils.logger import Logger
+    from ..zoo import lenet
+
+    jsonl = os.path.join(root, "selfcheck_fleet_metrics.jsonl")
+    log = Logger(os.path.join(root, "selfcheck_fleet_log.txt"),
+                 echo=False, jsonl_path=jsonl)
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, buckets=(1, 4),
+                      outputs=("prob",), slo_p99_ms=50.0,
+                      metrics_every_batches=0)
+
+    class InProcessProvider(ReplicaProvider):
+        def __init__(self):
+            self.spawned = []
+
+        def grow(self, model):
+            srv = InferenceServer(JaxNet(lenet(batch=4)),
+                                  dc_replace(cfg, model_name=model))
+            srv.start()
+            fe = BinaryFrontend(srv, port=0)
+            self.spawned.append((srv, fe))
+            return ReplicaHandle(
+                model, f"spkn://{fe.address[0]}:{fe.address[1]}")
+
+        def retire(self, handle):
+            pass
+
+        def stop(self):
+            for srv, fe in self.spawned:
+                fe.stop()
+                srv.stop()
+
+    provider = InProcessProvider()
+    router = ModelRouter(RouterConfig(workers=1), logger=log)
+    router.add_model("fleet_demo", JaxNet(lenet(batch=4)), cfg=cfg)
+    fc = FleetController(
+        router, provider=provider,
+        cfg=FleetConfig(interval_s=0.05, window_s=30.0, max_replicas=2,
+                        up_cooldown_s=0.0, status_row_every=1,
+                        policy=FleetPolicy(up_ticks=2, min_window_n=8)),
+        logger=log)
+    r = np.random.default_rng(0)
+    req = {"data": r.standard_normal((28, 28, 1)).astype(np.float32)}
+    try:
+        with router:
+            router.infer("fleet_demo", req, timeout=60.0)
+            for _ in range(32):  # a tail 4x over the 50 ms objective
+                router.latency["fleet_demo"].add(0.2)
+            fc.tick()
+            fc.tick()  # hysteresis satisfied -> grow + audit row
+            router.infer("fleet_demo", req, timeout=60.0)
+            fc.stop()
+    finally:
+        provider.stop()
+        log.close()
+    return jsonl
 
 
 def _selfcheck_serve_jsonl(root: str) -> str:
@@ -461,6 +607,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.selfcheck and not (s.get("serve") or {}).get("models"):
         print("selfcheck: serve run produced no request-size histogram "
               "(the --buckets-from input)", file=sys.stderr)
+        return 1
+    if args.selfcheck and not (s.get("fleet") or {}).get("scale_events"):
+        print("selfcheck: fleet run produced no scale-event audit "
+              "(the fleet view's input)", file=sys.stderr)
         return 1
     return 0
 

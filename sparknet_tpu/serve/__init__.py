@@ -32,13 +32,19 @@ makes it reachable:
     length-prefixed tensor frames (zero-parse `np.frombuffer` decode),
     request pipelining, and flag-gated chunked response streaming;
     `BinaryClient` / `binary_infer` are the matching clients.
-  - `TenantAdmission` (admission.py): per-tenant token buckets ahead of
-    the 429 path on both frontends (X-Tenant header / frame tenant
-    field) — one hot tenant cannot starve the rest.
+  - `TenantAdmission` / `PriorityAdmission` (admission.py): per-tenant
+    token buckets ahead of the 429 path on both frontends (X-Tenant
+    header / frame tenant field) — one hot tenant cannot starve the
+    rest; the priority-aware door adds request priority classes
+    (X-Priority / frame priority field), weighted tenant budgets, and
+    pressure-driven tightening — the fleet controller's fast lever
+    (`sparknet_tpu.fleet`).
   - `sparknet-serve` (app.py): the console entry point.
 """
 from ..model.quant import QuantConfig
-from .admission import TenantAdmission, TenantLimitError
+from .admission import (PRIORITIES, PriorityAdmission, PriorityShedError,
+                        TenantAdmission, TenantLimitError,
+                        parse_priority)
 from .batcher import (DeadlineExpiredError, DynamicBatcher,
                       QueueFullError, ServeRequest)
 from .binary_frontend import BinaryClient, BinaryFrontend, binary_infer
@@ -61,4 +67,6 @@ __all__ = [
     "HttpFrontend", "http_infer", "BackendAdapter",
     "BinaryFrontend", "BinaryClient", "binary_infer", "WireError",
     "TenantAdmission", "TenantLimitError",
+    "PriorityAdmission", "PriorityShedError", "PRIORITIES",
+    "parse_priority",
 ]

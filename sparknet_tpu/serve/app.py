@@ -12,12 +12,18 @@ through the full submit->batch->forward->depad path and prints the status
 JSON — the zero-infrastructure smoke ("does this model serve?") and what
 the tests exercise.
 
+`--autoscale` (with `--models`) additionally runs the fleet control
+plane (`sparknet_tpu.fleet`): SLO-burn-driven admission pressure plus
+replica grow/retire through the subprocess provider.
+
 Examples:
     sparknet-serve --model lenet --checkpoint-dir gs://bkt/run1/ck \
         --outputs prob --max-batch 32 --max-wait-ms 5 --http-port 8000 \
         --status-port 8080
     sparknet-serve --models mnist=lenet,cifar=cifar10_quick \
         --router-workers 4 --http-port 8000 --demo 16
+    sparknet-serve --models mnist=lenet --binary-port 9000 \
+        --slo-p99-ms 50 --autoscale --fleet-max 4 --tenant-rate 100
     sparknet-serve --model net.prototxt --weights w.caffemodel \
         --crop 227 --demo 64
     sparknet-serve --graph model.pb --weights w.npz --outputs fc7 --demo 8
@@ -108,6 +114,23 @@ def parse_models_arg(spec: str):
     return out
 
 
+def parse_weights_arg(spec: Optional[str]) -> dict:
+    """--tenant-weights 'tenant=weight[,...]' -> {tenant: float}."""
+    out = {}
+    for part in (spec or "").split(","):
+        if not part:
+            continue
+        name, sep, w = part.partition("=")
+        try:
+            out[name.strip()] = float(w)
+        except ValueError:
+            sep = ""
+        if not sep or not name:
+            raise SystemExit(f"--tenant-weights entry {part!r} is not "
+                             f"tenant=weight")
+    return out
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model", default="lenet",
@@ -193,6 +216,45 @@ def main(argv=None) -> None:
     p.add_argument("--tenant-burst", type=float, default=None,
                    help="per-tenant bucket depth for --tenant-rate "
                    "(default: 2x the rate)")
+    p.add_argument("--tenant-weights", default=None,
+                   metavar="T=W[,T=W...]",
+                   help="per-tenant budget weights for --tenant-rate "
+                   "(scales that tenant's rate AND burst; unnamed "
+                   "tenants get weight 1)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the fleet control plane (sparknet_tpu."
+                   "fleet): per-model SLO burn (windowed p99 vs "
+                   "--slo-p99-ms) + queue/shed pressure drive admission "
+                   "tightening (low priority sheds first), replica "
+                   "grow/retire through the subprocess provider, and "
+                   "shared-pool resizing. Requires --models (the "
+                   "controller acts on a ModelRouter)")
+    p.add_argument("--fleet-min", type=int, default=1,
+                   help="min replicas per model for --autoscale "
+                   "(local lane included; default 1)")
+    p.add_argument("--fleet-max", type=int, default=4,
+                   help="max replicas per model for --autoscale "
+                   "(default 4)")
+    p.add_argument("--fleet-interval", type=float, default=1.0,
+                   help="control-loop cadence seconds (default 1.0)")
+    p.add_argument("--fleet-window", type=float, default=30.0,
+                   help="sliding window seconds for the SLO-burn p99 "
+                   "(default 30)")
+    p.add_argument("--fleet-provider", default="subprocess",
+                   choices=("subprocess", "none"),
+                   help="where grown replicas come from: 'subprocess' "
+                   "spawns sparknet-serve children over spkn:// on "
+                   "this host; 'none' keeps only the admission + pool "
+                   "levers")
+    p.add_argument("--pool-max", type=int, default=None,
+                   help="with --autoscale: let the controller grow the "
+                   "router's shared worker pool up to this many "
+                   "threads (default: --router-workers, i.e. lever "
+                   "off)")
+    p.add_argument("--heartbeat-every", type=float, default=10.0,
+                   help="seconds between heartbeat writes for "
+                   "--heartbeat (fleet children beat fast so the "
+                   "staleness rule sees a kill promptly)")
     p.add_argument("--status-port", type=int, default=None,
                    help="serve /healthz and /metrics on this port "
                    "(0 = ephemeral)")
@@ -256,14 +318,28 @@ def main(argv=None) -> None:
 
     from ..obs import trace as obs_trace
 
+    if args.autoscale and not args.models:
+        p.error("--autoscale requires --models (the fleet controller "
+                "acts on a ModelRouter)")
+    if args.tenant_weights and not args.tenant_rate:
+        p.error("--tenant-weights requires --tenant-rate (weights "
+                "scale the per-tenant budget)")
+
+    # ONE admission door shared by both data planes (a tenant's budget
+    # is a property of the tenant, not of the wire it arrived on) AND
+    # by the fleet controller (its fast lever sets the pressure). The
+    # priority-aware door runs whenever tenant budgets or the
+    # controller ask for it.
+    tenants = None
+    if args.tenant_rate or args.autoscale:
+        from .admission import PriorityAdmission
+        tenants = PriorityAdmission(
+            args.tenant_rate, args.tenant_burst,
+            weights=parse_weights_arg(args.tenant_weights))
+
     def make_frontends(backend):
-        """The data planes the flags asked for: HTTP and/or binary,
-        sharing ONE per-tenant admission budget (a tenant's rate is a
-        property of the tenant, not of the wire it arrived on)."""
-        from .admission import TenantAdmission
+        """The data planes the flags asked for: HTTP and/or binary."""
         from .binary_frontend import BinaryFrontend
-        tenants = (TenantAdmission(args.tenant_rate, args.tenant_burst)
-                   if args.tenant_rate else None)
         fes = []
         if args.http_port is not None:
             fes.append(HttpFrontend(backend, args.http_port,
@@ -276,14 +352,36 @@ def main(argv=None) -> None:
                                       tenants=tenants, logger=log))
         return fes
 
+    def make_fleet(router, sources):
+        """The --autoscale control plane over the router."""
+        from ..fleet import (FleetConfig, FleetController,
+                             SubprocessReplicaProvider)
+        provider = None
+        if args.fleet_provider == "subprocess":
+            provider = SubprocessReplicaProvider(
+                dict(sources), max_batch=args.max_batch,
+                outputs=outputs or ("prob",),
+                compile_cache_dir=args.compile_cache)
+        cfg = FleetConfig(interval_s=args.fleet_interval,
+                          window_s=args.fleet_window,
+                          min_replicas=args.fleet_min,
+                          max_replicas=args.fleet_max,
+                          pool_max=args.pool_max,
+                          slo_p99_ms=args.slo_p99_ms)
+        return FleetController(router, provider=provider, cfg=cfg,
+                               admission=tenants, logger=log)
+
     with obs_trace.tracing(args.trace_out) if args.trace_out \
             else contextlib.nullcontext():
         if args.models:
             router = ModelRouter(
                 RouterConfig(workers=args.router_workers,
                              status_port=args.status_port,
-                             heartbeat_path=args.heartbeat), logger=log)
-            for name, src in parse_models_arg(args.models):
+                             heartbeat_path=args.heartbeat,
+                             heartbeat_every_s=args.heartbeat_every),
+                logger=log)
+            sources = parse_models_arg(args.models)
+            for name, src in sources:
                 ck = (args.checkpoint_dir.format(model=name)
                       if args.checkpoint_dir else None)
                 router.add_model(
@@ -291,13 +389,19 @@ def main(argv=None) -> None:
                     build_net(src, None, None, args.max_batch,
                               args.n_classes, args.crop),
                     cfg=lane_cfg(name, ck))
+            fleet = make_fleet(router, sources) if args.autoscale \
+                else None
             with router:
                 frontends = make_frontends(router)
+                if fleet is not None:
+                    fleet.start()
                 try:
                     _serve_until_done(router.status, args, log,
                                       run_fn=lambda:
                                       run_router_demo(router, args.demo))
                 finally:
+                    if fleet is not None:
+                        fleet.stop()
                     for fe in frontends:
                         fe.stop()
             return
@@ -307,6 +411,7 @@ def main(argv=None) -> None:
         cfg = lane_cfg(args.model_name, args.checkpoint_dir)
         cfg.status_port = args.status_port
         cfg.heartbeat_path = args.heartbeat
+        cfg.heartbeat_every_s = args.heartbeat_every
         server = InferenceServer(net, cfg, logger=log)
         with server:
             frontends = make_frontends(server)

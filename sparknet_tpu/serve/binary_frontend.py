@@ -50,7 +50,8 @@ import numpy as np
 
 from ..utils.logger import Logger
 from . import wire
-from .admission import TenantAdmission, TenantLimitError
+from .admission import (PriorityShedError, TenantAdmission,
+                        TenantLimitError)
 from .batcher import DeadlineExpiredError, QueueFullError
 from .http_frontend import (BackendAdapter, lru_cache_drop,
                             lru_cache_get, register_transport_metrics)
@@ -64,6 +65,8 @@ def _exception_to_err(e: BaseException) -> Tuple[Tuple[int, str], str]:
     mapping the HTTP frontend's except-ladder implements."""
     if isinstance(e, TenantLimitError):
         return wire.ERR_TENANT_LIMIT, str(e)
+    if isinstance(e, PriorityShedError):
+        return wire.ERR_PRIORITY, str(e)
     if isinstance(e, QueueFullError):
         return wire.ERR_QUEUE_FULL, str(e)
     if isinstance(e, DeadlineExpiredError):
@@ -86,6 +89,8 @@ def raise_for_error(code: int, kind: str, msg: str) -> None:
         raise wire.WireError(f"server rejected the frame: {kind}: {msg}")
     if kind == "tenant_limit":
         raise TenantLimitError(msg)
+    if kind == "priority":
+        raise PriorityShedError(msg)
     if code == 429:
         raise QueueFullError(msg)
     if kind == "deadline":
@@ -470,18 +475,24 @@ class BinaryFrontend:
                 f"connection")
             return
         try:
-            model_s, tenant, deadline_ms, descs = \
+            model_s, tenant, priority, deadline_ms, descs = \
                 wire.unpack_request_meta(meta)
             # admission runs BEFORE tensor decode / model resolution
             # (the HTTP rule): a shed tenant's flood must not buy
             # io-thread decode time, and a malformed request still
             # spends its tenant's token
-            if self.tenants is not None and \
-                    not self.tenants.allow(tenant or None):
-                self._c_shed.inc(model=model_s or "",
-                                 reason="tenant_limit")
-                self._answer_error(conn, req_id, wire.ERR_TENANT_LIMIT,
-                                   "tenant rate limit exceeded")
+            reason = (self.tenants.admit(tenant or None,
+                                         priority or None)
+                      if self.tenants is not None else None)
+            if reason is not None:
+                self._c_shed.inc(model=model_s or "", reason=reason)
+                self._answer_error(
+                    conn, req_id,
+                    wire.ERR_TENANT_LIMIT if reason == "tenant_limit"
+                    else wire.ERR_PRIORITY,
+                    "tenant rate limit exceeded"
+                    if reason == "tenant_limit" else
+                    "shed by priority class under admission pressure")
                 return
             inputs = wire.tensors_from(descs, payload)
             model = self.adapter.resolve(model_s or None)
@@ -619,12 +630,13 @@ class BinaryClient:
     def submit(self, payload: Dict[str, np.ndarray],
                model: str = "", deadline_s: Optional[float] = None,
                tenant: Optional[str] = None,
+               priority: Optional[str] = None,
                stream: bool = False) -> int:
         rid = next(self._ids)
         head, views = wire.pack_request(
             rid, model, {k: np.asarray(v) for k, v in payload.items()},
             deadline_ms=None if deadline_s is None else deadline_s * 1e3,
-            tenant=tenant, stream=stream)
+            tenant=tenant, priority=priority, stream=stream)
         self._pending[rid] = {"t_submit": time.perf_counter(),
                               "t_first": None, "done": False,
                               "outputs": None, "exc": None,
@@ -751,10 +763,12 @@ class BinaryClient:
 
     def infer(self, payload: Dict[str, np.ndarray], model: str = "",
               deadline_s: Optional[float] = None,
-              tenant: Optional[str] = None, stream: bool = False,
+              tenant: Optional[str] = None,
+              priority: Optional[str] = None, stream: bool = False,
               timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
         rid = self.submit(payload, model=model, deadline_s=deadline_s,
-                          tenant=tenant, stream=stream)
+                          tenant=tenant, priority=priority,
+                          stream=stream)
         return self.collect(rid, timeout=timeout)
 
 
@@ -782,6 +796,7 @@ def binary_infer(address, model: str,
                  deadline_s: Optional[float] = None,
                  timeout: float = 30.0,
                  tenant: Optional[str] = None,
+                 priority: Optional[str] = None,
                  stream: bool = False) -> Dict[str, np.ndarray]:
     """One inference request over the binary transport (thread-cached
     keep-alive client — the `http_infer` counterpart the router's
@@ -794,8 +809,8 @@ def binary_infer(address, model: str,
         cli = _cached_client(host, port, timeout)
         try:
             return cli.infer(payload, model=model, deadline_s=deadline_s,
-                             tenant=tenant, stream=stream,
-                             timeout=timeout)
+                             tenant=tenant, priority=priority,
+                             stream=stream, timeout=timeout)
         except (TenantLimitError, QueueFullError, DeadlineExpiredError,
                 NoReplicaError, UnknownModelError, ValueError):
             # typed sheds arrived ON the stream, which is usually still

@@ -29,10 +29,13 @@ Error codes (every shed is ANSWERED — a client never hangs):
   408  socket timed out mid-body-read (the stream is desynced — the
        reply closes the connection)
   413  body over the size cap
-  429  queue at capacity (QueueFullError backpressure) or the tenant's
+  429  queue at capacity (QueueFullError backpressure), the tenant's
        token bucket is empty (error_kind "tenant_limit" — per-tenant
-       admission via the X-Tenant header, serve/admission.py) +
-       Retry-After
+       admission via the X-Tenant header, serve/admission.py), or the
+       request's priority class (X-Priority: high|normal|low) is below
+       the admission-pressure cutoff the fleet controller set
+       (error_kind "priority" — low sheds first under SLO burn) — all
+       + Retry-After
   503  request shed: client deadline expired before a forward
        (DeadlineExpiredError), no routable replica (NoReplicaError),
        response-wait timeout, or the server is at its connection cap
@@ -83,7 +86,8 @@ from urllib.parse import urlsplit
 import numpy as np
 
 from ..utils.logger import Logger
-from .admission import TenantAdmission, TenantLimitError
+from .admission import (PriorityShedError, TenantAdmission,
+                        TenantLimitError)
 from .batcher import DeadlineExpiredError, QueueFullError
 from .router import ModelRouter, NoReplicaError, UnknownModelError
 from .server import InferenceServer, net_input_specs
@@ -369,24 +373,31 @@ class HttpFrontend:
                                      "error_kind": "bad_request"},
                             close=True)
                 return
-            if self.tenants is not None and \
-                    not self.tenants.allow(h.headers.get("X-Tenant")):
+            reason = (self.tenants.admit(h.headers.get("X-Tenant"),
+                                         h.headers.get("X-Priority"))
+                      if self.tenants is not None else None)
+            if reason is not None:
                 # shed the flood before DECODING or touching a queue
-                # slot. A small body is drained so keep-alive survives
-                # the 429; past the threshold we close instead — a
-                # tenant flooding huge bodies must not buy full-body
-                # socket reads on pinned accept threads either
+                # slot ("tenant_limit" = this tenant's bucket is empty;
+                # "priority" = the fleet controller tightened the door
+                # and this class is below the cutoff). A small body is
+                # drained so keep-alive survives the 429; past the
+                # threshold we close instead — a tenant flooding huge
+                # bodies must not buy full-body socket reads on pinned
+                # accept threads either
                 drain = length <= TENANT_SHED_DRAIN_BYTES
                 if drain:
                     self._read_body(h, length)
                 # label with the model the CLIENT named; a default-route
                 # request belongs to "" (blaming the alphabetically
                 # first model would misattribute tenant floods)
-                self._c_shed.inc(model=model or "",
-                                 reason="tenant_limit")
+                self._c_shed.inc(model=model or "", reason=reason)
                 self._reply(h, 429, {
-                    "error": "tenant rate limit exceeded",
-                    "error_kind": "tenant_limit"}, retry_after=True,
+                    "error": ("tenant rate limit exceeded"
+                              if reason == "tenant_limit" else
+                              "shed by priority class under admission "
+                              "pressure"),
+                    "error_kind": reason}, retry_after=True,
                     close=not drain)
                 return
             body = self._read_body(h, length)
@@ -425,6 +436,10 @@ class HttpFrontend:
         except UnknownModelError as e:
             self._reply(h, 404, {"error": str(e),
                                  "error_kind": "unknown_model"})
+        except PriorityShedError as e:
+            self._reply(h, 429, {"error": str(e),
+                                 "error_kind": "priority"},
+                        retry_after=True)
         except TenantLimitError as e:
             self._reply(h, 429, {"error": str(e),
                                  "error_kind": "tenant_limit"},
@@ -630,7 +645,8 @@ def http_infer(base_url: str, model: str,
                payload: Dict[str, np.ndarray],
                deadline_s: Optional[float] = None,
                timeout: float = 30.0,
-               tenant: Optional[str] = None) -> Dict[str, np.ndarray]:
+               tenant: Optional[str] = None,
+               priority: Optional[str] = None) -> Dict[str, np.ndarray]:
     """POST one inference request (npz wire format, keep-alive) and
     return the output arrays. Maps the frontend's shed codes back to the
     serve exceptions, so a remote replica behaves like a local lane.
@@ -649,6 +665,8 @@ def http_infer(base_url: str, model: str,
         headers["X-Deadline-Ms"] = f"{deadline_s * 1e3:.3f}"
     if tenant is not None:
         headers["X-Tenant"] = tenant
+    if priority is not None:
+        headers["X-Priority"] = priority
     body = _encode_npz(payload)
     for attempt in (0, 1):
         conn = _connection(host, port, timeout)
@@ -688,6 +706,8 @@ def http_infer(base_url: str, model: str,
     kind, msg = err.get("error_kind"), err.get("error", "")
     if resp.status == 429 and kind == "tenant_limit":
         raise TenantLimitError(msg)
+    if resp.status == 429 and kind == "priority":
+        raise PriorityShedError(msg)
     if resp.status == 429:
         raise QueueFullError(msg)
     if resp.status == 503 and kind == "deadline":

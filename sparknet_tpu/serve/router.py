@@ -29,7 +29,6 @@ than refuse), then `NoReplicaError` — which the HTTP frontend maps to
 """
 from __future__ import annotations
 
-import itertools
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -39,6 +38,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..obs import MetricsRegistry, StatusServer, register_build_info
 from ..utils.heartbeat import HeartbeatWriter, read_heartbeat, staleness_s
 from ..utils.logger import Logger
+from ..utils.metrics import LatencyStats
 from .server import InferenceServer, ServeConfig
 
 
@@ -94,6 +94,18 @@ class Replica:
         self.transport = transport
         self.health_fn = health_fn
         self._draining = False
+        self._fail_t = 0.0  # monotonic time of the last transport error
+
+    def note_failure(self) -> None:
+        """A proxy hop to this replica just failed at the transport
+        level (connection refused/reset). The router demotes it for
+        `conn_fail_cooldown_s` — faster than the heartbeat can go
+        stale — so a just-died replica stops eating round-robin turns
+        within one failed request, not one staleness window."""
+        self._fail_t = time.monotonic()
+
+    def recently_failed(self, cooldown_s: float) -> bool:
+        return (time.monotonic() - self._fail_t) < cooldown_s
 
     @property
     def draining(self) -> bool:
@@ -120,13 +132,22 @@ class RouterConfig:
     """Knobs for the multi-model router (the `sparknet-serve --models`
     CLI mirrors these)."""
 
-    workers: int = 2                    # shared pool threads
+    workers: int = 2                    # shared pool threads (initial;
+    #                                     set_pool_size resizes live)
     # a replica that just REJECTED a checkpoint swap is deprioritized
     # for this long (its peers absorb the load while it settles)
     swap_cooldown_s: float = 3.0
     # staleness rule for remote-replica heartbeats (the same threshold
     # the pod aggregator and elastic controller use)
     stale_after_s: float = 60.0
+    # heartbeat probe read-cache (heartbeat_health min_refresh_s): a
+    # busy router must not hammer the file/bucket per request, but the
+    # fleet tests/controller need sub-second demotion
+    health_refresh_s: float = 1.0
+    # a replica whose proxy hop just FAILED at the transport level is
+    # demoted for this long (note_failure): the fast complement of the
+    # heartbeat staleness rule
+    conn_fail_cooldown_s: float = 1.0
     # observability (shared across all lanes)
     status_port: Optional[int] = None   # None = no HTTP; 0 = ephemeral
     status_host: str = "127.0.0.1"
@@ -147,16 +168,35 @@ class ModelRouter:
         register_build_info(self.registry)
         self.lanes: Dict[str, InferenceServer] = {}
         self.replicas: Dict[str, List[Replica]] = {}
-        self._rr: Dict[str, Any] = {}           # round-robin counters
+        # round-robin state: index (into the FULL replica list) of the
+        # last replica picked, per model. _pick scans forward from it,
+        # skipping unroutable replicas — so a drained-then-undrained
+        # replica deterministically re-enters the rotation at its own
+        # position and resumes its fair share (a count-modulo over the
+        # FILTERED list could park on a parity that starves a flapping
+        # replica forever; tests pin both properties)
+        self._rr: Dict[str, int] = {}
+        self._rr_lock = threading.Lock()
         self._order: List[str] = []             # lane rotation order
         self._rot = 0
         self._wakeup = threading.Condition()
-        self._pool: List[threading.Thread] = []
+        # shared worker pool, resizable live (the fleet controller's
+        # in-process lever): thread idx -> thread; a thread retires when
+        # its idx >= _pool_target
+        self._pool: Dict[int, threading.Thread] = {}
+        self._pool_target = 0
+        self._pool_lock = threading.Lock()
+        # per-model END-TO-END latency from the router's vantage (submit
+        # -> future resolution, local lane or remote proxy alike): the
+        # fleet controller's SLO-burn signal must cover whichever
+        # replica served, not just the local lane's forwards
+        self.latency: Dict[str, LatencyStats] = {}
         # remote proxying must not block router callers: a small executor
         # carries the HTTP round-trips (bounded by pool size + margin)
         self._proxy: Optional[ThreadPoolExecutor] = None
         self._running = False
         self._http = None
+        self.fleet = None  # FleetController attaches here (attach_fleet)
         self.heartbeat = (HeartbeatWriter(cfg.heartbeat_path, role="serve",
                                           interval_s=cfg.heartbeat_every_s,
                                           registry=self.registry)
@@ -172,6 +212,14 @@ class ModelRouter:
             "sparknet_serve_replica_healthy",
             "1 = replica currently routable (not draining/stale/cooling)",
             labels=("model", "replica"))
+        self._c_failovers = self.registry.counter(
+            "sparknet_serve_replica_failovers_total",
+            "proxy hops that failed at the transport level and were "
+            "retried on another replica", labels=("model", "replica"))
+        self.registry.gauge(
+            "sparknet_serve_pool_workers",
+            "live shared-pool worker threads (set_pool_size resizes)"
+        ).set_fn(self.pool_size)
 
     # -- assembly ------------------------------------------------------------
 
@@ -192,8 +240,17 @@ class ModelRouter:
         self._order.append(name)
         self.replicas.setdefault(name, []).append(
             Replica(f"local:{name}", lane=lane))
-        self._rr[name] = itertools.count()
+        self._rr.setdefault(name, -1)
+        self._ensure_latency(name)
         return lane
+
+    def _ensure_latency(self, model: str) -> LatencyStats:
+        if model not in self.latency:
+            self.latency[model] = LatencyStats(
+                registry=self.registry,
+                name="sparknet_serve_routed_latency_seconds",
+                model=model)
+        return self.latency[model]
 
     def add_remote_replica(self, model: str, url: str,
                            health_fn: Optional[Callable[[], bool]] = None,
@@ -208,14 +265,35 @@ class ModelRouter:
         neither, the replica is trusted until drained."""
         if health_fn is None and heartbeat_path is not None:
             health_fn = heartbeat_health(heartbeat_path,
-                                         self.cfg.stale_after_s)
+                                         self.cfg.stale_after_s,
+                                         self.cfg.health_refresh_s)
         if transport is None:
             transport = "binary" if url.startswith("spkn://") else "http"
         rep = Replica(f"remote:{url}", url=url, health_fn=health_fn,
                       transport=transport)
         self.replicas.setdefault(model, []).append(rep)
-        self._rr.setdefault(model, itertools.count())
+        self._rr.setdefault(model, -1)
+        self._ensure_latency(model)
         return rep
+
+    def remove_replica(self, model: str, replica: str) -> Replica:
+        """Unregister a replica (by name or url) — the fleet
+        controller's retire step, AFTER a drain has gated new routing
+        and the grace window let in-flight work finish. Raises
+        UnknownModelError when nothing matches."""
+        reps = self.replicas.get(model, [])
+        for i, r in enumerate(reps):
+            if r.name == replica or r.url == replica:
+                if r.lane is not None:
+                    raise ValueError(
+                        f"{model}/{r.name}: the local lane cannot be "
+                        f"removed (drain it instead)")
+                del reps[i]
+                if self.log is not None:
+                    self.log.log(f"serve: removed replica "
+                                 f"{model}/{r.name}")
+                return r
+        raise UnknownModelError(f"{model}/{replica}")
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -228,17 +306,51 @@ class ModelRouter:
         self._proxy = ThreadPoolExecutor(
             max_workers=max(4, 2 * self.cfg.workers),
             thread_name_prefix="serve-proxy")
-        self._pool = [threading.Thread(target=self._pool_run,
-                                       name=f"serve-pool-{i}", daemon=True)
-                      for i in range(self.cfg.workers)]
-        for t in self._pool:
-            t.start()
+        self.set_pool_size(self.cfg.workers)
         if self.cfg.status_port is not None:
             self._http = StatusServer(
                 self.cfg.status_port, self.registry,
                 host=self.cfg.status_host,
-                healthz=self._healthz, status=self.status)
+                healthz=self._healthz, status=self.status,
+                routes={"/fleet/status": self._fleet_status})
         return self
+
+    def attach_fleet(self, controller) -> None:
+        """Bind a FleetController: /fleet/status starts answering with
+        its view (the route itself is always registered)."""
+        self.fleet = controller
+
+    def _fleet_status(self) -> Dict[str, Any]:
+        if self.fleet is None:
+            return {"enabled": False}
+        return self.fleet.status()
+
+    # -- pool sizing (the fleet controller's in-process lever) ---------------
+
+    def pool_size(self) -> int:
+        return sum(t.is_alive() for t in self._pool.values())
+
+    def set_pool_size(self, n: int) -> int:
+        """Resize the shared worker pool LIVE, within [1, ...]. Growth
+        spawns threads immediately; shrink is cooperative — a thread
+        whose idx falls past the target retires at its next sweep (mid-
+        forward work always completes; a shrink never drops a batch).
+        Returns the new target."""
+        n = max(1, int(n))
+        with self._pool_lock:
+            self._pool_target = n
+            if self._running:
+                for i in range(n):
+                    t = self._pool.get(i)
+                    if t is None or not t.is_alive():
+                        t = threading.Thread(target=self._pool_run,
+                                             args=(i,),
+                                             name=f"serve-pool-{i}",
+                                             daemon=True)
+                        self._pool[i] = t
+                        t.start()
+        self._wake()  # retiring threads notice the new target promptly
+        return n
 
     def stop(self, drain_s: float = 5.0) -> None:
         """Drain queued work (bounded), then stop lanes and the pool."""
@@ -252,9 +364,13 @@ class ModelRouter:
             lane.batcher.close()
         with self._wakeup:
             self._wakeup.notify_all()
-        for t in self._pool:
+        with self._pool_lock:
+            # snapshot under the lock: a racing set_pool_size (a fleet
+            # controller not yet stopped) must not mutate the dict
+            # mid-iteration
+            pool, self._pool = list(self._pool.values()), {}
+        for t in pool:
             t.join(timeout=max(drain_s, 1.0))
-        self._pool = []
         if self._proxy is not None:
             self._proxy.shutdown(wait=False)
             self._proxy = None
@@ -285,6 +401,8 @@ class ModelRouter:
             return rep.lane._running and not \
                 rep.lane.manager.swap_cooldown_active(
                     self.cfg.swap_cooldown_s)
+        if rep.recently_failed(self.cfg.conn_fail_cooldown_s):
+            return False  # transport just refused/reset: demote fast
         if rep.health_fn is not None:
             try:
                 return bool(rep.health_fn())
@@ -305,40 +423,79 @@ class ModelRouter:
                     1.0 if self._replica_routable(r) else 0.0,
                     model=model, replica=r.name)
 
-    def _pick(self, model: str) -> Replica:
+    def _pick(self, model: str,
+              exclude: Optional[Replica] = None) -> Replica:
+        """Next replica by deterministic rotation: scan the FULL replica
+        list forward from the last pick, skipping unroutable entries —
+        each routable replica gets consecutive turns regardless of how
+        the routable subset flaps between picks (a count-modulo over the
+        filtered list can alias against a flapping replica's phase and
+        starve it; regression-tested). `exclude` skips one replica (the
+        failover retry must not re-pick the replica that just refused)."""
         reps = self.replicas.get(model)
         if not reps:
             raise UnknownModelError(model)
-        healthy = [r for r in reps if self._replica_routable(r)]
-        if not healthy:
+        reps = list(reps)  # snapshot: the fleet controller may
+        #                    add/remove replicas concurrently
+
+        def scan(ok) -> Optional[Replica]:
+            # probes FIRST, lock SECOND: a heartbeat health_fn may read
+            # a file or a gs:// object — that I/O must never run under
+            # the shared rotation lock, or one stalling replica's probe
+            # serializes routing for every model
+            flags = [r is not exclude and ok(r) for r in reps]
+            if not any(flags):
+                return None
+            with self._rr_lock:
+                start = self._rr.get(model, -1)
+                n = len(reps)
+                for i in range(1, n + 1):
+                    j = (start + i) % n
+                    if flags[j]:
+                        self._rr[model] = j
+                        return reps[j]
+            return None
+
+        rep = scan(self._replica_routable)
+        if rep is None:
             # degrade before refusing: a cooling-down or stale-beat
             # replica that is NOT draining may still answer (freshness
             # degrades, availability does not)
-            healthy = [r for r in reps if not r.draining
-                       and (r.lane is None or r.lane._running)]
-        if not healthy:
+            rep = scan(lambda r: not r.draining
+                       and (r.lane is None or r.lane._running))
+        if rep is None:
             raise NoReplicaError(
                 f"model {model!r}: every replica is draining or down")
-        return healthy[next(self._rr[model]) % len(healthy)]
+        return rep
 
     def submit(self, model: str, payload: Dict[str, Any],
-               deadline_s: Optional[float] = None) -> Future:
+               deadline_s: Optional[float] = None,
+               _exclude: Optional[Replica] = None) -> Future:
         """Route one request; returns its response future. Raises
         UnknownModelError / NoReplicaError synchronously; QueueFullError
         propagates from the chosen local lane (backpressure is
-        per-replica — the caller may retry, which re-routes)."""
-        rep = self._pick(model)
+        per-replica — the caller may retry, which re-routes). Served
+        requests feed the per-model `self.latency` window (the fleet
+        controller's SLO-burn signal) whichever replica answered."""
+        rep = self._pick(model, exclude=_exclude)
         self._c_routed.inc(model=model, replica=rep.name)
         if rep.lane is not None:
-            return rep.lane.submit(payload, deadline_s=deadline_s)
-        proxy = self._proxy
-        if proxy is None or not self._running:
-            # racing stop() (or called before start): a typed shed, not
-            # an AttributeError surfacing as a 500
-            raise NoReplicaError(f"model {model!r}: router is not running")
-        fut: Future = Future()
-        proxy.submit(self._proxy_call, rep, model, payload,
-                     deadline_s, fut)
+            fut = rep.lane.submit(payload, deadline_s=deadline_s)
+        else:
+            proxy = self._proxy
+            if proxy is None or not self._running:
+                # racing stop() (or called before start): a typed shed,
+                # not an AttributeError surfacing as a 500
+                raise NoReplicaError(
+                    f"model {model!r}: router is not running")
+            fut = Future()
+            proxy.submit(self._proxy_call, rep, model, payload,
+                         deadline_s, fut)
+        t0 = time.perf_counter()
+        lat = self._ensure_latency(model)
+        fut.add_done_callback(
+            lambda f: lat.add(time.perf_counter() - t0)
+            if f.exception() is None else None)
         return fut
 
     def infer(self, model: str, payload: Dict[str, Any],
@@ -353,7 +510,8 @@ class ModelRouter:
 
     def _proxy_call(self, rep: Replica, model: str,
                     payload: Dict[str, Any],
-                    deadline_s: Optional[float], fut: Future) -> None:
+                    deadline_s: Optional[float], fut: Future,
+                    retried: bool = False) -> None:
         try:
             if rep.transport == "binary":
                 from .binary_frontend import binary_infer  # cycle guard
@@ -364,8 +522,46 @@ class ModelRouter:
                 out = http_infer(rep.url, model, payload,
                                  deadline_s=deadline_s)
             fut.set_result(out)
+        except ConnectionError as e:
+            # the replica refused/reset at the transport level (a kill
+            # -9'd process does this long before its heartbeat goes
+            # stale): demote it and fail the request OVER to another
+            # replica, once — the detection window of a dying replica
+            # costs a retry, not a dropped response. (Timeouts do NOT
+            # failover: a slow server already did the work.)
+            rep.note_failure()
+            if retried or not self._running:
+                fut.set_exception(e)
+                return
+            self._c_failovers.inc(model=model, replica=rep.name)
+            try:
+                rep2 = self._pick(model, exclude=rep)
+            except Exception:
+                fut.set_exception(e)  # nowhere to fail over to
+                return
+            self._c_routed.inc(model=model, replica=rep2.name)
+            if rep2.lane is not None:
+                try:
+                    f2 = rep2.lane.submit(payload, deadline_s=deadline_s)
+                except Exception as e2:
+                    fut.set_exception(e2)
+                    return
+                f2.add_done_callback(lambda f: self._chain(f, fut))
+            else:
+                self._proxy_call(rep2, model, payload, deadline_s, fut,
+                                 retried=True)
         except Exception as e:
             fut.set_exception(e)
+
+    @staticmethod
+    def _chain(src: Future, dst: Future) -> None:
+        if dst.done():
+            return
+        exc = src.exception()
+        if exc is not None:
+            dst.set_exception(exc)
+        else:
+            dst.set_result(src.result())
 
     def drain(self, model: str, replica: str) -> Replica:
         """Operator drain by replica name (or bare 'local:<model>' /
@@ -391,10 +587,10 @@ class ModelRouter:
         self._rot = (self._rot + 1) % max(len(self._order), 1)
         return self._order[self._rot:] + self._order[:self._rot]
 
-    def _pool_run(self) -> None:
+    def _pool_run(self, idx: int = 0) -> None:
         duty = min([l._duty_s for l in self.lanes.values()] or [1.0])
         next_duty = 0.0
-        while self._running:
+        while self._running and idx < self._pool_target:
             progressed = False
             for name in self._rotation():
                 lane = self.lanes[name]
@@ -482,11 +678,15 @@ class ModelRouter:
         return {
             "role": "serve",
             "router": True,
-            "pool_workers": self.cfg.workers,
+            "pool_workers": self.pool_size(),
+            "pool_target": self._pool_target,
             "models": self._model_rows(),
             "lanes": {n: lane.status() for n, lane in self.lanes.items()},
             "replicas": {m: [r.as_dict() for r in reps]
                          for m, reps in self.replicas.items()},
+            "routed_latency": {m: s.summary()
+                               for m, s in self.latency.items()},
+            "autoscale": self.fleet is not None,
         }
 
     @property

@@ -15,7 +15,7 @@ Frame layout (everything little-endian)::
 
     offset  size  field
     0       4     magic      b"SPK1"
-    4       1     version    1
+    4       1     version    2 (1 = the pre-priority REQUEST meta)
     5       1     type       1=REQUEST 2=RESPONSE 3=ERROR 4=CHUNK
     6       2     flags      bit0 STREAM, bit1 LAST (final chunk)
     8       8     request_id client-chosen; replies carry it back
@@ -31,7 +31,9 @@ chunked-encoding scan).
 
 Meta sections (str8 = u8 length + utf-8 bytes; str16 = u16 length):
 
-  REQUEST:  model str8 | tenant str8 | deadline_ms f64 (NaN = none) |
+  REQUEST:  model str8 | tenant str8 | priority str8 ("" = normal;
+            the admission priority class, serve/admission.py) |
+            deadline_ms f64 (NaN = none) |
             n_tensors u16 | descriptor*
   RESPONSE: model str8 | step i64 (-1 = unknown) | n_tensors u16 |
             descriptor*   (with FLAG_STREAM: descriptors announce the
@@ -66,7 +68,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 MAGIC = b"SPK1"
-VERSION = 1
+# version 2: REQUEST meta grew the priority str8 field (between tenant
+# and deadline_ms). The bump is what makes a rolling upgrade honest: a
+# v1 peer gets the TYPED bad_version error frame instead of silently
+# misparsing the deadline bytes as a priority string.
+VERSION = 2
 HEADER = struct.Struct("<4sBBHQQQ")
 HEADER_LEN = HEADER.size  # 32
 
@@ -83,6 +89,7 @@ ERR_UNKNOWN_MODEL = (404, "unknown_model")
 ERR_TOO_LARGE = (413, "too_large")
 ERR_QUEUE_FULL = (429, "queue_full")
 ERR_TENANT_LIMIT = (429, "tenant_limit")
+ERR_PRIORITY = (429, "priority")
 ERR_OVER_CAPACITY = (503, "over_capacity")
 ERR_DEADLINE = (503, "deadline")
 ERR_NO_REPLICA = (503, "no_replica")
@@ -253,6 +260,7 @@ def pack_request(request_id: int, model: str,
                  payload: Dict[str, np.ndarray],
                  deadline_ms: Optional[float] = None,
                  tenant: Optional[str] = None,
+                 priority: Optional[str] = None,
                  stream: bool = False
                  ) -> Tuple[bytes, List[memoryview]]:
     """(header+meta bytes, payload byte views). The caller writes the
@@ -261,6 +269,7 @@ def pack_request(request_id: int, model: str,
     meta = b"".join((
         _pack_str8(model),
         _pack_str8(tenant or ""),
+        _pack_str8(priority or ""),
         struct.pack("<d", float("nan") if deadline_ms is None
                     else float(deadline_ms)),
         _pack_table(descs)))
@@ -270,17 +279,19 @@ def pack_request(request_id: int, model: str,
 
 
 def unpack_request_meta(meta: bytes
-                        ) -> Tuple[str, str, Optional[float],
+                        ) -> Tuple[str, str, str, Optional[float],
                                    List[TensorDesc]]:
+    """-> (model, tenant, priority, deadline_ms, descriptors)."""
     r = _Reader(meta)
     model = r.str8()
     tenant = r.str8()
+    priority = r.str8()
     deadline_ms = r.f64()
     if deadline_ms != deadline_ms:  # NaN
         deadline = None
     else:
         deadline = float(deadline_ms)
-    return model, tenant, deadline, _read_table(r)
+    return model, tenant, priority, deadline, _read_table(r)
 
 
 def pack_response(request_id: int, model: str, step: Optional[int],

@@ -150,6 +150,12 @@ class LatencyStats:
         registry across models); None keeps the unlabeled family — but
         the two modes must not mix within one registry/name."""
         self._obs: deque = deque(maxlen=max(2, window))
+        # enqueue times of the SAME observations (parallel deque, same
+        # maxlen, appended under the same lock): the fleet controller's
+        # SLO-burn signal is a TIME-sliding p99, not a count-sliding one
+        # — 4096 trickle observations can span an hour, and an autoscaler
+        # acting on an hour-old tail would chase ghosts
+        self._obs_t: deque = deque(maxlen=max(2, window))
         self._lock = threading.Lock()
         self.count = 0
         self._hist = None
@@ -162,6 +168,7 @@ class LatencyStats:
     def add(self, seconds: float) -> None:
         with self._lock:
             self._obs.append(float(seconds))
+            self._obs_t.append(time.monotonic())
             self.count += 1
         if self._hist is not None:
             self._hist.observe(seconds, **self._labels)
@@ -173,13 +180,28 @@ class LatencyStats:
             xs = sorted(self._obs)
         return _rank(xs, q) if xs else None
 
+    def windowed(self, window_s: float) -> Dict[str, Optional[float]]:
+        """p50/p99 (ms) + n over the observations of the last `window_s`
+        seconds — the fleet controller's SLO-burn input. Returns
+        {"n": 0, "p50_ms": None, "p99_ms": None} when the window holds
+        nothing (a quiet model must read as NOT burning, never as stale-
+        tail burning)."""
+        cutoff = time.monotonic() - float(window_s)
+        with self._lock:
+            xs = sorted(v for v, t in zip(self._obs, self._obs_t)
+                        if t >= cutoff)
+        out: Dict[str, Optional[float]] = {"n": len(xs)}
+        for name, q in (("p50_ms", 0.50), ("p99_ms", 0.99)):
+            out[name] = round(_rank(xs, q) * 1e3, 3) if xs else None
+        return out
+
     def summary(self) -> Dict[str, Optional[float]]:
         # ONE consistent copy for all three quantiles: a scrape racing the
         # worker's add() must not see p50 and p99 from different windows
         with self._lock:
             xs = sorted(self._obs)
             n = self.count
-        out: Dict[str, Optional[float]] = {"n": n}
+        out: Dict[str, Optional[float]] = {"n": n}  # lifetime count
         for name, q in (("p50_ms", 0.50), ("p90_ms", 0.90),
                         ("p99_ms", 0.99)):
             out[name] = round(_rank(xs, q) * 1e3, 3) if xs else None
@@ -188,6 +210,7 @@ class LatencyStats:
     def reset(self) -> None:
         with self._lock:
             self._obs.clear()
+            self._obs_t.clear()
             self.count = 0
 
 

@@ -69,14 +69,17 @@ def test_wire_request_roundtrip():
     payload = {"data": r.standard_normal((3, 4)).astype(np.float32),
                "label": np.arange(2, dtype=np.int32)}
     head, views = wire.pack_request(7, "m", payload, deadline_ms=125.0,
-                                    tenant="t1", stream=True)
+                                    tenant="t1", priority="low",
+                                    stream=True)
     buf = head + b"".join(bytes(v) for v in views)
     ftype, flags, rid, meta_len, payload_len = wire.parse_header(buf)
     assert (ftype, rid) == (wire.T_REQUEST, 7)
     assert flags & wire.FLAG_STREAM
     meta = buf[wire.HEADER_LEN:wire.HEADER_LEN + meta_len]
-    model, tenant, deadline_ms, descs = wire.unpack_request_meta(meta)
-    assert (model, tenant, deadline_ms) == ("m", "t1", 125.0)
+    model, tenant, priority, deadline_ms, descs = \
+        wire.unpack_request_meta(meta)
+    assert (model, tenant, priority, deadline_ms) == \
+        ("m", "t1", "low", 125.0)
     out = wire.tensors_from(descs,
                             buf[wire.HEADER_LEN + meta_len:])
     assert set(out) == {"data", "label"}

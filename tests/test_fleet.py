@@ -1,0 +1,666 @@
+"""The fleet control plane (sparknet_tpu/fleet/) + its admission and
+router substrate:
+
+  - priority-aware admission: classes, weighted tenant budgets,
+    pressure-driven tightening; the tenant-table churn hygiene
+    (bounded under a tenant-id sweep, fresh burst after eviction).
+  - policy units: SLO burn, hot/cold verdicts, the pressure curve,
+    construction-time validation.
+  - router fairness: a drained-then-undrained replica resumes its
+    round-robin share, and a FLAPPING replica is never parity-starved
+    (the rotation-index fix); live pool resizing.
+  - heartbeat-health demotion END TO END: a remote replica over the
+    binary transport whose beat goes stale mid-traffic is routed
+    around within stale_after_s and rejoins when beats resume.
+  - FleetController: grow on SLO burn (audit-named), shrink via drain
+    with zero dropped, dead-replica eviction + replacement, min-bound
+    enforcement, admission pressure threading, /fleet/status.
+  - both frontends shed low-priority traffic TYPED under pressure
+    (X-Priority header / binary priority field, reason="priority").
+
+Tier-1: CPU backend, lenet shapes, ephemeral ports, no subprocess
+spawns (the subprocess provider runs in bench.py --fleet; here an
+in-process provider keeps the suite fast).
+"""
+import json
+import threading
+import time
+import urllib.request
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.fleet import (FleetConfig, FleetController, FleetPolicy,
+                                ModelSignals, PodReplicaProvider,
+                                ReplicaHandle, ReplicaProvider, slo_burn)
+from sparknet_tpu.net_api import JaxNet
+from sparknet_tpu.serve import (BinaryFrontend, HttpFrontend,
+                                InferenceServer, ModelRouter,
+                                PriorityAdmission, PriorityShedError,
+                                Replica, RouterConfig, ServeConfig,
+                                TenantAdmission, binary_infer,
+                                http_infer, parse_priority)
+from sparknet_tpu.utils.heartbeat import HeartbeatWriter
+from sparknet_tpu.zoo import lenet
+
+SLO_MS = 50.0
+
+
+def _example(i: int) -> dict:
+    r = np.random.default_rng(7000 + i)
+    return {"data": r.standard_normal((28, 28, 1)).astype(np.float32)}
+
+
+def _lane_cfg(name: str = "m") -> ServeConfig:
+    return ServeConfig(model_name=name, max_batch=4, max_wait_ms=2.0,
+                       outputs=("prob",), slo_p99_ms=SLO_MS,
+                       metrics_every_batches=0)
+
+
+class InProcessProvider(ReplicaProvider):
+    """Grow = a fresh InferenceServer + BinaryFrontend in THIS process
+    (the subprocess provider's spawn cost without the subprocess)."""
+
+    def __init__(self):
+        self.spawned = []          # (server, frontend, handle)
+        self.retired = []
+        self._dead = set()
+
+    def grow(self, model: str) -> ReplicaHandle:
+        srv = InferenceServer(JaxNet(lenet(batch=4)),
+                              _lane_cfg(model)).start()
+        fe = BinaryFrontend(srv, port=0)
+        h = ReplicaHandle(model,
+                          f"spkn://{fe.address[0]}:{fe.address[1]}",
+                          meta={"i": len(self.spawned)})
+        self.spawned.append((srv, fe, h))
+        return h
+
+    def kill(self, handle: ReplicaHandle) -> None:
+        """The in-process kill -9: the frontend stops answering and
+        alive() flips false."""
+        self._dead.add(handle.meta["i"])
+        srv, fe, _ = self.spawned[handle.meta["i"]]
+        fe.stop()
+
+    def retire(self, handle: ReplicaHandle) -> None:
+        self.retired.append(handle.meta["i"])
+
+    def alive(self, handle: ReplicaHandle) -> bool:
+        return handle.meta["i"] not in self._dead
+
+    def stop(self) -> None:
+        for srv, fe, h in self.spawned:
+            if h.meta["i"] not in self._dead:
+                fe.stop()
+            srv.stop()
+
+
+def _controller(router, provider=None, admission=None, logger=None,
+                **over) -> FleetController:
+    kw = dict(interval_s=0.05, window_s=30.0, min_replicas=1,
+              max_replicas=2, up_cooldown_s=0.0, down_cooldown_s=0.0,
+              drain_grace_s=0.0, dead_ticks=2,
+              policy=FleetPolicy(up_ticks=2, down_ticks=3,
+                                 min_window_n=8))
+    kw.update(over)
+    return FleetController(router, provider=provider,
+                           cfg=FleetConfig(**kw), admission=admission,
+                           logger=logger)
+
+
+def _burn(router, model: str, n: int = 32, seconds: float = 0.2):
+    """Inject a burning tail into the router-vantage latency window."""
+    for _ in range(n):
+        router.latency[model].add(seconds)
+
+
+# -- admission: priority classes + weighted budgets ---------------------------
+
+def test_parse_priority_degrades_unknown_to_normal():
+    assert parse_priority("high") == "high"
+    assert parse_priority(" LOW ") == "low"
+    assert parse_priority(None) == "normal"
+    assert parse_priority("argh") == "normal"
+
+
+def test_tenant_churn_table_bounded_and_fresh_burst_after_eviction():
+    """The admission-hygiene satellite: thousands of distinct tenants
+    sweeping through must not grow the table past max_tenants, and an
+    evicted-then-returning tenant gets a FRESH full burst — never a
+    stale empty bucket left from its previous life."""
+    a = TenantAdmission(rate_rps=0.001, burst=3.0, max_tenants=128)
+    # drain tenant t0 to empty (burst 3, negligible refill)
+    for _ in range(3):
+        assert a.allow("t0")
+    assert not a.allow("t0")  # bucket empty now
+    # a 5000-tenant sweep churns t0 out
+    for i in range(5000):
+        a.allow(f"sweep-{i}")
+        assert a.tracked_tenants() <= 128
+    assert "t0" not in a.snapshot()
+    # the returning tenant starts from a FULL burst: 3 admits, then shed
+    for _ in range(3):
+        assert a.allow("t0"), "evicted tenant did not get a fresh burst"
+    assert not a.allow("t0")
+    assert abs(a.snapshot()["t0"]) < 0.01
+
+
+def test_weighted_tenant_gets_scaled_rate_and_burst():
+    a = PriorityAdmission(rate_rps=10.0, burst=2.0,
+                          weights={"vip": 2.0, "cheap": 0.5})
+    assert a._rate_for("vip") == 20.0   # 10 * weight 2.0, no pressure
+    assert a._rate_for("cheap") == 5.0
+    assert a._burst_for("vip") == 4.0
+    assert a._burst_for("cheap") == 1.0
+    assert a._burst_for("unknown") == 2.0
+    # a fresh weighted bucket opens at ITS burst: vip admits 4 straight
+    for _ in range(4):
+        assert a.admit("vip") is None
+    assert a.admit("vip") == "tenant_limit"
+    # the churn rule survives the weighting: evict + return = full burst
+    a2 = PriorityAdmission(rate_rps=0.001, burst=2.0,
+                           weights={"vip": 2.0}, max_tenants=8)
+    for _ in range(4):
+        assert a2.admit("vip") is None
+    assert a2.admit("vip") == "tenant_limit"
+    for i in range(64):
+        a2.admit(f"sweep-{i}")
+    for _ in range(4):
+        assert a2.admit("vip") is None, "stale bucket after eviction"
+
+
+def test_priority_sheds_low_first_under_pressure():
+    a = PriorityAdmission()  # no tenant buckets: pure priority door
+    for cls in ("high", "normal", "low"):
+        assert a.admit("t", cls) is None  # no pressure: all admitted
+    a.set_pressure(0.6)
+    assert a.admit("t", "low") == "priority"
+    assert a.admit("t", "normal") is None
+    assert a.admit("t", "high") is None
+    a.set_pressure(0.95)
+    assert a.admit("t", "low") == "priority"
+    assert a.admit("t", "normal") == "priority"
+    assert a.admit("t", "high") is None  # high never pressure-shed
+    assert a.shed_priority == 3
+
+
+def test_pressure_tightens_refill_toward_floor():
+    a = PriorityAdmission(rate_rps=10.0, tighten=0.8, rate_floor=0.1)
+    assert a._rate_for("t") == 10.0
+    a.set_pressure(1.0)
+    assert abs(a._rate_for("t") - 2.0) < 1e-9   # 10 * (1 - 0.8)
+    b = PriorityAdmission(rate_rps=10.0, tighten=1.0, rate_floor=0.25)
+    b.set_pressure(1.0)
+    assert abs(b._rate_for("t") - 2.5) < 1e-9   # clamped at the floor
+
+
+def test_admission_validation_fails_at_construction():
+    with pytest.raises(ValueError, match="weights"):
+        PriorityAdmission(rate_rps=1.0, weights={"t": -1.0})
+    with pytest.raises(ValueError, match="priority class"):
+        PriorityAdmission(shed_at={"urgent": 0.5})
+    with pytest.raises(ValueError, match="tighten"):
+        PriorityAdmission(tighten=1.5)
+    with pytest.raises(ValueError, match="rate must be > 0"):
+        TenantAdmission(rate_rps=0.0)
+
+
+# -- policy units -------------------------------------------------------------
+
+def test_slo_burn_edges():
+    assert slo_burn(None, 50.0) == 0.0
+    assert slo_burn(100.0, None) == 0.0
+    assert slo_burn(100.0, 50.0) == 2.0
+
+
+def _sig(**over) -> ModelSignals:
+    kw = dict(model="m", p99_ms=None, slo_p99_ms=SLO_MS, n_window=100,
+              queue_frac=0.0, shed_per_s=0.0, replicas=1, routable=1)
+    kw.update(over)
+    return ModelSignals(**kw)
+
+
+def test_policy_hot_reasons_and_window_gate():
+    p = FleetPolicy()
+    assert p.hot_reason(_sig(p99_ms=2 * SLO_MS)) == "slo_burn"
+    # a near-empty window's p99 is noise, not a scale-up signal
+    assert p.hot_reason(_sig(p99_ms=2 * SLO_MS, n_window=3)) is None
+    assert p.hot_reason(_sig(queue_frac=0.9)) == "queue"
+    assert p.hot_reason(_sig(shed_per_s=5.0)) == "shed"
+    assert p.hot_reason(_sig(p99_ms=0.5 * SLO_MS)) is None
+
+
+def test_policy_cold_requires_every_margin():
+    p = FleetPolicy()
+    assert p.is_cold(_sig(p99_ms=0.2 * SLO_MS))
+    assert p.is_cold(_sig())  # idle model (no p99) IS cold
+    assert not p.is_cold(_sig(queue_frac=0.3))
+    assert not p.is_cold(_sig(p99_ms=0.9 * SLO_MS))
+
+
+def test_policy_pressure_curve():
+    p = FleetPolicy(pressure_start=1.0, pressure_full=2.0)
+    assert p.pressure_from_burn(0.5) == 0.0
+    assert abs(p.pressure_from_burn(1.5) - 0.5) < 1e-9
+    assert p.pressure_from_burn(3.0) == 1.0
+
+
+def test_policy_and_config_validate_at_construction():
+    with pytest.raises(ValueError, match="burn_down"):
+        FleetPolicy(burn_up=1.0, burn_down=1.0)
+    with pytest.raises(ValueError, match="pressure_full"):
+        FleetPolicy(pressure_start=1.0, pressure_full=1.0)
+    with pytest.raises(ValueError, match="min_replicas"):
+        FleetConfig(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="interval_s"):
+        FleetConfig(interval_s=0.0)
+
+
+# -- router fairness (the rotation-index satellite) ---------------------------
+
+def _bare_router_with_remotes(n: int = 3):
+    router = ModelRouter(RouterConfig(workers=1))
+    reps = [Replica(f"r{i}", url=f"http://h{i}:1") for i in range(n)]
+    router.replicas["m"] = reps
+    router._rr["m"] = -1
+    return router, reps
+
+
+def test_drained_then_undrained_replica_resumes_round_robin_share():
+    router, (r0, r1, r2) = _bare_router_with_remotes(3)
+    picks = [router._pick("m").name for _ in range(30)]
+    assert all(picks.count(r.name) == 10 for r in (r0, r1, r2))
+    r1.drain()
+    picks = [router._pick("m").name for _ in range(20)]
+    assert picks.count("r1") == 0
+    assert picks.count("r0") == picks.count("r2") == 10
+    r1.undrain()
+    picks = [router._pick("m").name for _ in range(30)]
+    # the returning replica resumes its FULL share — no permanent skew
+    assert all(picks.count(r.name) == 10 for r in (r0, r1, r2)), picks
+
+
+def test_flapping_replica_is_never_parity_starved():
+    """The regression the rotation-index fix exists for: with the old
+    count-modulo over the FILTERED healthy list, a replica whose
+    health flaps in step with the pick parity is starved FOREVER
+    (len alternates 2/1, the counter advances 2 between len-2 picks,
+    the modulo parity never reaches it)."""
+    router, (r0, r1) = _bare_router_with_remotes(2)
+    got_r1 = 0
+    undrained_picks = 0
+    for i in range(20):
+        if i % 2:
+            r1.drain()
+        else:
+            r1.undrain()
+            undrained_picks += 1
+        if router._pick("m").name == "r1":
+            got_r1 += 1
+    assert undrained_picks == 10
+    assert got_r1 >= 8, (f"flapping replica starved: picked {got_r1} "
+                         f"of {undrained_picks} available turns")
+
+
+def test_pool_resize_live():
+    router = ModelRouter(RouterConfig(workers=1))
+    router.add_model("m", JaxNet(lenet(batch=4)), cfg=_lane_cfg())
+    with router:
+        router.infer("m", _example(0), timeout=30.0)
+        assert router.pool_size() == 1
+        router.set_pool_size(3)
+        deadline = time.monotonic() + 5
+        while router.pool_size() < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert router.pool_size() == 3
+        router.infer("m", _example(1), timeout=30.0)
+        router.set_pool_size(1)
+        deadline = time.monotonic() + 5
+        while router.pool_size() > 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert router.pool_size() == 1
+        # a shrunk pool still serves
+        out = router.infer("m", _example(2), timeout=30.0)
+        assert out["prob"].shape == (10,)
+
+
+# -- heartbeat-health demotion, end to end over the binary wire ---------------
+
+def test_stale_heartbeat_routes_around_then_rejoins(tmp_path):
+    """The two-replica e2e satellite: mid-traffic, the remote replica's
+    heartbeat goes stale -> the router demotes it within stale_after_s
+    (NEW requests all land on the local lane); beats resume -> the
+    replica rejoins the rotation. All through the real binary
+    transport."""
+    hb_path = str(tmp_path / "replica.heartbeat.json")
+    hb = HeartbeatWriter(hb_path, role="serve", interval_s=0.0)
+    hb.beat(1, force=True)
+    rb = ModelRouter(RouterConfig(workers=1))
+    rb.add_model("m", JaxNet(lenet(batch=4)), cfg=_lane_cfg())
+    ra = ModelRouter(RouterConfig(workers=1, stale_after_s=0.6,
+                                  health_refresh_s=0.05))
+    ra.add_model("m", JaxNet(lenet(batch=4)), cfg=_lane_cfg())
+    with rb:
+        fe_b = BinaryFrontend(rb, port=0)
+        try:
+            with ra:
+                rep = ra.add_remote_replica(
+                    "m", f"spkn://{fe_b.address[0]}:{fe_b.address[1]}",
+                    heartbeat_path=hb_path)
+                routed = ra.registry.counter(
+                    "sparknet_serve_routed_total",
+                    labels=("model", "replica"))
+
+                def remote_count():
+                    return routed.value(model="m",
+                                        replica=rep.name) or 0
+
+                for i in range(6):
+                    hb.beat(1, force=True)
+                    ra.infer("m", _example(i), timeout=30.0)
+                assert remote_count() >= 2  # rotation includes remote
+                # beats STOP: within stale_after_s (+ probe refresh) the
+                # replica must become unroutable
+                t0 = time.monotonic()
+                while ra._replica_routable(rep) and \
+                        time.monotonic() - t0 < 3.0:
+                    time.sleep(0.05)
+                detect_s = time.monotonic() - t0
+                assert not ra._replica_routable(rep), \
+                    "stale heartbeat never demoted the replica"
+                assert detect_s <= 1.5, f"demotion took {detect_s:.2f}s"
+                before = remote_count()
+                for i in range(6):
+                    out = ra.infer("m", _example(10 + i), timeout=30.0)
+                    assert out["prob"].shape == (10,)
+                assert remote_count() == before, \
+                    "stale replica still received new routing"
+                # beats RESUME: the replica rejoins
+                hb.beat(2, force=True)
+                t0 = time.monotonic()
+                while not ra._replica_routable(rep) and \
+                        time.monotonic() - t0 < 3.0:
+                    hb.beat(2, force=True)
+                    time.sleep(0.05)
+                assert ra._replica_routable(rep)
+                for i in range(6):
+                    hb.beat(2, force=True)
+                    ra.infer("m", _example(20 + i), timeout=30.0)
+                assert remote_count() > before, \
+                    "recovered replica never rejoined the rotation"
+        finally:
+            fe_b.stop()
+
+
+# -- the controller -----------------------------------------------------------
+
+@pytest.fixture()
+def fleet_router():
+    router = ModelRouter(RouterConfig(workers=1, stale_after_s=0.6,
+                                      health_refresh_s=0.02,
+                                      conn_fail_cooldown_s=0.2))
+    router.add_model("m", JaxNet(lenet(batch=4)), cfg=_lane_cfg())
+    provider = InProcessProvider()
+    with router:
+        router.infer("m", _example(0), timeout=30.0)
+        yield router, provider
+    provider.stop()
+
+
+def test_controller_grows_on_slo_burn_with_named_audit(fleet_router):
+    router, provider = fleet_router
+    fc = _controller(router, provider)
+    fc.tick()
+    assert len(router.replicas["m"]) == 1  # quiet: nothing to do
+    _burn(router, "m")
+    fc.tick()
+    assert len(router.replicas["m"]) == 1  # hysteresis: 1 hot tick
+    fc.tick()
+    assert len(router.replicas["m"]) == 2  # up_ticks=2 satisfied
+    ev = fc.audit[-1]
+    assert (ev["direction"], ev["reason"]) == ("up", "slo_burn")
+    assert ev["replica"].startswith("remote:spkn://")
+    g = router.registry.gauge("sparknet_fleet_replicas",
+                              labels=("model",))
+    assert g.value(model="m") == 2
+    c = router.registry.counter(
+        "sparknet_fleet_scale_events_total",
+        labels=("model", "direction", "reason"))
+    assert c.value(model="m", direction="up", reason="slo_burn") == 1
+    # bounded: still-burning traffic cannot exceed max_replicas
+    _burn(router, "m")
+    for _ in range(4):
+        fc.tick()
+    assert len(router.replicas["m"]) == 2
+    # the grown replica actually serves
+    for i in range(4):
+        out = router.infer("m", _example(i), timeout=30.0)
+        assert out["prob"].shape == (10,)
+    fc.stop()
+
+
+def test_controller_shrinks_via_drain_zero_dropped(fleet_router):
+    router, provider = fleet_router
+    fc = _controller(router, provider)
+    _burn(router, "m")
+    fc.tick()
+    fc.tick()
+    assert len(router.replicas["m"]) == 2
+    router.latency["m"].reset()  # traffic goes quiet
+    # keep a trickle flowing THROUGH the shrink: zero dropped required
+    errors, answered = [], []
+
+    def trickle():
+        for i in range(12):
+            try:
+                answered.append(router.infer("m", _example(i),
+                                             timeout=30.0))
+            except Exception as e:
+                errors.append(e)
+            time.sleep(0.02)
+    tt = threading.Thread(target=trickle)
+    tt.start()
+    deadline = time.monotonic() + 10
+    while len(router.replicas["m"]) > 1 and \
+            time.monotonic() < deadline:
+        fc.tick()
+        time.sleep(0.05)
+    tt.join(timeout=30.0)
+    assert len(router.replicas["m"]) == 1
+    assert provider.retired, "provider never retired the drained child"
+    assert not errors, f"shrink dropped requests: {errors[:3]}"
+    assert len(answered) == 12
+    downs = [a for a in fc.audit if a["direction"] == "down"]
+    assert downs and downs[-1]["reason"] == "quiet"
+    fc.stop()
+
+
+def test_controller_replaces_dead_replica_and_names_it(fleet_router):
+    router, provider = fleet_router
+    fc = _controller(router, provider, max_replicas=3)
+    _burn(router, "m")
+    fc.tick()
+    fc.tick()
+    assert len(router.replicas["m"]) == 2
+    victim_rep, victim_handle = fc._owned["m"][0]
+    provider.kill(victim_handle)          # the in-process kill -9
+    fc.tick()                             # proc-dead: evict + replace
+    assert victim_rep.name not in [r.name for r in
+                                   router.replicas["m"]]
+    reasons = [(a["direction"], a["reason"]) for a in fc.audit]
+    assert ("down", "dead") in reasons
+    assert ("up", "replace") in reasons
+    dead_ev = next(a for a in fc.audit if a["reason"] == "dead")
+    assert dead_ev["replica"] == victim_rep.name  # eviction is NAMED
+    assert len(router.replicas["m"]) == 2  # replacement restored size
+    for i in range(4):
+        out = router.infer("m", _example(i), timeout=30.0)
+        assert out["prob"].shape == (10,)
+    fc.stop()
+
+
+def test_controller_enforces_min_replicas(fleet_router):
+    router, provider = fleet_router
+    fc = _controller(router, provider, min_replicas=2, max_replicas=3)
+    fc.tick()  # no burn needed: the floor is not a load decision
+    assert len(router.replicas["m"]) == 2
+    assert fc.audit[-1]["reason"] == "min_bound"
+    fc.stop()
+
+
+def test_controller_pool_lever_from_queue_pressure(fleet_router):
+    router, provider = fleet_router
+    fc = _controller(router, None, pool_min=1, pool_max=3)
+    hot = _sig(queue_frac=0.9)
+    fc._signals = lambda model, dt: hot  # craft signal, keep the loop
+    fc.tick()
+    fc.tick()
+    assert router._pool_target == 2
+    assert fc.audit[-1] == {**fc.audit[-1], "model": "_pool",
+                            "direction": "up", "reason": "queue"}
+    quiet = _sig(queue_frac=0.0)
+    fc._signals = lambda model, dt: quiet
+    for _ in range(4):
+        fc.tick()
+    assert router._pool_target == 1
+    fc.stop()
+
+
+def test_controller_pressure_threads_to_admission_door(fleet_router):
+    router, provider = fleet_router
+    admission = PriorityAdmission()
+    fc = _controller(router, None, admission=admission,
+                     policy=FleetPolicy(up_ticks=2, down_ticks=3,
+                                        min_window_n=8,
+                                        pressure_start=0.5,
+                                        pressure_full=1.0))
+    fc.tick()
+    assert admission.pressure == 0.0
+    _burn(router, "m", seconds=0.2)       # burn 4.0 -> pressure 1.0
+    fc.tick()
+    assert admission.pressure == 1.0
+    assert admission.admit("t", "low") == "priority"
+    assert admission.admit("t", "high") is None
+    router.latency["m"].reset()
+    fc.tick()
+    assert admission.pressure == 0.0       # instantly reversible
+    fc.stop()
+
+
+def test_fleet_status_route(fleet_router):
+    router, provider = fleet_router
+    # the route exists without a controller and says so
+    from sparknet_tpu.obs import StatusServer
+    assert router._fleet_status() == {"enabled": False}
+    fc = _controller(router, provider)
+    _burn(router, "m")
+    fc.tick()
+    fc.tick()
+    st = router._fleet_status()
+    assert st["enabled"] is True
+    assert st["models"]["m"]["replicas"] == 2
+    assert st["models"]["m"]["slo_p99_ms"] == SLO_MS
+    assert st["models"]["m"]["burn"] > 1.0
+    assert st["audit"][-1]["reason"] == "slo_burn"
+    assert st["pool"]["size"] == 1
+    # and over real HTTP via the router's StatusServer route table
+    http = StatusServer(0, router.registry,
+                        routes={"/fleet/status": router._fleet_status})
+    try:
+        host, port = http.address
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/fleet/status", timeout=10) as r:
+            body = json.loads(r.read())
+        assert body["enabled"] is True
+        assert body["models"]["m"]["replicas"] == 2
+    finally:
+        http.stop()
+    fc.stop()
+
+
+# -- frontends: priority shed, typed on both wires ----------------------------
+
+def test_http_x_priority_sheds_typed_under_pressure():
+    admission = PriorityAdmission()
+    admission.set_pressure(0.6)
+    srv = InferenceServer(JaxNet(lenet(batch=4)),
+                          _lane_cfg("default")).start()
+    fe = HttpFrontend(srv, port=0, tenants=admission)
+    try:
+        url = f"http://{fe.address[0]}:{fe.address[1]}"
+        with pytest.raises(PriorityShedError):
+            http_infer(url, "default", _example(0), deadline_s=30.0,
+                       priority="low")
+        out = http_infer(url, "default", _example(0), deadline_s=30.0,
+                         priority="high")
+        assert out["prob"].shape == (10,)
+        c = srv.registry.counter("sparknet_serve_shed_total",
+                                 labels=("model", "reason"))
+        assert c.value(model="default", reason="priority") == 1
+    finally:
+        fe.stop()
+        srv.stop()
+
+
+def test_binary_priority_field_sheds_typed_under_pressure():
+    admission = PriorityAdmission()
+    admission.set_pressure(0.95)
+    srv = InferenceServer(JaxNet(lenet(batch=4)),
+                          _lane_cfg("default")).start()
+    fe = BinaryFrontend(srv, port=0, tenants=admission)
+    try:
+        with pytest.raises(PriorityShedError):
+            binary_infer(fe.address, "default", _example(0),
+                         deadline_s=30.0, priority="normal")
+        out = binary_infer(fe.address, "default", _example(0),
+                           deadline_s=30.0, priority="high")
+        assert out["prob"].shape == (10,)
+        # the typed shed rode the SAME keep-alive connection
+        assert fe.connections == 1
+    finally:
+        fe.stop()
+        srv.stop()
+
+
+# -- providers + CLI ----------------------------------------------------------
+
+def test_pod_provider_stub_assembles_launcher_protocol():
+    calls = []
+    prov = PodReplicaProvider({"m": "lenet"}, zone="us-east5-b",
+                              accel_type="v5e-8",
+                              launcher="scripts/tpu_pod_launch.sh",
+                              runner=calls.append)
+    h = prov.grow("m")
+    assert h.url == "spkn://sparknet-fleet-m-1:8470"
+    assert [c[1] for c in calls] == ["create", "setup", "run"]
+    assert calls[0][2:] == ["sparknet-fleet-m-1", "us-east5-b", "v5e-8"]
+    assert "sparknet-serve" in calls[2][4]
+    assert "--binary-port 8470" in calls[2][4]
+    prov.retire(h)
+    assert calls[-1][1] == "delete"
+    with pytest.raises(KeyError):
+        prov.grow("unknown")
+
+
+def test_serve_cli_autoscale_demo(tmp_path, capsys):
+    """`sparknet-serve --models ... --autoscale --fleet-provider none
+    --demo`: the control plane starts, the demo serves, the status
+    carries autoscale=true, and shutdown is clean."""
+    from sparknet_tpu.serve.app import main
+    main(["--models", "m=lenet", "--autoscale",
+          "--fleet-provider", "none", "--binary-port", "0",
+          "--slo-p99-ms", "50", "--demo", "4",
+          "--workdir", str(tmp_path)])
+    status = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert status["autoscale"] is True
+    assert status["models"]["m"]["requests_ok"] == 4
+
+
+def test_serve_cli_autoscale_requires_models(tmp_path):
+    from sparknet_tpu.serve.app import main
+    with pytest.raises(SystemExit):
+        main(["--model", "lenet", "--autoscale",
+              "--workdir", str(tmp_path)])
