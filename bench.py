@@ -1341,6 +1341,98 @@ def serve_bench(out_path: str | None = "BENCH_SERVE.json",
     return {"headline": out, "rows": rows}
 
 
+def _calibrate_rps(addr, model: str, req) -> float:
+    """Closed-loop single-client rps over the binary wire — the capacity
+    yardstick the fleet/fresh load rates scale from."""
+    from sparknet_tpu.serve import binary_infer
+    for _ in range(3):
+        binary_infer(addr, model, req, deadline_s=30.0)
+    n, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < 1.0:
+        binary_infer(addr, model, req, deadline_s=30.0)
+        n += 1
+    return n / (time.perf_counter() - t0)
+
+
+def _open_load(addr, model: str, req, rps: float, secs: float,
+               deadline_s: float = 0.25, priority: str | None = None,
+               tenant: str | None = None):
+    """Open-loop senders over the binary wire (shared by the fleet and
+    fresh arms); returns (counts, [(t_done, dt)] for served requests,
+    hung sender count). Every shed must be TYPED; connection errors are
+    drops and fail the caller's arm gate."""
+    import threading
+
+    from sparknet_tpu.serve import (DeadlineExpiredError, NoReplicaError,
+                                    PriorityShedError, QueueFullError,
+                                    TenantLimitError, binary_infer)
+
+    conns = int(min(32, max(4, rps // 25)))
+    counts = {"ok": 0, "shed_429": 0, "shed_503": 0,
+              "shed_priority": 0, "dropped": 0, "timed_out": 0,
+              "errors_other": 0}
+    lats: list = []
+    lock = threading.Lock()
+    t_start = time.perf_counter()
+    t_stop = t_start + secs
+    period = conns / rps
+
+    def sender(j):
+        t_next = t_start + (j / conns) * period
+        while True:
+            now = time.perf_counter()
+            if now >= t_stop:
+                return
+            if now < t_next:
+                time.sleep(min(t_next - now, t_stop - now))
+                continue
+            t0 = time.perf_counter()
+            try:
+                binary_infer(addr, model, req, deadline_s=deadline_s,
+                             timeout=10.0, priority=priority,
+                             tenant=tenant)
+                dt = time.perf_counter() - t0
+                with lock:
+                    counts["ok"] += 1
+                    lats.append((time.perf_counter() - t_start, dt))
+            except PriorityShedError:
+                with lock:
+                    counts["shed_priority"] += 1
+            except (TenantLimitError, QueueFullError):
+                with lock:
+                    counts["shed_429"] += 1
+            except (DeadlineExpiredError, NoReplicaError):
+                with lock:
+                    counts["shed_503"] += 1
+            except TimeoutError:
+                with lock:
+                    counts["timed_out"] += 1
+            except ConnectionError:
+                with lock:
+                    counts["dropped"] += 1
+            except Exception:
+                with lock:
+                    counts["errors_other"] += 1
+            t_next += period
+            if t_next < time.perf_counter() - 5 * period:
+                t_next = time.perf_counter()  # behind: shed schedule
+    ts = [threading.Thread(target=sender, args=(j,))
+          for j in range(conns)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=secs + 30.0)
+    hung = sum(t.is_alive() for t in ts)
+    return counts, lats, hung
+
+
+def _lat_p99_ms(lats, t_from: float = 0.0):
+    xs = sorted(dt for t, dt in lats if t >= t_from)
+    if not xs:
+        return None
+    return round(xs[min(len(xs) - 1, int(0.99 * len(xs)))] * 1e3, 3)
+
+
 def fleet_bench(out_path: str | None = "BENCH_FLEET.json",
                 duration_s: float = 2.0, max_batch: int = 8,
                 keep: str | None = None) -> dict:
@@ -1392,12 +1484,9 @@ def fleet_bench(out_path: str | None = "BENCH_FLEET.json",
                                     FleetPolicy,
                                     SubprocessReplicaProvider)
     from sparknet_tpu.net_api import JaxNet
-    from sparknet_tpu.serve import (BinaryFrontend, DeadlineExpiredError,
-                                    ModelRouter, NoReplicaError,
-                                    PriorityAdmission, PriorityShedError,
-                                    QueueFullError, RouterConfig,
-                                    ServeConfig, TenantLimitError,
-                                    binary_infer)
+    from sparknet_tpu.serve import (BinaryFrontend, ModelRouter,
+                                    PriorityAdmission, RouterConfig,
+                                    ServeConfig, binary_infer)
     from sparknet_tpu.utils.logger import Logger
     from sparknet_tpu.zoo import lenet
 
@@ -1434,86 +1523,17 @@ def fleet_bench(out_path: str | None = "BENCH_FLEET.json",
             heartbeat_every_s=0.3)
 
     def calibrate(addr) -> float:
-        """Closed-loop single-client rps — the capacity yardstick the
-        flood rates scale from."""
-        for _ in range(3):
-            binary_infer(addr, model, req, deadline_s=30.0)
-        n, t0 = 0, time.perf_counter()
-        while time.perf_counter() - t0 < 1.0:
-            binary_infer(addr, model, req, deadline_s=30.0)
-            n += 1
-        return n / (time.perf_counter() - t0)
+        return _calibrate_rps(addr, model, req)
 
     def open_load(addr, rps: float, secs: float,
                   deadline_s: float = 0.25,
                   priority: str | None = None,
                   tenant: str | None = None):
-        """Open-loop senders over the binary wire; returns (counts,
-        [(t_done, dt)] for served requests, hung). Every shed must be
-        TYPED; connection errors are drops and fail the arm's gate."""
-        conns = int(min(32, max(4, rps // 25)))
-        counts = {"ok": 0, "shed_429": 0, "shed_503": 0,
-                  "shed_priority": 0, "dropped": 0, "timed_out": 0,
-                  "errors_other": 0}
-        lats: list = []
-        lock = threading.Lock()
-        t_start = time.perf_counter()
-        t_stop = t_start + secs
-        period = conns / rps
+        return _open_load(addr, model, req, rps, secs,
+                          deadline_s=deadline_s, priority=priority,
+                          tenant=tenant)
 
-        def sender(j):
-            t_next = t_start + (j / conns) * period
-            while True:
-                now = time.perf_counter()
-                if now >= t_stop:
-                    return
-                if now < t_next:
-                    time.sleep(min(t_next - now, t_stop - now))
-                    continue
-                t0 = time.perf_counter()
-                try:
-                    binary_infer(addr, model, req, deadline_s=deadline_s,
-                                 timeout=10.0, priority=priority,
-                                 tenant=tenant)
-                    dt = time.perf_counter() - t0
-                    with lock:
-                        counts["ok"] += 1
-                        lats.append((time.perf_counter() - t_start, dt))
-                except PriorityShedError:
-                    with lock:
-                        counts["shed_priority"] += 1
-                except (TenantLimitError, QueueFullError):
-                    with lock:
-                        counts["shed_429"] += 1
-                except (DeadlineExpiredError, NoReplicaError):
-                    with lock:
-                        counts["shed_503"] += 1
-                except TimeoutError:
-                    with lock:
-                        counts["timed_out"] += 1
-                except ConnectionError:
-                    with lock:
-                        counts["dropped"] += 1
-                except Exception:
-                    with lock:
-                        counts["errors_other"] += 1
-                t_next += period
-                if t_next < time.perf_counter() - 5 * period:
-                    t_next = time.perf_counter()  # behind: shed schedule
-        ts = [threading.Thread(target=sender, args=(j,))
-              for j in range(conns)]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join(timeout=secs + 30.0)
-        hung = sum(t.is_alive() for t in ts)
-        return counts, lats, hung
-
-    def p99_ms(lats, t_from: float = 0.0):
-        xs = sorted(dt for t, dt in lats if t >= t_from)
-        if not xs:
-            return None
-        return round(xs[min(len(xs) - 1, int(0.99 * len(xs)))] * 1e3, 3)
+    p99_ms = _lat_p99_ms
 
     rows = []
 
@@ -1832,6 +1852,456 @@ def fleet_bench(out_path: str | None = "BENCH_FLEET.json",
     assert prio["low_shed_typed"], f"low priority never shed: {prio}"
     assert prio["high_never_priority_shed"], \
         f"high priority was admission-shed: {prio}"
+    if out_path:
+        from sparknet_tpu.obs import run_metadata
+        with open(out_path, "w") as f:
+            json.dump({"headline": out, "rows": rows,
+                       "meta": run_metadata()}, f, indent=1)
+    print(json.dumps(out))
+    return {"headline": out, "rows": rows}
+
+
+def fresh_train_child(cfg_path: str) -> None:
+    """The `--fresh` chaos arm's training half: one subprocess = one
+    virtual elastic CPU pod (XLA host-platform device count), training
+    lenet with commit_ts-stamped checkpoints every `save_every` rounds
+    into the store the serve fleet watches. Peers are self-simulated
+    heartbeats; at `drop_round` one peer's beat is backdated ("preempted
+    minutes ago") so the MembershipController runs a LIVE elastic resize
+    mid-run — while serving polls the same store. The parent kill -9s
+    THIS process mid-run (the training preemption) and relaunches it
+    with resume=true; the relaunch restores from the newest VERIFIED
+    checkpoint and the formerly dead peer beats fresh again (rejoin)."""
+    import json as _json
+
+    with open(cfg_path) as f:
+        c = _json.load(f)
+    workers = int(c["workers"])
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               f" --xla_force_host_platform_device_count="
+                               f"{max(8, workers)}").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from sparknet_tpu.apps.train_loop import train
+    from sparknet_tpu.data.dataset import ArrayDataset
+    from sparknet_tpu.obs.pod import worker_heartbeat_path
+    from sparknet_tpu.utils.config import ElasticConfig, RunConfig
+    from sparknet_tpu.utils.heartbeat import HeartbeatWriter
+    from sparknet_tpu.utils.logger import Logger
+    from sparknet_tpu.zoo import lenet
+
+    root, b, tau = c["root"], 16, 2
+    pod = os.path.join(root, "pod")
+    r = np.random.default_rng(0)
+    ds = ArrayDataset({
+        "data": r.standard_normal((1024, 1, 28, 28)).astype(np.float32),
+        "label": r.integers(0, 10, (1024, 1)).astype(np.int32)})
+    cfg = RunConfig(
+        model="lenet", n_devices=workers, local_batch=b, tau=tau,
+        max_rounds=int(c["rounds"]), eval_every=0, workdir=root,
+        checkpoint_dir=c["ckpt_dir"], checkpoint_every=int(c["save_every"]),
+        resume=bool(c.get("resume")),
+        pod_dir=pod, pod_port=0, heartbeat_every_s=0.0,
+        elastic=ElasticConfig(
+            enabled=True, expected_workers=workers, stale_after_s=30.0,
+            reprobe_backoff_s=0.05, dead_probes=2, poll_interval_s=0.0,
+            min_workers=1))
+    victim = workers - 1
+    hbs = {i: HeartbeatWriter(worker_heartbeat_path(pod, i),
+                              interval_s=0.0)
+           for i in range(1, workers)}
+    for hb in hbs.values():
+        # fresh beats up front: a resumed run re-adopts the peer the
+        # first launch's chaos killed (rejoin), instead of re-evicting a
+        # stale on-disk record
+        hb.beat(int(c.get("round0", 0)), status="ok", round_s=0.01,
+                force=True)
+    state = {"killed": False}
+    drop_round = c.get("drop_round")
+
+    def hook(rnd, st):
+        for i, hb in hbs.items():
+            if i == victim and state["killed"]:
+                continue
+            hb.beat(rnd, status="ok", round_s=0.01, data_wait_s=0.0,
+                    force=True)
+        if drop_round is not None and not state["killed"] and \
+                rnd >= drop_round:
+            state["killed"] = True
+            p = worker_heartbeat_path(pod, victim)
+            rec = _json.load(open(p))
+            rec["t"] -= 1e4  # "preempted minutes ago"
+            _json.dump(rec, open(p, "w"))
+
+    tag = "resume" if c.get("resume") else "first"
+    log = Logger(os.path.join(root, f"train_{tag}.log"), echo=False,
+                 jsonl_path=c["jsonl"])
+    try:
+        train(cfg, lenet(batch=b), ds, None, logger=log, round_hook=hook)
+    finally:
+        log.close()
+
+
+def fresh_bench(out_path: str | None = "BENCH_FRESH.json",
+                rounds: int = 40, save_every: int = 2,
+                train_workers: int = 4, max_batch: int = 8,
+                keep: str | None = None) -> dict:
+    """The r12 continuous-learning audit (writes BENCH_FRESH.json):
+    train and serve run COLOCATED against one checkpoint store, and the
+    train->serve loop must stay closed under chaos.
+
+    One arm, everything at once (the composition IS the claim):
+
+      - a training subprocess (a virtual elastic pod,
+        `--fresh-train-child`) commits commit_ts-stamped checkpoints
+        every `save_every` rounds; mid-run one of its simulated peers is
+        preempted, forcing a LIVE elastic resize through the store;
+      - a serve fleet (local canary lane + 2 subprocess replicas under
+        the FleetController) adopts each commit through the STAGGERED
+        rollout duty: canary -> wave(1 replica) -> wave(1 replica) ->
+        gate opens fleet-wide, every transition audit-logged;
+      - open-loop load runs THE WHOLE TIME at a fixed online SLO, with a
+        parallel response checker (finite outputs — the zero-CORRUPTED
+        gate) and a ~10 Hz freshness sampler (worst replica's
+        now - commit_ts of its serving step);
+      - mid-serve the parent kill -9s the TRAINING process (the
+        preemption window) and relaunches it; the relaunch resumes from
+        the newest verified checkpoint and commits keep flowing.
+
+    Hard gates: zero dropped/timed-out/hung/corrupted responses across
+    the whole window (preemption included); >= 3 completed staggered
+    rollouts with >= 3 audit-logged canary/wave transitions; the elastic
+    resize completed (eviction in the training JSONL); the resumed run
+    finished. Headline: the measured freshness p99. The CPU-box caveat
+    applies to the latency/freshness NUMBERS (train + 3 serve processes
+    + load on shared cores) — pod hardware tightens them; the loop
+    closure and zero-loss gates are structural truth."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from sparknet_tpu.fleet import (FleetConfig, FleetController,
+                                    FleetPolicy,
+                                    SubprocessReplicaProvider, write_gate)
+    from sparknet_tpu.net_api import JaxNet
+    from sparknet_tpu.serve import (BinaryFrontend, ModelRouter,
+                                    RouterConfig, ServeConfig,
+                                    binary_infer)
+    from sparknet_tpu.utils import checkpoint as ck
+    from sparknet_tpu.utils.heartbeat import read_heartbeat
+    from sparknet_tpu.utils.logger import Logger
+    from sparknet_tpu.zoo import lenet
+
+    model = "lenet"
+    slo_ms = 60.0
+    workdir = keep or tempfile.mkdtemp(prefix="fresh-bench-")
+    os.makedirs(workdir, exist_ok=True)
+    ckpt_dir = os.path.join(workdir, "ck")
+    gate_path = os.path.join(workdir, "ROLLOUT.json")
+    cache = os.path.join(workdir, "compile-cache")
+    logger = Logger(path=os.path.join(workdir, "fresh_bench.log"),
+                    echo=False,
+                    jsonl_path=os.path.join(workdir, "fresh_bench.jsonl"))
+    rng = np.random.default_rng(0)
+    req = {"data": rng.standard_normal((28, 28, 1)).astype(np.float32)}
+
+    # the gate exists BEFORE any replica comes up: the very first
+    # adoption is already staggered (no ungated race on rollout #1)
+    write_gate(gate_path, {"v": 1, "state": "idle", "wave": 0,
+                           "approved": {}, "denied": []})
+
+    def spawn_train(resume: bool) -> subprocess.Popen:
+        cfg_path = os.path.join(
+            workdir, f"train_{'resume' if resume else 'first'}.json")
+        with open(cfg_path, "w") as f:
+            json.dump({
+                "root": workdir, "ckpt_dir": ckpt_dir,
+                "jsonl": os.path.join(
+                    workdir,
+                    f"train_{'resume' if resume else 'first'}.jsonl"),
+                "workers": train_workers, "rounds": rounds,
+                "save_every": save_every, "resume": resume,
+                "drop_round": None if resume else max(4, rounds // 6),
+            }, f)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        out = open(os.path.join(
+            workdir,
+            f"train_{'resume' if resume else 'first'}.out"), "ab")
+        try:
+            return subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--fresh-train-child", cfg_path],
+                stdout=out, stderr=out, cwd=workdir, env=env)
+        finally:
+            out.close()
+
+    def train_resizes() -> list:
+        evs = []
+        for tag in ("first", "resume"):
+            p = os.path.join(workdir, f"train_{tag}.jsonl")
+            if not os.path.exists(p):
+                continue
+            for line in open(p):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("event") == "resize":
+                    evs.append({**rec, "arm": tag})
+        return evs
+
+    lane = ServeConfig(model_name=model, max_batch=max_batch,
+                       max_wait_ms=5.0, outputs=("prob",),
+                       slo_p99_ms=slo_ms, metrics_every_batches=0,
+                       compile_cache_dir=cache,
+                       checkpoint_dir=ckpt_dir, poll_interval_s=0.25,
+                       poll_jitter=0.2, replica_name="local",
+                       rollout_gate=gate_path)
+    prov = SubprocessReplicaProvider(
+        {model: "lenet"}, workdir=os.path.join(workdir, "replicas"),
+        max_batch=max_batch, compile_cache_dir=cache,
+        heartbeat_every_s=0.3, checkpoint_dir=ckpt_dir,
+        poll_interval_s=0.25, poll_jitter=0.2, rollout_gate=gate_path)
+    router = ModelRouter(
+        RouterConfig(workers=2, stale_after_s=1.5, health_refresh_s=0.2,
+                     conn_fail_cooldown_s=2.0), logger=logger)
+    router.add_model(model, JaxNet(lenet(batch=max_batch)), cfg=lane)
+    fc = FleetController(
+        router, provider=prov,
+        cfg=FleetConfig(interval_s=0.25, window_s=6.0, min_replicas=3,
+                        max_replicas=3, up_cooldown_s=0.5,
+                        down_cooldown_s=120.0, drain_grace_s=1.0,
+                        dead_ticks=4, status_row_every=8,
+                        policy=FleetPolicy(
+                            up_ticks=2, down_ticks=100, min_window_n=16,
+                            rollout_wave_size=1,
+                            # burn halts are unit-tested; on a shared-core
+                            # CPU box a transient burn must not deny a
+                            # GOOD step mid-soak
+                            rollout_halt_burn=50.0,
+                            rollout_timeout_s=25.0)),
+        logger=logger)
+
+    mgr = router.lanes[model].manager
+    samples: list = []          # (t, {replica: freshness_s}, worst)
+    steps_seen: set = set()
+    corrupt = {"n": 0, "checked": 0}
+    stop_ev = threading.Event()
+    loads = {"counts": {"ok": 0, "shed_429": 0, "shed_503": 0,
+                        "shed_priority": 0, "dropped": 0, "timed_out": 0,
+                        "errors_other": 0},
+             "lats": [], "hung": 0}
+
+    def sampler():
+        t0 = time.perf_counter()
+        while not stop_ev.is_set():
+            per = {}
+            f = mgr.freshness_s()
+            if f is not None:
+                per["local"] = f
+            if mgr.step is not None:
+                steps_seen.add(mgr.step)
+            for rep, handle in list(fc._owned.get(model, ())):
+                hb = read_heartbeat(handle.heartbeat_path)
+                row = ((hb or {}).get("models") or {}).get(model) or {}
+                if row.get("freshness_s") is not None:
+                    # heartbeat freshness ages between beats; the beat
+                    # cadence (0.3 s) bounds the error
+                    per[handle.meta.get("tag", rep.name)] = \
+                        row["freshness_s"]
+            if per:
+                samples.append((round(time.perf_counter() - t0, 3), per,
+                                max(per.values())))
+            stop_ev.wait(0.1)
+
+    def checker(addr):
+        while not stop_ev.is_set():
+            try:
+                out = binary_infer(addr, model, req, deadline_s=5.0,
+                                   timeout=10.0)
+                corrupt["checked"] += 1
+                if not all(np.isfinite(v).all() for v in out.values()):
+                    corrupt["n"] += 1
+            except Exception:
+                pass  # sheds are the load arm's ledger, not corruption
+            stop_ev.wait(0.05)
+
+    def load_pump(addr, rps):
+        while not stop_ev.is_set():
+            c, l, h = _open_load(addr, model, req, rps, 3.0)
+            off = len(loads["lats"]) and loads["lats"][-1][0] or 0.0
+            for k, v in c.items():
+                loads["counts"][k] += v
+            loads["lats"].extend((off + t, dt) for t, dt in l)
+            loads["hung"] += h
+
+    rollout_audit: list = []
+    ro_status: dict = {}
+    threads: list = []
+    rates = {"base_rps": None, "rps": None}
+    train_first = train_resume = None
+    t_kill_s = None
+    try:
+        with router:
+            bfe = BinaryFrontend(router, port=0, logger=logger)
+            try:
+                fc.start()
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < 180 and \
+                        len(router.replicas[model]) < 3:
+                    time.sleep(0.2)  # min-bound grow brings children up
+                assert len(router.replicas[model]) == 3, \
+                    "fleet never reached 3 replicas (local + 2 children)"
+                base_rps = _calibrate_rps(bfe.address, model, req)
+                rps = min(40.0, max(8.0, 0.5 * base_rps))
+                rates.update(base_rps=round(base_rps, 1),
+                             rps=round(rps, 1))
+
+                train_first = spawn_train(resume=False)
+                t_serve0 = time.monotonic()
+                threads = [threading.Thread(target=sampler),
+                           threading.Thread(target=checker,
+                                            args=(bfe.address,)),
+                           threading.Thread(target=load_pump,
+                                            args=(bfe.address, rps))]
+                for t in threads:
+                    t.start()
+
+                def ro():
+                    return fc._rollouts.get(model)
+
+                # kill -9 the TRAINER once adoption is demonstrably
+                # staggered AND its own elastic resize has fired
+                deadline = time.monotonic() + 240
+                while time.monotonic() < deadline:
+                    r_ = ro()
+                    if r_ is not None and r_.rollouts >= 2 and \
+                            train_resizes() and \
+                            train_first.poll() is None:
+                        break
+                    if train_first.poll() is not None:
+                        break  # trainer already finished: kill moot
+                    time.sleep(0.25)
+                assert train_first.poll() is None, \
+                    "trainer finished before the preemption window " \
+                    "(raise --fresh-rounds)"
+                train_first.send_signal(signal.SIGKILL)
+                train_first.wait(timeout=30.0)
+                t_kill_s = round(time.monotonic() - t_serve0, 2)
+                time.sleep(1.5)  # serve rides through the dead trainer
+
+                train_resume = spawn_train(resume=True)
+                rc = train_resume.wait(timeout=600.0)
+                assert rc == 0, f"resumed trainer exited {rc}"
+
+                # let the fleet adopt the final commit
+                final_step = ck.newest_verified_step(ckpt_dir)
+                deadline = time.monotonic() + 45
+                while time.monotonic() < deadline:
+                    r_ = ro()
+                    if mgr.step == final_step and r_ is not None and \
+                            r_.state == "idle":
+                        break
+                    time.sleep(0.25)
+            finally:
+                stop_ev.set()
+                for t in threads:
+                    t.join(timeout=60.0)
+                rollout_audit = [a for a in fc.audit
+                                 if a.get("direction") == "rollout"]
+                ro_status = (fc._rollouts[model].status()
+                             if model in fc._rollouts else {})
+                fc.stop()
+                bfe.stop()
+    finally:
+        for proc in (train_first, train_resume):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+        prov.stop()
+        logger.close()
+
+    counts, hung = loads["counts"], loads["hung"]
+    resizes = train_resizes()
+    evictions = [e for e in resizes if e.get("dead")]
+    wave_events = [a for a in rollout_audit
+                   if a.get("reason") in ("canary", "wave")]
+    worst = [w for _, _, w in samples]
+    fresh_p99 = (round(sorted(worst)[min(len(worst) - 1,
+                                         int(0.99 * len(worst)))], 3)
+                 if worst else None)
+    rows = [
+        {"load": "fresh_serve", "offered_rps": rates["rps"],
+         "base_rps": rates["base_rps"], **counts,
+         "hung_clients": hung, "corrupted": corrupt["n"],
+         "responses_checked": corrupt["checked"],
+         "p99_ms": _lat_p99_ms(loads["lats"]), "slo_p99_ms": slo_ms,
+         "zero_dropped": (counts["dropped"] == 0
+                          and counts["timed_out"] == 0 and hung == 0
+                          and corrupt["n"] == 0)},
+        {"load": "freshness", "samples": len(samples),
+         "freshness_p99_s": fresh_p99,
+         "freshness_max_s": round(max(worst), 3) if worst else None,
+         "steps_served_local": sorted(steps_seen),
+         "local_swaps": mgr.swaps, "local_rollbacks": mgr.swap_failures},
+        {"load": "rollout", **ro_status,
+         "wave_events": len(wave_events),
+         "audit_tail": rollout_audit[-24:]},
+        {"load": "preemption", "t_kill_s": t_kill_s,
+         "train_resumed": True,
+         "final_committed_step": ck.newest_verified_step(ckpt_dir),
+         "resize_events": len(resizes),
+         "evictions": [{k: e.get(k) for k in ("step", "dead",
+                                              "n_workers", "arm")}
+                       for e in evictions]},
+    ]
+    p99_online = rows[0]["p99_ms"]
+    within = p99_online is not None and p99_online <= slo_ms
+    out = {
+        "metric": "train_serve_freshness_p99_s",
+        "value": fresh_p99,
+        "unit": "p99 age (s) of the worst replica's serving checkpoint "
+                "(now - commit_ts), ~10 Hz samples under continuous "
+                "online load with a mid-run trainer kill -9",
+        "slo_p99_ms": slo_ms,
+        "online_p99_ms": p99_online,
+        "online_p99_within_slo": within,
+        # 4 processes + load generators share this box's cores; the
+        # freshness/latency NUMBERS are pod truth, the closed loop and
+        # the zero-loss gates are proven here
+        "structure_proof": not within,
+        "rollouts_completed": ro_status.get("rollouts"),
+        "waves_done": ro_status.get("waves_done"),
+        "halts": ro_status.get("halts"),
+        "wave_events_audited": len(wave_events),
+        "steps_served_local": sorted(steps_seen),
+        "zero_dropped": rows[0]["zero_dropped"],
+        "elastic_resize_completed": bool(evictions),
+        "preemption": {"t_kill_s": t_kill_s,
+                       "resumed": True,
+                       "final_step": rows[3]["final_committed_step"]},
+    }
+    assert rows[0]["zero_dropped"], \
+        f"responses lost/corrupted through the soak: {rows[0]}"
+    assert (ro_status.get("rollouts") or 0) >= 3, \
+        f"fewer than 3 completed staggered rollouts: {ro_status}"
+    assert len(wave_events) >= 3, \
+        f"fewer than 3 audit-logged canary/wave transitions: " \
+        f"{rollout_audit}"
+    assert len(steps_seen) >= 3, \
+        f"local lane served < 3 distinct steps: {sorted(steps_seen)}"
+    assert evictions, \
+        f"training-side elastic resize never completed: {resizes}"
+    assert samples, "freshness sampler collected nothing"
+    if not keep:
+        shutil.rmtree(workdir, ignore_errors=True)
     if out_path:
         from sparknet_tpu.obs import run_metadata
         with open(out_path, "w") as f:
@@ -3127,6 +3597,17 @@ def main() -> None:
                    "-> replica scale-up, quiet shrink (zero-dropped "
                    "drain), kill -9 replica replacement, mixed-priority "
                    "overload with SLO-burn shedding; writes BENCH_FLEET")
+    p.add_argument("--fresh", action="store_true",
+                   help="r12 continuous-learning audit: colocated "
+                   "train+serve, staggered rollout adoption of every "
+                   "commit, mid-run trainer kill -9 + resume, freshness "
+                   "p99 under online load; writes BENCH_FRESH")
+    p.add_argument("--fresh-rounds", type=int, default=40,
+                   help="training rounds for --fresh (CI short config "
+                   "uses fewer)")
+    p.add_argument("--fresh-train-child", metavar="CFG_JSON",
+                   default=None,
+                   help=argparse.SUPPRESS)  # the --fresh training child
     p.add_argument("--econ", action="store_true",
                    help="r9 inference-economics audit: quantized-vs-f32 "
                    "serve throughput + parity, cold-start with a warm "
@@ -3187,6 +3668,11 @@ def main() -> None:
         checkpoint_stall(mb=args.ckpt_mb)
     elif args.econ_child:
         econ_coldstart_child(args.econ_child)
+    elif args.fresh_train_child:
+        fresh_train_child(args.fresh_train_child)
+    elif args.fresh:
+        fresh_bench(rounds=args.fresh_rounds,
+                    max_batch=args.batch or 8, keep=args.keep)
     elif args.econ:
         econ_bench(duration_s=args.serve_secs,
                    max_batch=args.batch or 8, keep=args.keep)

@@ -1452,8 +1452,15 @@ def _save_checkpoint(cfg: RunConfig, trainer, state, step: int,
         #         every process persists its own shard files
 
     def persist() -> None:
+        # publish instant from the TRAINING loop's side: persist() runs
+        # at the head of stage 2 (inline or on the writer thread), so
+        # this is when the weights left the round loop. checkpoint.py
+        # re-stamps the authoritative top-level commit_ts at meta-write
+        # time; the serve fleet's freshness metric keys off that one,
+        # this tag survives in extra for commit-latency forensics.
         extra = {"n_devices": trainer.n_devices,
-                 "tp": getattr(trainer, "tp", 1)}
+                 "tp": getattr(trainer, "tp", 1),
+                 "publish_t": round(time.time(), 3)}
         layout = getattr(trainer, "state_layout", "replica")
         if layout != "replica":
             # NamedSharding trainer: logical leaves (no [n_devices] axis).

@@ -20,17 +20,25 @@ a `ModelRouter` fleet:
     over spkn:// (CPU truth: tests + `bench.py --fleet`);
     `PodReplicaProvider` is the `tpu_pod_launch.sh`-protocol stub for
     TPU VMs.
+  - `RolloutManager` (rollout.py): the continuous-learning rollout
+    duty — staggered checkpoint adoption (canary -> health-gated waves
+    -> fleet-wide) coordinated through an atomically-replaced
+    ROLLOUT.json gate the serving ModelManagers obey, with
+    halt-and-rollback on a rejected canary step.
 
-Enable from the CLI with `sparknet-serve --models ... --autoscale`.
+Enable from the CLI with `sparknet-serve --models ... --autoscale`
+(+ `--rollout-gate` for staggered adoption).
 """
 from .controller import FleetConfig, FleetController
 from .policy import FleetPolicy, ModelSignals, slo_burn
 from .provider import (PodReplicaProvider, ReplicaHandle,
                        ReplicaProvider, SubprocessReplicaProvider)
+from .rollout import ReplicaView, RolloutManager, read_gate, write_gate
 
 __all__ = [
     "FleetController", "FleetConfig",
     "FleetPolicy", "ModelSignals", "slo_burn",
     "ReplicaProvider", "ReplicaHandle",
     "SubprocessReplicaProvider", "PodReplicaProvider",
+    "RolloutManager", "ReplicaView", "read_gate", "write_gate",
 ]

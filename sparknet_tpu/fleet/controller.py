@@ -50,9 +50,11 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..utils.heartbeat import read_heartbeat
 from ..utils.logger import Logger
 from .policy import FleetPolicy, ModelSignals
 from .provider import ReplicaHandle, ReplicaProvider
+from .rollout import ReplicaView, RolloutManager
 
 
 @dataclass
@@ -158,6 +160,10 @@ class FleetController:
         self.pressure = 0.0
         self.ticks = 0
         self.scale_events = 0
+        # rollout duty: one wave sequencer per model whose local lane
+        # watches a checkpoint dir through a rollout gate (lazily built
+        # on the first tick that sees such a lane)
+        self._rollouts: Dict[str, RolloutManager] = {}
         self.audit: deque = deque(maxlen=200)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -305,6 +311,7 @@ class FleetController:
             for model, sig in sigs.items():
                 self._scale_model(model, sigs[model], now)
         self._scale_pool(sigs, now)
+        self._rollout_duty(sigs, now)
         # POST-action counts: the gauge a grow lands in shows the grown
         # fleet, not the pre-grow signal snapshot
         for model in sigs:
@@ -472,6 +479,56 @@ class FleetController:
             self.router.set_pool_size(target - 1)
             self._event("_pool", "down", "quiet", pool=target - 1)
 
+    # -- rollout duty --------------------------------------------------------
+
+    def _rollout_duty(self, sigs: Dict[str, ModelSignals],
+                      now: float) -> None:
+        """Staggered checkpoint adoption (fleet/rollout.py): for each
+        model whose LOCAL lane watches a checkpoint dir through a
+        rollout gate, feed the sequencer this tick's adoption views —
+        the lane's manager read directly (it doubles as the canary,
+        first in the list), the provider-owned children through their
+        heartbeats' per-model rows — plus the newest committed step and
+        the model's SLO burn (the wave health gate)."""
+        for model in sigs:
+            lane = self.router.lanes.get(model)
+            mgr = getattr(lane, "manager", None) if lane is not None \
+                else None
+            if mgr is None or not getattr(mgr, "rollout_gate", None) \
+                    or not mgr.checkpoint_dir:
+                continue
+            ro = self._rollouts.get(model)
+            if ro is None:
+                ro = RolloutManager(
+                    mgr.rollout_gate,
+                    wave_size=self.policy.rollout_wave_size,
+                    halt_burn=self.policy.rollout_halt_burn,
+                    timeout_s=self.policy.rollout_timeout_s,
+                    event=(lambda direction, reason, _m=model, **ex:
+                           self._event(_m, direction, reason, **ex)),
+                    logger=self.log)
+                self._rollouts[model] = ro
+            st = self._state.get(model)
+            ro.tick(self._rollout_views(model, mgr), mgr.latest_seen,
+                    st.burn if st else 0.0, now)
+
+    def _rollout_views(self, model: str, mgr) -> List[ReplicaView]:
+        views = [ReplicaView(mgr.replica, mgr.step, mgr.swap_failures)]
+        for rep, handle in self._owned.get(model, []):
+            key = (getattr(handle, "meta", None) or {}).get("tag",
+                                                            rep.name)
+            step = None
+            rollbacks = 0
+            hb = (read_heartbeat(handle.heartbeat_path)
+                  if handle.heartbeat_path else None)
+            if hb:
+                row = (hb.get("models") or {}).get(model) or {}
+                step = row.get("model_step", row.get("step"))
+                rollbacks = int(row.get("swap_failures",
+                                        hb.get("rollbacks", 0)) or 0)
+            views.append(ReplicaView(key, step, rollbacks))
+        return views
+
     # -- bookkeeping ---------------------------------------------------------
 
     def _event(self, model: str, direction: str, reason: str,
@@ -487,9 +544,13 @@ class FleetController:
             # "t" stays out of the kv: Logger.metrics stamps its own
             # run-relative t (+ wall-clock ts) on every record, and the
             # audit entry's epoch t would clobber the timeline key
-            self.log.metrics(self.ticks, event="fleet_scale",
-                             **{k: v for k, v in entry.items()
-                                if k not in ("tick", "t")})
+            kv = {k: v for k, v in entry.items()
+                  if k not in ("tick", "t")}
+            if "step" in kv:
+                # rollout events carry the checkpoint step; Logger.metrics
+                # reserves "step" for its positional (the tick counter)
+                kv["ckpt_step"] = kv.pop("step")
+            self.log.metrics(self.ticks, event="fleet_scale", **kv)
 
     def _log(self, msg: str) -> None:
         if self.log is not None:
@@ -552,6 +613,9 @@ class FleetController:
             "scale_events": self.scale_events,
             "audit": list(self.audit)[-20:],
         }
+        if self._rollouts:
+            out["rollout"] = {m: ro.status()
+                              for m, ro in self._rollouts.items()}
         if self.admission is not None and \
                 hasattr(self.admission, "status"):
             out["admission"] = self.admission.status()

@@ -85,6 +85,14 @@ class FleetPolicy:
     # pressure_start burn to 1 at pressure_full burn
     pressure_start: float = 1.0
     pressure_full: float = 2.0
+    # rollout duty (fleet/rollout.py): staggered checkpoint adoption.
+    # After the canary, at most rollout_wave_size replicas swap per
+    # wave; a wave only opens while SLO burn sits under
+    # rollout_halt_burn, and a phase that hasn't fully adopted within
+    # rollout_timeout_s halts the rollout (deny + revert).
+    rollout_wave_size: int = 2
+    rollout_halt_burn: float = 1.5
+    rollout_timeout_s: float = 30.0
 
     def __post_init__(self) -> None:
         # fail at construction, not mid-control-loop (the ElasticConfig
@@ -106,6 +114,13 @@ class FleetPolicy:
             raise ValueError(
                 f"pressure_full ({self.pressure_full}) must exceed "
                 f"pressure_start ({self.pressure_start})")
+        if self.rollout_wave_size < 1:
+            raise ValueError(f"rollout_wave_size must be >= 1 (got "
+                             f"{self.rollout_wave_size})")
+        if self.rollout_halt_burn <= 0 or self.rollout_timeout_s <= 0:
+            raise ValueError(
+                f"rollout_halt_burn/rollout_timeout_s must be > 0 (got "
+                f"{self.rollout_halt_burn}, {self.rollout_timeout_s})")
 
     # -- signal -> verdict ---------------------------------------------------
 

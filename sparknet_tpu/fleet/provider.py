@@ -83,7 +83,13 @@ class SubprocessReplicaProvider(ReplicaProvider):
     .prototxt path — exactly the `sparknet-serve --model` argument).
     Children write fast heartbeats (`heartbeat_every_s`) so the
     router's staleness rule sees a kill -9 promptly, and serve prob-only
-    outputs at `max_batch` unless overridden via `extra_args`."""
+    outputs at `max_batch` unless overridden via `extra_args`.
+
+    Continuous learning: with `checkpoint_dir` set (a path/URL, `{model}`
+    substituted), children watch the training store and hot-swap; each
+    gets its provider tag as `--replica-name` — the identity the rollout
+    gate (`rollout_gate`, when set) approves steps under — plus the
+    fleet-shared `poll_interval_s`/`poll_jitter` cadence."""
 
     def __init__(self, sources: Dict[str, str],
                  workdir: Optional[str] = None,
@@ -93,7 +99,11 @@ class SubprocessReplicaProvider(ReplicaProvider):
                  heartbeat_every_s: float = 0.5,
                  spawn_timeout_s: float = 120.0,
                  extra_args: Sequence[str] = (),
-                 python: str = sys.executable):
+                 python: str = sys.executable,
+                 checkpoint_dir: Optional[str] = None,
+                 poll_interval_s: Optional[float] = None,
+                 poll_jitter: Optional[float] = None,
+                 rollout_gate: Optional[str] = None):
         self.sources = dict(sources)
         self.workdir = workdir or tempfile.mkdtemp(
             prefix="sparknet-fleet-")
@@ -105,6 +115,10 @@ class SubprocessReplicaProvider(ReplicaProvider):
         self.spawn_timeout_s = float(spawn_timeout_s)
         self.extra_args = tuple(extra_args)
         self.python = python
+        self.checkpoint_dir = checkpoint_dir
+        self.poll_interval_s = poll_interval_s
+        self.poll_jitter = poll_jitter
+        self.rollout_gate = rollout_gate
         self._n = 0
         self._procs: List[subprocess.Popen] = []
 
@@ -128,6 +142,17 @@ class SubprocessReplicaProvider(ReplicaProvider):
             cmd += ["--outputs", ",".join(self.outputs)]
         if self.compile_cache_dir:
             cmd += ["--compile-cache", self.compile_cache_dir]
+        if self.checkpoint_dir:
+            cmd += ["--checkpoint-dir",
+                    self.checkpoint_dir.replace("{model}", model),
+                    "--replica-name", tag]
+            if self.poll_interval_s is not None:
+                cmd += ["--poll-interval", str(self.poll_interval_s)]
+            if self.poll_jitter is not None:
+                cmd += ["--poll-jitter", str(self.poll_jitter)]
+            if self.rollout_gate:
+                cmd += ["--rollout-gate",
+                        self.rollout_gate.replace("{model}", model)]
         cmd += list(self.extra_args)
         # the child must resolve sparknet_tpu however THIS process did
         # (editable install, or a bare checkout run from the repo root)

@@ -688,7 +688,12 @@ def format_pod_table(status: Dict[str, Any]) -> str:
         for name in sorted(w.get("models") or ()):
             m = w["models"][name] or {}
             parts = [f"model={name}"]
-            for k, fmt in (("step", "step={}"), ("queue_depth", "q={}"),
+            # freshness (commit age of the serving step) and step lag
+            # ride the heartbeat model rows — staleness per replica
+            # WITHOUT a /metrics scrape
+            for k, fmt in (("step", "step={}"), ("freshness_s",
+                           "fresh={}s"), ("step_lag", "lag={}"),
+                           ("queue_depth", "q={}"),
                            ("p99_ms", "p99={}ms"),
                            ("requests_ok", "ok={}"),
                            ("requests_shed", "shed={}"),

@@ -126,10 +126,54 @@ def summarize(recs: List[Dict[str, Any]], tail: int = 10) -> Dict[str, Any]:
     serve = _serve_view(recs)
     if serve is not None:
         out["serve"] = serve
+    fresh = _fresh_view(recs)
+    if fresh is not None:
+        out["freshness"] = fresh
     fleet = _fleet_view(recs)
     if fleet is not None:
         out["fleet"] = fleet
     return out
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    """Nearest-rank percentile (ceil rank) over a non-empty list."""
+    ys = sorted(xs)
+    return ys[min(len(ys) - 1, max(0, math.ceil(q * len(ys)) - 1))]
+
+
+def _fresh_view(recs: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Train->serve freshness: the age of each model's SERVING step
+    (`freshness_s` = now - the checkpoint's meta.json commit_ts, which
+    serve metrics rows carry once a stamped checkpoint installs), the
+    distinct steps the replica actually served, and how far it trailed
+    the newest committed step (`model_step_lag`). This is the post-hoc
+    freshness-SLO answer — `bench.py --fresh` stamps the same p99 into
+    BENCH_FRESH.json from live samples. None when no row carries
+    freshness (no continuous-learning serve run in the records)."""
+    rows = [r for r in recs if r.get("freshness_s") is not None]
+    if not rows:
+        return None
+    models: Dict[str, Any] = {}
+    for r in rows:
+        m = models.setdefault(str(r.get("model", "default")), {
+            "samples": 0, "_fresh": [], "_steps": set()})
+        m["samples"] += 1
+        m["_fresh"].append(float(r["freshness_s"]))
+        if r.get("model_step") is not None:
+            m["_steps"].add(int(r["model_step"]))
+        if r.get("model_step_lag") is not None:
+            m["step_lag_max"] = max(m.get("step_lag_max", 0),
+                                    int(r["model_step_lag"]))
+        if r.get("swaps") is not None:
+            # cumulative per process; max = the final count
+            m["swaps"] = max(m.get("swaps", 0), int(r["swaps"]))
+    for m in models.values():
+        xs = m.pop("_fresh")
+        m["steps_served"] = sorted(m.pop("_steps"))
+        m["freshness_last_s"] = round(xs[-1], 3)
+        m["freshness_p99_s"] = round(_percentile(xs, 0.99), 3)
+        m["freshness_max_s"] = round(max(xs), 3)
+    return {"models": models}
 
 
 def _fleet_view(recs: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
@@ -355,6 +399,23 @@ def format_text(s: Dict[str, Any]) -> str:
             for sz, n in hist.items():
                 bar = "#" * max(1, round(24 * n / peak)) if peak else ""
                 lines.append(f"    batch size {sz:>4}  {n:>8}  {bar}")
+    fresh = s.get("freshness")
+    if fresh:
+        lines.append("")
+        lines.append("freshness view (train->serve commit age of the "
+                     "serving step):")
+        for name, m in sorted(fresh["models"].items()):
+            steps = m["steps_served"]
+            shown = (", ".join(str(x) for x in steps) if len(steps) <= 8
+                     else f"{steps[0]}..{steps[-1]} ({len(steps)} steps)")
+            lines.append(
+                f"  model {name}: p99 {m['freshness_p99_s']:.3f} s  "
+                f"max {m['freshness_max_s']:.3f} s  "
+                f"last {m['freshness_last_s']:.3f} s  "
+                f"({m['samples']} samples)")
+            lines.append(
+                f"    steps served: {shown}  swaps {m.get('swaps', 0)}  "
+                f"max step lag {m.get('step_lag_max', 0)}")
     fleet = s.get("fleet")
     if fleet:
         lines.append("")
@@ -516,16 +577,20 @@ def _selfcheck_fleet_jsonl(root: str) -> str:
 
 def _selfcheck_serve_jsonl(root: str) -> str:
     """Run a tiny live InferenceServer (lenet, CPU) against a short
-    synthetic request trace and return the serve metrics JSONL it wrote —
-    the freshest possible serve schema, so the request-size-histogram
-    section (the `--buckets-from` input) cannot rot against the live
-    logger without failing the selfcheck."""
+    synthetic request trace — watching a real checkpoint dir it initial-
+    loads from and hot-swaps against — and return the serve metrics
+    JSONL it wrote: the freshest possible serve schema, so the
+    request-size-histogram section (the `--buckets-from` input) AND the
+    freshness view (commit-age rows from commit_ts-stamped checkpoints)
+    cannot rot against the live logger without failing the selfcheck."""
     import os
+    import time as _time
 
     import numpy as np
 
     from ..net_api import JaxNet
     from ..serve import InferenceServer, ServeConfig
+    from ..utils import checkpoint as ckpt
     from ..utils.logger import Logger
     from ..zoo import lenet
 
@@ -533,13 +598,27 @@ def _selfcheck_serve_jsonl(root: str) -> str:
     log = Logger(os.path.join(root, "selfcheck_serve_log.txt"),
                  echo=False, jsonl_path=jsonl)
     net = JaxNet(lenet(batch=4))
+
+    def save_step(step):
+        flat = {f"params/{ln}/{pn}": np.asarray(w)[None]
+                for ln, lp in net.params.items() for pn, w in lp.items()}
+        ckpt.save(os.path.join(root, "selfcheck_serve_ckpt"), flat,
+                  step=step)
+
+    save_step(1)  # initial load: freshness rows from the first batch on
     cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, buckets=(1, 4),
-                      outputs=("prob",), metrics_every_batches=1)
+                      outputs=("prob",), metrics_every_batches=1,
+                      checkpoint_dir=os.path.join(root,
+                                                  "selfcheck_serve_ckpt"),
+                      poll_interval_s=0.05, poll_jitter=0.0)
     r = np.random.default_rng(0)
     req = {"data": r.standard_normal((28, 28, 1)).astype(np.float32)}
     try:
         with InferenceServer(net, cfg, logger=log) as srv:
             srv.infer(req)                     # a size-1 batch
+            save_step(2)                       # a commit lands mid-serve
+            # force one due poll (deterministic: no sleep-for-the-duty)
+            srv.manager.poll(now=_time.monotonic() + 1.0)
             for f in [srv.submit(req) for _ in range(4)]:  # a size-4 one
                 f.result(timeout=60.0)
     finally:
@@ -607,6 +686,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.selfcheck and not (s.get("serve") or {}).get("models"):
         print("selfcheck: serve run produced no request-size histogram "
               "(the --buckets-from input)", file=sys.stderr)
+        return 1
+    if args.selfcheck and not (s.get("freshness") or {}).get("models"):
+        print("selfcheck: serve run produced no freshness rows (the "
+              "train->serve commit-age view's input)", file=sys.stderr)
         return 1
     if args.selfcheck and not (s.get("fleet") or {}).get("scale_events"):
         print("selfcheck: fleet run produced no scale-event audit "

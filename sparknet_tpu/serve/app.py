@@ -154,6 +154,20 @@ def main(argv=None) -> None:
                    "e.g. gs://bkt/runs/{model}/ck")
     p.add_argument("--poll-interval", type=float, default=2.0,
                    help="seconds between checkpoint-dir polls")
+    p.add_argument("--poll-jitter", type=float, default=0.1,
+                   help="± fraction of --poll-interval each poll "
+                   "deadline is jittered by (de-synchronizes a fleet of "
+                   "replicas watching one bucket; default 0.1)")
+    p.add_argument("--replica-name", default="local",
+                   help="fleet identity: the rollout-gate key and the "
+                   "replica label on freshness gauges (providers pass "
+                   "their tag)")
+    p.add_argument("--rollout-gate", default=None, metavar="PATH",
+                   help="obey the fleet rollout duty's ROLLOUT.json at "
+                   "this path (local or gs://|s3://): only adopt "
+                   "checkpoint steps approved for --replica-name. In "
+                   "--models mode: a {model} template. Missing gate = "
+                   "ungated polling")
     p.add_argument("--n-classes", type=int, default=10)
     p.add_argument("--crop", type=int, default=None)
     p.add_argument("--max-batch", type=int, default=8)
@@ -307,12 +321,16 @@ def main(argv=None) -> None:
         # explicit --buckets wins; then the model's derived ladder, then
         # the merged-traffic ladder, then pow2
         lane_buckets = buckets or derived.get(name) or derived.get(None)
+        gate = (args.rollout_gate.replace("{model}", name)
+                if args.rollout_gate else None)
         return ServeConfig(
             model_name=name, max_batch=args.max_batch,
             max_wait_ms=args.max_wait_ms, buckets=lane_buckets,
             slo_p99_ms=args.slo_p99_ms, outputs=outputs,
             checkpoint_dir=checkpoint_dir,
             poll_interval_s=args.poll_interval,
+            poll_jitter=args.poll_jitter,
+            replica_name=args.replica_name, rollout_gate=gate,
             canary=not args.no_canary, quant=quant,
             compile_cache_dir=args.compile_cache)
 
@@ -358,10 +376,17 @@ def main(argv=None) -> None:
                              SubprocessReplicaProvider)
         provider = None
         if args.fleet_provider == "subprocess":
+            # grown children join the continuous-learning loop: same
+            # checkpoint store + rollout gate as the local lanes, each
+            # under its own provider tag (--replica-name)
             provider = SubprocessReplicaProvider(
                 dict(sources), max_batch=args.max_batch,
                 outputs=outputs or ("prob",),
-                compile_cache_dir=args.compile_cache)
+                compile_cache_dir=args.compile_cache,
+                checkpoint_dir=args.checkpoint_dir,
+                poll_interval_s=args.poll_interval,
+                poll_jitter=args.poll_jitter,
+                rollout_gate=args.rollout_gate)
         cfg = FleetConfig(interval_s=args.fleet_interval,
                           window_s=args.fleet_window,
                           min_replicas=args.fleet_min,

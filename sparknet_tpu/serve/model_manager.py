@@ -32,6 +32,7 @@ training pod watches a serving process.
 """
 from __future__ import annotations
 
+import random
 import time
 from typing import Any, Callable, Dict, Optional
 
@@ -127,7 +128,9 @@ class ModelManager:
                  bad_step_retry_s: float = 30.0, registry=None,
                  model: str = "default",
                  quant: Optional[QuantConfig] = None,
-                 parity_batch: Optional[Dict[str, np.ndarray]] = None):
+                 parity_batch: Optional[Dict[str, np.ndarray]] = None,
+                 replica: str = "local", poll_jitter: float = 0.0,
+                 rollout_gate: Optional[str] = None):
         if checkpoint_dir and not hasattr(net, "params"):
             raise ServeModelError(
                 "checkpoint hot-reload needs a layer-IR JaxNet (exposes "
@@ -167,7 +170,32 @@ class ModelManager:
         self.heartbeat = heartbeat
         self.bad_step_retry_s = float(bad_step_retry_s)
         self.model = str(model)
+        #: fleet identity — the key this replica looks itself up under in
+        #: a rollout gate's approval map, and the `replica` label on the
+        #: freshness gauges (provider tag for subprocess replicas,
+        #: "local" for an in-process lane)
+        self.replica = str(replica)
+        #: ± fraction of poll_interval_s each poll's NEXT deadline is
+        #: jittered by (per-instance RNG): N replicas watching one bucket
+        #: must not list it in lockstep on every commit (thundering herd)
+        self.poll_jitter = float(poll_jitter)
+        if not 0.0 <= self.poll_jitter < 1.0:
+            raise ValueError(f"poll_jitter must be in [0, 1), "
+                             f"got {poll_jitter}")
+        #: optional ROLLOUT.json gate path (local or gs://|s3://): when
+        #: present and readable, this replica only adopts the step the
+        #: fleet rollout duty approved FOR IT (fleet/rollout.py writes
+        #: it); missing gate = ungated independent polling (back-compat)
+        self.rollout_gate = rollout_gate
+        self._rng = random.Random()
         self.step: Optional[int] = None   # served checkpoint step
+        #: wall-clock commit instant (meta.json commit_ts) of the SERVING
+        #: step — freshness_s = now - this. None until a stamped
+        #: checkpoint installs (initial weights / pre-r12 checkpoints).
+        self.commit_ts: Optional[float] = None
+        #: newest COMMITTED step the poll loop has seen in the store —
+        #: step lag = latest_seen - step (how far behind this replica is)
+        self.latest_seen: Optional[int] = None
         self.swaps = 0                    # successful hot swaps
         self.swap_failures = 0            # rejected or rolled-back swaps
         self.last_error: Optional[str] = None
@@ -192,6 +220,22 @@ class ModelManager:
                 labels=("model",)
             ).set_fn(lambda: -1 if self.step is None else self.step,
                      model=self.model)
+            registry.gauge(
+                "sparknet_serve_model_freshness_seconds",
+                "now - commit_ts of the serving step (-1 = no stamped "
+                "checkpoint installed)",
+                labels=("model", "replica")
+            ).set_fn(lambda: (-1.0 if (f := self.freshness_s()) is None
+                              else f),
+                     model=self.model, replica=self.replica)
+            registry.gauge(
+                "sparknet_serve_model_step_lag",
+                "newest committed step minus the serving step (-1 = "
+                "unknown)",
+                labels=("model", "replica")
+            ).set_fn(lambda: (-1 if (lag := self.step_lag()) is None
+                              else lag),
+                     model=self.model, replica=self.replica)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -236,25 +280,76 @@ class ModelManager:
     def poll(self, now: Optional[float] = None) -> bool:
         """Time-gated reload check (the server calls this every idle tick
         and between batches; actual store traffic happens at most once per
-        poll_interval_s). Returns True when a swap was installed."""
+        poll_interval_s, de-synchronized across replicas by poll_jitter).
+        Returns True when a swap was installed."""
         if not self.checkpoint_dir:
             return False
         now = time.monotonic() if now is None else now
         if now < self._next_poll:
             return False
-        self._next_poll = now + self.poll_interval_s
+        self._schedule_next_poll(now)
         try:
             latest = ckpt.latest_step(self.checkpoint_dir)
         except Exception as e:
-            # store outage: freshness degrades, serving does not
-            self.last_error = f"poll: {e}"
-            self._log(f"serve: checkpoint poll failed ({e}); retrying")
+            # store outage: freshness degrades, serving does not — and a
+            # transient listing error is STORE trouble, never a reason to
+            # cool any step down
+            self._store_error(f"poll: {e}", now=now)
             return False
-        if latest is None or latest == self.step:
+        if latest is not None:
+            self.latest_seen = latest
+        target = latest
+        if self.rollout_gate:
+            held, want = self._gate_target()
+            if held:
+                return False  # gated: no step approved for this replica
+            if want is not None:
+                target = want  # may be < self.step: rollback swap-down
+        if target is None or target == self.step:
             return False
-        if now < self._bad.get(latest, 0.0):
+        if now < self._bad.get(target, 0.0):
             return False  # known-bad step, still cooling down
-        return self._try_swap(latest)
+        return self._try_swap(target)
+
+    def _schedule_next_poll(self, now: float) -> None:
+        j = self.poll_jitter
+        scale = 1.0 + self._rng.uniform(-j, j) if j > 0.0 else 1.0
+        self._next_poll = now + self.poll_interval_s * scale
+
+    def _store_error(self, msg: str, now: Optional[float] = None) -> None:
+        """Transient store trouble (outage, timeout, auth blip): count it
+        under its own outcome, retry after FULL-jitter backoff — every
+        replica that saw the same blip re-polls at an independent uniform
+        offset instead of stampeding the store together — and never
+        corrupt-step-cooldown anything (the step is probably fine)."""
+        now = time.monotonic() if now is None else now
+        self.last_error = msg
+        if self._c_swaps is not None:
+            self._c_swaps.inc(model=self.model, outcome="store_error")
+        self._next_poll = now + self._rng.uniform(0.0,
+                                                  self.poll_interval_s)
+        self._log(f"serve: transient store error ({msg}); retrying with "
+                  f"jittered backoff")
+
+    def _gate_target(self) -> tuple:
+        """(held, step) under the rollout gate: held=True means the gate
+        exists but approves nothing for this replica (hold the current
+        weights); step is the approved target otherwise. A missing or
+        unreadable gate degrades to ungated independent polling."""
+        from ..fleet.rollout import read_gate
+        gate = read_gate(self.rollout_gate)
+        if not gate:
+            return False, None
+        # per-replica approval wins; "all" is the completed-rollout (or
+        # post-halt fallback) step open to EVERY replica, including ones
+        # grown after the rollout finished
+        want = gate.get("approved", {}).get(self.replica, gate.get("all"))
+        if want is None:
+            return True, None
+        want = int(want)
+        if want in set(int(d) for d in gate.get("denied", ())):
+            return True, None  # approval raced a deny; hold
+        return False, want
 
     # -- swap machinery ------------------------------------------------------
 
@@ -267,13 +362,28 @@ class ModelManager:
                 # fetched bytes (restore IS the verification — one read)
                 flat, got, extra = ckpt.restore_flat(self.checkpoint_dir,
                                                      step=step)
+            except ckpt.CheckpointVanishedError as e:
+                # the step disappeared between listing and fetch
+                # (retention pruned it while a slow rollout still had it
+                # approved): NOT a rejection — raising swap_failures here
+                # would read as "this replica refused the checkpoint" and
+                # halt a fleet rollout over a step that is simply gone.
+                # The next poll re-targets whatever is newest.
+                self.last_error = f"step {step}: vanished ({e})"
+                if self._c_swaps is not None:
+                    self._c_swaps.inc(model=self.model, outcome="vanished")
+                self._log(f"serve: checkpoint step {step} vanished before "
+                          f"fetch — continuing on step {self.step}")
+                return False
             except ckpt.CheckpointCorruptError as e:
                 self._reject(step, f"corrupt: {e}")
                 return False
             except Exception as e:
-                self.last_error = f"load step {step}: {e}"
-                self._log(f"serve: could not fetch step {step} ({e}); "
-                          f"will retry")
+                # NOT corruption: the loader propagates store trouble
+                # (ConnectionError, timeouts, non-404 HTTP) distinctly, so
+                # this step must not be cooled down — it will load fine
+                # once the store answers again
+                self._store_error(f"load step {step}: {e}")
                 return False
             return self._install(flat, got, extra)
 
@@ -325,6 +435,10 @@ class ModelManager:
                                "outputs or crash) — swap rolled back")
             return False
         self.step = step
+        ts = extra.get("commit_ts")
+        self.commit_ts = float(ts) if ts is not None else None
+        if self.latest_seen is None or step > self.latest_seen:
+            self.latest_seen = step
         if not initial:
             self.swaps += 1
         if self._c_swaps is not None:
@@ -393,6 +507,25 @@ class ModelManager:
                                blob_names=list(self.canary_outputs or ()))
         return all(np.isfinite(np.asarray(v, dtype=np.float32)).all()
                    for v in out.values())
+
+    # -- freshness -----------------------------------------------------------
+
+    def freshness_s(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds between the serving step's COMMIT (meta.json
+        commit_ts, wall clock) and now — the train->serve staleness this
+        replica is answering traffic at. None until a stamped checkpoint
+        installs (initial weights, pre-r12 checkpoints)."""
+        if self.commit_ts is None:
+            return None
+        now = time.time() if now is None else now
+        return round(max(0.0, now - self.commit_ts), 3)
+
+    def step_lag(self) -> Optional[int]:
+        """Newest committed step seen in the store minus the serving
+        step (0 = fully fresh); None before the first poll/install."""
+        if self.latest_seen is None or self.step is None:
+            return None
+        return max(0, int(self.latest_seen) - int(self.step))
 
     def swap_cooldown_active(self, cooldown_s: float) -> bool:
         """True within `cooldown_s` of the last rejected/rolled-back

@@ -680,6 +680,10 @@ class ModelRouter:
             "router": True,
             "pool_workers": self.pool_size(),
             "pool_target": self._pool_target,
+            # train->serve staleness at a glance (full per-model rows —
+            # model_step, step_lag — live under "models")
+            "freshness_s": {n: l.manager.freshness_s()
+                            for n, l in self.lanes.items()},
             "models": self._model_rows(),
             "lanes": {n: lane.status() for n, lane in self.lanes.items()},
             "replicas": {m: [r.as_dict() for r in reps]

@@ -160,7 +160,19 @@ class ServeConfig:
     # checkpoint hot-reload
     checkpoint_dir: Optional[str] = None
     poll_interval_s: float = 2.0
+    # ± fraction of poll_interval_s each poll deadline is jittered by: a
+    # fleet of replicas watching one bucket must not list it in lockstep
+    # on every commit (thundering herd)
+    poll_jitter: float = 0.1
     canary: bool = True                 # nonfinite-canary gate on swaps
+    # fleet identity: the key this replica looks itself up under in the
+    # rollout gate and the `replica` label on the freshness gauges
+    # (providers pass their tag; a standalone server stays "local")
+    replica_name: str = "local"
+    # rollout gate path (fleet/rollout.py ROLLOUT.json): when set, this
+    # replica only adopts checkpoint steps the fleet rollout duty
+    # approved for it; missing gate = ungated independent polling
+    rollout_gate: Optional[str] = None
     # observability. status_port serves /metrics (Prometheus text from
     # the shared obs registry — the SAME metric-name schema the training
     # process exports), /healthz and /status (the JSON vitals dict).
@@ -279,7 +291,9 @@ class InferenceServer:
             registry=self.registry, model=cfg.model_name,
             quant=self.quant,
             parity_batch=(parity_batch(net, self.buckets[0])
-                          if self.quant is not None else None))
+                          if self.quant is not None else None),
+            replica=cfg.replica_name, poll_jitter=cfg.poll_jitter,
+            rollout_gate=cfg.rollout_gate)
         # meters: worker-thread-written, internally locked — status() and
         # the HTTP scrape read consistent snapshots, never torn state
         self.latency = LatencyStats(registry=self.registry,
@@ -423,6 +437,16 @@ class InferenceServer:
                                 in sorted(self.fill.size_hist().items())},
             "quant": None if self.quant is None else self.quant.mode,
             "model_step": m.step,
+            "replica": m.replica,
+            # train->serve freshness: age of the serving step's commit
+            # (None until a commit_ts-stamped checkpoint installs) and
+            # how many committed steps this replica trails by.
+            # _log_metrics_row lifts the numeric fields into the JSONL
+            # stream, which is what the sparknet-metrics freshness view
+            # aggregates.
+            "freshness_s": m.freshness_s(),
+            "model_step_lag": m.step_lag(),
+            "latest_step_seen": m.latest_seen,
             "swaps": m.swaps,
             "swap_failures": m.swap_failures,
             "last_error": m.last_error,
@@ -517,6 +541,12 @@ class InferenceServer:
         without shipping the whole status dict."""
         lat = self.latency.summary()
         return {"step": self.manager.step,
+                # staleness without a /metrics scrape: the rollout duty
+                # reads adoption (model_step) from heartbeat rows, and
+                # sparknet-podview renders freshness per replica
+                "model_step": self.manager.step,
+                "freshness_s": self.manager.freshness_s(),
+                "step_lag": self.manager.step_lag(),
                 "queue_depth": self.batcher.depth(),
                 "requests_ok": self.requests_ok,
                 "requests_failed": self.requests_failed,

@@ -95,6 +95,15 @@ class CheckpointCorruptError(RuntimeError):
     (unreadable meta.json / state.npz, missing keys, or digest mismatch)."""
 
 
+class CheckpointVanishedError(CheckpointCorruptError):
+    """An explicitly requested step has no meta.json commit marker — it
+    was retention-pruned (or never committed) between listing and fetch.
+    Subclasses CheckpointCorruptError so every existing rollback/skip
+    path still treats it as not-loadable, but callers that react to
+    CORRUPTION (serve's rejected-swap cooldown, rollout halts) can tell
+    "the bytes are bad" from "the step is simply gone"."""
+
+
 def _is_extension_dtype(dt: np.dtype) -> bool:
     # bfloat16/float8_e4m3fn report kind 'V', but float8_e5m2 reports kind
     # 'f' (and still breaks savez) — match on the registering module too,
@@ -324,6 +333,18 @@ def _prepare_save(tree: Any, step: int, extra: Optional[Dict[str, Any]]
     return flat, meta
 
 
+def _stamp_commit(meta: Dict[str, Any]) -> Dict[str, Any]:
+    """Stamp wall-clock commit time into the manifest IMMEDIATELY before
+    the meta.json write. meta.json is the commit marker, so `commit_ts`
+    is the moment the step became visible to readers — the anchor the
+    serving fleet's freshness metric (now - commit_ts of the serving
+    step) is measured from. Stamped here rather than at snapshot time so
+    an async stage-2 writer or a slow multi-process digest poll doesn't
+    pre-age the step before anyone could possibly have served it."""
+    meta["commit_ts"] = round(time.time(), 3)
+    return meta
+
+
 def save(directory: str, tree: Any, *, step: int,
          extra: Optional[Dict[str, Any]] = None) -> str:
     """Atomically write checkpoint `step-N` under directory (a local path
@@ -340,7 +361,7 @@ def save(directory: str, tree: Any, *, step: int,
     try:
         np.savez(os.path.join(tmp, "state.npz"), **flat)
         with open(os.path.join(tmp, "meta.json"), "w") as f:
-            json.dump(meta, f)
+            json.dump(_stamp_commit(meta), f)
         final = os.path.join(directory, f"step-{int(step)}")
         if os.path.exists(final):
             shutil.rmtree(final)
@@ -377,7 +398,7 @@ def _save_bucket(directory: str, tree: Any, *, step: int,
     # getbuffer(): zero-copy view — getvalue() would duplicate the whole
     # serialized archive next to the flat arrays on the writer thread
     ops.write_large(f"{final}/state.npz", buf.getbuffer())
-    ops.write(f"{final}/meta.json", json.dumps(meta).encode())
+    ops.write(f"{final}/meta.json", json.dumps(_stamp_commit(meta)).encode())
     _record_written(directory, step)
     return final
 
@@ -555,7 +576,7 @@ def _commit_sharded_local(directory: str, step: int, files, meta,
 
         def write_meta():
             with open(os.path.join(tmp, "meta.json"), "w") as f:
-                json.dump(meta, f)
+                json.dump(_stamp_commit(meta), f)
 
         timed_write("meta", write_meta)
         final = os.path.join(directory, f"step-{int(step)}")
@@ -616,8 +637,8 @@ def _commit_sharded_bucket(directory: str, step: int, files, meta,
 
     _stamp_digests(meta, _parallel_file_writes(files, write_one,
                                                timed_write))
-    timed_write("meta", lambda: ops.write(f"{final}/meta.json",
-                                          json.dumps(meta).encode()))
+    timed_write("meta", lambda: ops.write(
+        f"{final}/meta.json", json.dumps(_stamp_commit(meta)).encode()))
     _record_written(directory, step, files=tuple(sorted(files)))
     return final
 
@@ -727,7 +748,7 @@ def _commit_sharded_multiproc(directory: str, step: int, files, meta,
                 time.sleep(0.2)
     _stamp_digests(meta, all_digests)
     timed_write("meta", lambda: write_file(
-        "meta.json", json.dumps(meta).encode()))
+        "meta.json", json.dumps(_stamp_commit(meta)).encode()))
     for p in expected:
         try:
             delete_file(f"commit-{int(p)}.json")
@@ -829,7 +850,9 @@ def _load_checkpoint(path: str) -> Tuple[Dict[str, np.ndarray], int,
     with a vacuous digest check — old checkpoints must still restore."""
     meta = _load_meta(path)
     if meta is None:
-        raise CheckpointCorruptError(f"{path}: meta.json missing/unreadable")
+        raise CheckpointVanishedError(
+            f"{path}: meta.json missing/unreadable — never committed or "
+            f"retention-pruned")
     if "shards" in meta:
         return _load_sharded(path, meta)
     try:
@@ -850,10 +873,12 @@ def _load_checkpoint(path: str) -> Tuple[Dict[str, np.ndarray], int,
             src = os.path.join(path, "state.npz")
         with np.load(src) as z:
             flat = {k: z[k] for k in z.files}
-    except ConnectionError:
-        # a bucket outage outlasting the retry budget is NOT corruption:
-        # propagating keeps the fallback scan from silently restoring an
-        # older step during a transient store failure
+    except (ConnectionError, TimeoutError):
+        # a bucket outage (or a socket timeout mid-stream) outlasting the
+        # retry budget is NOT corruption: propagating keeps the fallback
+        # scan from silently restoring an older step — and the serving
+        # poller from cooling down a perfectly good step — during a
+        # transient store failure
         raise
     except urllib.error.HTTPError as e:
         # meta committed but state unreadable: only a definitive 404
@@ -884,7 +909,18 @@ def _load_checkpoint(path: str) -> Tuple[Dict[str, np.ndarray], int,
                 f"corrupted at rest or in transit")
     for key, name in meta.get("ext_dtypes", {}).items():
         flat[key] = flat[key].view(np.dtype(name))
-    return flat, int(meta["step"]), meta.get("extra", {})
+    return flat, int(meta["step"]), _extra_with_commit(meta)
+
+
+def _extra_with_commit(meta: Dict[str, Any]) -> Dict[str, Any]:
+    """The checkpoint's `extra` dict with the manifest's top-level
+    `commit_ts` folded in — one returned mapping carries both the saver's
+    tags and the commit instant, so restore_flat's 3-tuple signature
+    stays put while freshness consumers see when the step went live."""
+    extra = dict(meta.get("extra") or {})
+    if "commit_ts" in meta:
+        extra.setdefault("commit_ts", meta["commit_ts"])
+    return extra
 
 
 def _load_sharded(path: str, meta: Dict[str, Any]
@@ -917,8 +953,8 @@ def _load_sharded(path: str, meta: Dict[str, Any]
             else:
                 with open(os.path.join(path, name), "rb") as f:
                     raw = f.read()
-        except ConnectionError:
-            raise
+        except (ConnectionError, TimeoutError):
+            raise  # store trouble, not corruption — same rule as meta
         except urllib.error.HTTPError as e:
             if e.code == 404:
                 raise CheckpointCorruptError(
@@ -986,7 +1022,7 @@ def _load_sharded(path: str, meta: Dict[str, Any]
                 f"overlapping shards")
     for key, name in meta.get("ext_dtypes", {}).items():
         flat[key] = flat[key].view(np.dtype(name))
-    return flat, int(meta["step"]), meta.get("extra", {})
+    return flat, int(meta["step"]), _extra_with_commit(meta)
 
 
 def restore_flat(directory: str, step: Optional[int] = None

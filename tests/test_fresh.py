@@ -1,0 +1,268 @@
+"""r12 continuous learning — the train->serve loop's control surfaces.
+
+Three contracts pinned here:
+
+  - FRESHNESS: training stamps `commit_ts` into meta.json at
+    manifest-commit time; ModelManager carries it through install so
+    `freshness_s` (now - commit of the serving step) and `step_lag`
+    (newest committed step - serving step) are measurable per replica.
+    Pre-r12 checkpoints (no stamp) degrade to freshness=None, never an
+    error.
+  - STAGGERED ADOPTION: RolloutManager sequences a new committed step
+    through canary -> waves -> done against its ROLLOUT.json gate, and
+    HALTS (deny fleet-wide, revert approvals) on a rejection, an SLO
+    burn breach, or an adoption timeout. A denied step is never
+    re-targeted; a newer step still rolls out.
+  - BLAST RADIUS (the acceptance pin): a poisoned-but-digest-valid
+    checkpoint is rejected by the CANARY replica's forward gate, the
+    rollout halts, the canary sheds to its peers via swap-cooldown —
+    and the bad step NEVER installs on a second replica.
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.fleet import ReplicaView, RolloutManager
+from sparknet_tpu.fleet.rollout import read_gate, write_gate
+from sparknet_tpu.net_api import JaxNet
+from sparknet_tpu.obs import MetricsRegistry
+from sparknet_tpu.serve import ModelManager, zeros_batch
+from sparknet_tpu.utils import checkpoint as ckpt
+from sparknet_tpu.zoo import lenet
+
+
+@pytest.fixture(scope="module")
+def net():
+    return JaxNet(lenet(batch=4))
+
+
+def _save(net, d, step, scale=1.0):
+    """A TrainState-shaped checkpoint of this net's weights * scale."""
+    flat = {f"params/{ln}/{pn}": np.asarray(w)[None] * scale
+            for ln, lp in net.params.items() for pn, w in lp.items()}
+    return ckpt.save(str(d), flat, step=step)
+
+
+def _views(*mgrs):
+    return [ReplicaView(m.replica, m.step, m.swap_failures) for m in mgrs]
+
+
+# -- the gate file ------------------------------------------------------------
+
+def test_gate_roundtrip_and_degraded_reads(tmp_path):
+    p = str(tmp_path / "ROLLOUT.json")
+    assert read_gate(p) is None                      # missing -> ungated
+    gate = {"v": 1, "state": "wave", "wave": 2, "approved": {"r1": 7},
+            "denied": [6], "all": 5, "target": 7}
+    write_gate(p, gate)
+    assert read_gate(p) == gate
+    # torn/garbage content degrades to None, never raises
+    open(p, "w").write('{"v": 1, "appro')
+    assert read_gate(p) is None
+    open(p, "w").write("[1, 2]")                     # valid JSON, non-dict
+    assert read_gate(p) is None
+
+
+# -- freshness plumbing -------------------------------------------------------
+
+def test_commit_ts_stamped_and_freshness_accessors(net, tmp_path):
+    d = tmp_path / "ck"
+    t0 = time.time()
+    path = _save(net, d, step=3)
+    meta = json.load(open(f"{path}/meta.json"))
+    assert t0 - 1.0 <= meta["commit_ts"] <= time.time() + 1.0
+    m = ModelManager(net, checkpoint_dir=str(d))
+    assert m.load_initial() == 3
+    assert m.commit_ts == meta["commit_ts"]
+    assert m.freshness_s(now=m.commit_ts + 5.0) == 5.0
+    assert m.freshness_s(now=m.commit_ts - 9.0) == 0.0  # clock skew clamps
+    assert m.step_lag() == 0
+    # no checkpoint dir at all: freshness is undefined, not an error
+    bare = ModelManager(net)
+    assert bare.freshness_s() is None and bare.step_lag() is None
+
+
+def test_pre_r12_checkpoint_without_stamp_degrades(net, tmp_path):
+    d = tmp_path / "ck"
+    path = _save(net, d, step=1)
+    meta = json.load(open(f"{path}/meta.json"))
+    del meta["commit_ts"]                 # a checkpoint from an old writer
+    json.dump(meta, open(f"{path}/meta.json", "w"))
+    m = ModelManager(net, checkpoint_dir=str(d))
+    assert m.load_initial() == 1          # serves fine
+    assert m.commit_ts is None and m.freshness_s() is None
+
+
+def test_step_lag_counts_held_back_steps(net, tmp_path):
+    """A gated replica that is HELD while newer steps commit reports the
+    lag — the staleness signal podview/metrics surface per replica."""
+    d = tmp_path / "ck"
+    gate = str(tmp_path / "ROLLOUT.json")
+    _save(net, d, step=1)
+    m = ModelManager(net, checkpoint_dir=str(d), poll_interval_s=0.0,
+                     rollout_gate=gate)
+    assert m.load_initial() == 1
+    write_gate(gate, {"v": 1, "state": "canary", "approved": {"other": 4},
+                      "denied": []})
+    _save(net, d, step=4)
+    assert m.poll() is False              # held: nothing approved for us
+    assert m.step == 1 and m.latest_seen == 4 and m.step_lag() == 3
+
+
+def test_vanished_step_is_not_a_rejection(net, tmp_path):
+    """A step that retention pruned between listing and fetch must not
+    count as a REJECTED swap: a rising swap_failures reads as "this
+    replica refused the checkpoint" and would halt a fleet rollout over
+    a step that is simply gone."""
+    import shutil
+
+    d = tmp_path / "ck"
+    _save(net, d, step=1)
+    path2 = _save(net, d, step=2)
+    reg = MetricsRegistry()
+    m = ModelManager(net, checkpoint_dir=str(d), poll_interval_s=0.0,
+                     registry=reg)
+    assert m.load_initial() == 2
+    shutil.rmtree(path2)                  # retention prunes step 2
+    with pytest.raises(ckpt.CheckpointVanishedError):
+        ckpt.restore_flat(str(d), step=2)
+    assert m._try_swap(2) is False        # a slow rollout still wants it
+    assert m.step == 2 and m.swap_failures == 0 and m._bad == {}
+    assert "vanished" in m.last_error
+    assert 'outcome="vanished"} 1' in reg.render_prometheus()
+    assert 'outcome="rejected"' not in reg.render_prometheus()
+
+
+# -- the rollout state machine ------------------------------------------------
+
+def test_rollout_staggers_canary_then_waves_then_all(tmp_path):
+    gate = str(tmp_path / "ROLLOUT.json")
+    events = []
+    ro = RolloutManager(gate, wave_size=2, timeout_s=30.0,
+                        event=lambda _d, r, **ex: events.append((r, ex)))
+    keys = ["local", "r1", "r2", "r3", "r4"]
+    at = {k: 1 for k in keys}
+    view = lambda: [ReplicaView(k, at[k]) for k in keys]
+    # nothing new committed: stays idle
+    assert ro.tick(view(), newest_step=None, burn=0.0, now=0.0) == "idle"
+    # step 2 commits: canary (first view = the local lane) only
+    assert ro.tick(view(), 2, 0.0, now=1.0) == "canary"
+    g = read_gate(gate)
+    assert g["approved"] == {"local": 2} and g.get("all") is None
+    # canary not adopted yet: no wave opens
+    assert ro.tick(view(), 2, 0.0, now=2.0) == "canary"
+    at["local"] = 2
+    assert ro.tick(view(), 2, 0.0, now=3.0) == "wave"
+    g = read_gate(gate)
+    assert g["wave"] == 1 and set(g["approved"]) == {"local", "r1", "r2"}
+    at["r1"] = at["r2"] = 2
+    assert ro.tick(view(), 2, 0.0, now=4.0) == "wave"
+    assert set(read_gate(gate)["approved"]) == set(keys)
+    at["r3"] = at["r4"] = 2
+    assert ro.tick(view(), 2, 0.0, now=5.0) == "idle"  # done
+    g = read_gate(gate)
+    # the finished rollout opens the step to EVERYONE — including a
+    # replica grown later that never appeared in any wave
+    assert g["all"] == 2 and g["approved"] == {} and g["denied"] == []
+    st = ro.status()
+    assert st["rollouts"] == 1 and st["waves_done"] == 2
+    assert st["halts"] == 0
+    assert [r for r, _ in events] == ["canary", "wave", "wave", "done"]
+    # the same step never re-opens; a NEWER one does
+    assert ro.tick(view(), 2, 0.0, now=6.0) == "idle"
+    assert ro.tick(view(), 3, 0.0, now=7.0) == "canary"
+
+
+def test_rollout_halt_on_burn_and_on_adoption_timeout(tmp_path):
+    gate = str(tmp_path / "ROLLOUT.json")
+    ro = RolloutManager(gate, wave_size=1, halt_burn=1.5, timeout_s=10.0)
+    views = [ReplicaView("local", 5), ReplicaView("r1", 5)]
+    assert ro.tick(views, 6, 0.0, now=0.0) == "canary"
+    views[0].step = 6                     # canary adopted, but burn is hot
+    assert ro.tick(views, 6, burn=2.0, now=1.0) == "idle"
+    assert ro.status()["denied"] == [6] and ro.status()["halts"] == 1
+    assert read_gate(gate)["all"] == 5    # fleet reverts to pre-rollout
+    # adoption timeout: a canary that never installs (wedged replica)
+    views[0].step = 5
+    assert ro.tick(views, 7, 0.0, now=2.0) == "canary"
+    assert ro.tick(views, 7, 0.0, now=5.0) == "canary"   # within budget
+    assert ro.tick(views, 7, 0.0, now=13.0) == "idle"    # 11s > 10s
+    assert ro.status()["denied"] == [6, 7]
+
+
+def test_gate_target_resolution(net, tmp_path):
+    gate = str(tmp_path / "ROLLOUT.json")
+    m = ModelManager(net, checkpoint_dir=str(tmp_path / "ck"),
+                     replica="r1", rollout_gate=gate)
+    assert m._gate_target() == (False, None)        # no gate: ungated
+    write_gate(gate, {"approved": {"r1": 5}})
+    assert m._gate_target() == (False, 5)           # named approval wins
+    write_gate(gate, {"approved": {"other": 5}})
+    assert m._gate_target() == (True, None)         # someone else's wave
+    write_gate(gate, {"approved": {}, "all": 4})
+    assert m._gate_target() == (False, 4)           # completed rollout
+    write_gate(gate, {"approved": {"r1": 6}, "denied": [6]})
+    assert m._gate_target() == (True, None)         # approval raced a deny
+
+
+# -- the acceptance pin -------------------------------------------------------
+
+@pytest.mark.chaos
+def test_rejected_canary_halts_wave_and_never_reaches_peers(tmp_path):
+    """A digest-valid but POISONED step (NaN weights) reaches the canary
+    replica, fails its canary forward, and the rollout halts: the step is
+    denied fleet-wide, the canary sheds to peers through swap-cooldown,
+    and no second replica ever installs it."""
+    d = tmp_path / "ck"
+    gate = str(tmp_path / "ROLLOUT.json")
+    nets = [JaxNet(lenet(batch=4)) for _ in range(3)]
+    _save(nets[0], d, step=1)
+    regs = [MetricsRegistry() for _ in range(3)]
+    mgrs = [ModelManager(nets[i], checkpoint_dir=str(d),
+                         poll_interval_s=0.0, bad_step_retry_s=0.01,
+                         canary_batch=zeros_batch(nets[i], 1),
+                         canary_outputs=("prob",), replica=rk,
+                         rollout_gate=gate, registry=regs[i])
+            for i, rk in enumerate(("local", "r1", "r2"))]
+    for m in mgrs:
+        assert m.load_initial() == 1
+    ro = RolloutManager(gate, wave_size=1, timeout_s=30.0)
+    _save(nets[0], d, step=2, scale=np.nan)   # poisoned, digests valid
+    assert ro.tick(_views(*mgrs), 2, 0.0, now=0.0) == "canary"
+    # the canary tries it and ROLLS BACK; peers are held by the gate
+    assert mgrs[0].poll() is False
+    assert mgrs[0].step == 1 and mgrs[0].swap_failures == 1
+    assert "canary" in mgrs[0].last_error
+    assert mgrs[0].swap_cooldown_active(30.0)   # router sheds to peers
+    assert 'outcome="rejected"} 1' in regs[0].render_prometheus()
+    for m in mgrs[1:]:
+        assert m.poll() is False and m.step == 1
+    # the controller sees the canary's rollback count rise -> HALT
+    assert ro.tick(_views(*mgrs), 2, 0.0, now=1.0) == "idle"
+    st = ro.status()
+    assert st["denied"] == [2] and st["halts"] == 1
+    assert read_gate(gate)["all"] == 1
+    # even past the canary's bad-step cooldown, the denied step installs
+    # NOWHERE — and peers took zero swap attempts at it
+    time.sleep(0.02)
+    for m in mgrs:
+        assert m.poll() is False and m.step == 1
+    for m in mgrs[1:]:
+        assert m.swaps == 0 and m.swap_failures == 0
+        assert 'outcome="rejected"' not in \
+            regs[mgrs.index(m)].render_prometheus()
+    # a FIXED newer step then rolls out to the whole fleet, staggered
+    _save(nets[0], d, step=3, scale=0.5)
+    assert ro.tick(_views(*mgrs), 3, 0.0, now=2.0) == "canary"
+    assert mgrs[0].poll() is True and mgrs[0].step == 3
+    assert mgrs[1].poll() is False              # still only the canary
+    assert ro.tick(_views(*mgrs), 3, 0.0, now=3.0) == "wave"
+    moved = [m for m in mgrs[1:] if m.poll()]
+    assert len(moved) == 1                      # wave_size=1
+    assert ro.tick(_views(*mgrs), 3, 0.0, now=4.0) == "wave"
+    assert [m for m in mgrs if m.step != 3 and m.poll()]
+    assert ro.tick(_views(*mgrs), 3, 0.0, now=5.0) == "idle"
+    assert [m.step for m in mgrs] == [3, 3, 3]
+    assert ro.status()["rollouts"] == 1 and read_gate(gate)["all"] == 3

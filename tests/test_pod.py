@@ -290,7 +290,8 @@ def test_aggregator_surfaces_per_model_serve_rows(tmp_path):
         7, status="ok", round_s=0.1)
     HeartbeatWriter(worker_heartbeat_path(pod_dir, 1), role="serve").beat(
         42, status="ok",
-        models={"mnist": {"step": 42, "queue_depth": 3, "p99_ms": 8.5,
+        models={"mnist": {"step": 42, "freshness_s": 3.25, "step_lag": 1,
+                          "queue_depth": 3, "p99_ms": 8.5,
                           "requests_ok": 100, "requests_shed": 2,
                           "swaps": 1},
                 "cifar": {"step": 9, "queue_depth": 0, "p99_ms": 30.1,
@@ -301,11 +302,18 @@ def test_aggregator_surfaces_per_model_serve_rows(tmp_path):
     assert serve["role"] == "serve"
     assert set(serve["models"]) == {"mnist", "cifar"}
     assert serve["models"]["mnist"]["p99_ms"] == 8.5
+    # r12: checkpoint freshness and step lag ride the heartbeat row, so
+    # podview shows per-replica staleness WITHOUT scraping /metrics
+    assert serve["models"]["mnist"]["freshness_s"] == 3.25
+    assert serve["models"]["mnist"]["step_lag"] == 1
     train = [w for w in status["workers"] if w["worker"] == "0"][0]
     assert "models" not in train  # train rows stay exactly as before
     table = format_pod_table(status)
     assert "model=mnist" in table and "p99=8.5ms" in table
+    assert "fresh=3.25s" in table and "lag=1" in table
     assert "model=cifar" in table and "shed=2" in table
+    cifar = [ln for ln in table.splitlines() if "model=cifar" in ln][0]
+    assert "fresh=" not in cifar      # no freshness reported = omitted
 
 
 def test_aggregator_file_mode_stale_worker_named(tmp_path):

@@ -654,3 +654,126 @@ def test_manager_loads_sharded_manifest_checkpoints(net, tmp_path):
             np.testing.assert_array_equal(
                 np.asarray(m.net.params[lname][pname]), w,
                 err_msg=f"{lname}/{pname}")
+
+
+# -- r12 freshness-era poll behavior -----------------------------------------
+
+def test_manager_store_outage_is_store_error_not_corrupt(net, tmp_path):
+    """A store that stops answering mid-poll is TRANSIENT trouble: it
+    lands under swaps_total{outcome="store_error"}, cools down NO step
+    (the checkpoint is probably fine), raises no swap_failures (a fleet
+    rollout must not read an outage as a rejection), and reschedules the
+    poll with full-jitter backoff inside one interval."""
+    from fake_stores import bucket_store, stop_serving
+
+    from sparknet_tpu.obs import MetricsRegistry
+    reg = MetricsRegistry()
+    with bucket_store("gs") as (url, srv):
+        d = f"{url}/ck"
+        _save_trainstate_like(net, d, step=1)
+        m = ModelManager(net, checkpoint_dir=d, poll_interval_s=5.0,
+                         registry=reg)
+        assert m.load_initial() == 1
+        _save_trainstate_like(net, d, step=2)
+        stop_serving(srv)
+        t0 = time.monotonic()
+        assert m.poll(now=t0) is False
+    assert m.step == 1
+    assert m.swap_failures == 0          # an outage is NOT a rejection
+    assert m._bad == {}                  # and NO step went on cooldown
+    assert 'outcome="store_error"} 1' in reg.render_prometheus()
+    assert 'outcome="rejected"' not in reg.render_prometheus()
+    # full-jitter: retry lands uniformly within ONE poll interval, not at
+    # the bad_step_retry_s corruption cadence
+    assert t0 <= m._next_poll <= t0 + 5.0
+
+
+def test_manager_transient_load_error_then_same_step_installs(
+        net, tmp_path, monkeypatch):
+    """Store trouble during the checkpoint FETCH (listing worked) is
+    classified the same way — and once the store answers again the very
+    same step installs, because it was never cooled down."""
+    d = tmp_path / "ck"
+    _save_trainstate_like(net, d, step=1)
+    m = ModelManager(net, checkpoint_dir=str(d), poll_interval_s=2.0)
+    assert m.load_initial() == 1
+    _save_trainstate_like(net, d, step=2)
+    real, tries = ckpt.restore_flat, []
+
+    def flaky(*a, **kw):
+        if not tries:
+            tries.append(1)
+            raise TimeoutError("store busy")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(ckpt, "restore_flat", flaky)
+    t0 = time.monotonic()
+    assert m.poll(now=t0) is False
+    assert m.step == 1 and m.swap_failures == 0 and m._bad == {}
+    assert "store" in m.last_error or "busy" in m.last_error
+    assert t0 <= m._next_poll <= t0 + 2.0
+    assert m.poll(now=m._next_poll + 1e-3) is True
+    assert m.step == 2                   # no cooldown stood in the way
+
+
+def test_poll_jitter_desynchronizes_replicas(net, tmp_path):
+    """N replicas watching one store must not list it in lockstep: with
+    poll_jitter set, one shared poll instant schedules N DISTINCT next
+    polls, all within ±jitter of the interval. jitter=0 keeps the exact
+    legacy cadence (back-compat default for ModelManager)."""
+    d = tmp_path / "ck"
+    _save_trainstate_like(net, d, step=1)
+    mgrs = [ModelManager(net, checkpoint_dir=str(d), poll_interval_s=10.0,
+                         poll_jitter=0.4) for _ in range(8)]
+    for m in mgrs:
+        m.poll(now=100.0)
+    nexts = [m._next_poll for m in mgrs]
+    assert all(106.0 <= t <= 114.0 for t in nexts)
+    assert len(set(nexts)) >= 7          # spread, not lockstep
+    legacy = ModelManager(net, checkpoint_dir=str(d), poll_interval_s=10.0)
+    legacy.poll(now=100.0)
+    assert legacy._next_poll == 110.0
+    with pytest.raises(ValueError, match="poll_jitter"):
+        ModelManager(net, checkpoint_dir=str(d), poll_jitter=1.0)
+
+
+def test_poll_skips_torn_sharded_write_until_meta_commits(net, tmp_path):
+    """Serve-side torn-checkpoint safety: a poll landing in the middle of
+    a SHARDED save (array shards on disk, meta.json not yet) must treat
+    the step as not-a-checkpoint — no install, no rejection, no cooldown.
+    The moment the meta.json commit marker lands, the same poll path
+    installs it whole."""
+    import shutil
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from sparknet_tpu.parallel.mesh import fetch_state_shards, make_mesh
+
+    d = tmp_path / "ck"
+    _save_trainstate_like(net, d, step=1)
+    m = ModelManager(net, checkpoint_dir=str(d), poll_interval_s=0.0)
+    assert m.load_initial() == 1
+    want = {ln: {pn: np.asarray(w) * 0.25 for pn, w in lp.items()}
+            for ln, lp in net.params.items()}
+    mesh = make_mesh(4)
+    tree = {"params": {
+        ln: {pn: jax.device_put(w[None], NamedSharding(mesh, P()))
+             for pn, w in lp.items()}
+        for ln, lp in want.items()}}
+    stage = tmp_path / "stage"
+    ckpt.save_sharded(str(stage), fetch_state_shards(tree, mesh), step=9)
+    src, dst = stage / "step-9", d / "step-9"
+    os.makedirs(dst)
+    for f in os.listdir(src):
+        if f != "meta.json":             # the commit marker stays out
+            shutil.copy(src / f, dst / f)
+    with pytest.warns(RuntimeWarning, match="meta.json"):
+        assert m.poll() is False
+    assert m.step == 1 and m.swap_failures == 0 and m._bad == {}
+    shutil.copy(src / "meta.json", dst / "meta.json")
+    assert m.poll() is True and m.step == 9
+    for ln, lp in want.items():
+        for pn, w in lp.items():
+            np.testing.assert_array_equal(
+                np.asarray(m.net.params[ln][pn]), w,
+                err_msg=f"{ln}/{pn}")
