@@ -167,10 +167,15 @@ class SubprocessReplicaProvider(ReplicaProvider):
                                     cwd=self.workdir, env=env)
         finally:
             log_f.close()  # the child holds its own fd now
+        # shm_eligible: the child is a colocated loopback process — the
+        # binary client's spkn-shm handshake will succeed against it
+        # (the nonce proof still decides at connect time; this flag is
+        # advisory, for status/placement readers)
         handle = ReplicaHandle(model, f"spkn://127.0.0.1:{port}",
                                heartbeat_path=hb,
                                meta={"proc": proc, "port": port,
-                                     "log": log_path, "tag": tag})
+                                     "log": log_path, "tag": tag,
+                                     "shm_eligible": True})
         self._procs.append(proc)
         deadline = time.monotonic() + self.spawn_timeout_s
         while time.monotonic() < deadline:

@@ -46,24 +46,25 @@ from .admission import (PRIORITIES, PriorityAdmission, PriorityShedError,
                         TenantAdmission, TenantLimitError,
                         parse_priority)
 from .batcher import (DeadlineExpiredError, DynamicBatcher,
-                      QueueFullError, ServeRequest)
+                      QueueFullError, RequestCancelledError,
+                      ServeRequest)
 from .binary_frontend import BinaryClient, BinaryFrontend, binary_infer
 from .buckets import derive_buckets, fill_ratio, size_hist_from_jsonl
 from .http_frontend import BackendAdapter, HttpFrontend, http_infer
 from .model_manager import ModelManager, ServeModelError
 from .router import (ModelRouter, NoReplicaError, Replica, RouterConfig,
-                     UnknownModelError, heartbeat_health)
+                     UnknownModelError, heartbeat_fill, heartbeat_health)
 from .server import InferenceServer, ServeConfig, parity_batch, zeros_batch
 from .wire import WireError
 
 __all__ = [
     "DynamicBatcher", "QueueFullError", "DeadlineExpiredError",
-    "ServeRequest",
+    "RequestCancelledError", "ServeRequest",
     "ModelManager", "ServeModelError",
     "InferenceServer", "ServeConfig", "zeros_batch", "parity_batch",
     "QuantConfig", "derive_buckets", "fill_ratio", "size_hist_from_jsonl",
     "ModelRouter", "RouterConfig", "Replica", "NoReplicaError",
-    "UnknownModelError", "heartbeat_health",
+    "UnknownModelError", "heartbeat_health", "heartbeat_fill",
     "HttpFrontend", "http_infer", "BackendAdapter",
     "BinaryFrontend", "BinaryClient", "binary_infer", "WireError",
     "TenantAdmission", "TenantLimitError",

@@ -219,6 +219,31 @@ def main(argv=None) -> None:
     p.add_argument("--binary-host", default="127.0.0.1",
                    help='bind host for --binary-port ("0.0.0.0" for '
                    "cross-host clients)")
+    p.add_argument("--no-shm", action="store_true",
+                   help="disable the spkn-shm shared-memory transport "
+                   "on the binary frontend (same-host peers then send "
+                   "tensor payloads inline over the socket)")
+    p.add_argument("--request-journal", default=None, metavar="PATH",
+                   help="journal every data-plane request as JSONL "
+                   "(ts, model, tenant, priority, tensor sizes, "
+                   "deadline_ms, transport) — the raw material for "
+                   "trace-replay benchmarks; off by default")
+    p.add_argument("--hedge", action="store_true",
+                   help="hedge slow requests (--models router only): "
+                   "after an adaptive delay (the model's live routed-"
+                   "latency quantile) re-issue an in-flight request to "
+                   "a second healthy replica; first answer wins, the "
+                   "loser is cancelled best-effort")
+    p.add_argument("--hedge-budget", type=float, default=0.05,
+                   help="max fraction of routed requests that may "
+                   "hedge (default 0.05); hedging also disables "
+                   "itself under admission pressure")
+    p.add_argument("--coalesce", action="store_true",
+                   help="coalesced batch formation (--models router "
+                   "only): when every replica of a model reports "
+                   "under-filled batches, focus consecutive requests "
+                   "on ONE replica per formation window (rotating for "
+                   "fairness) so batches actually fill")
     p.add_argument("--io-threads", type=int, default=2,
                    help="event-loop io threads for --binary-port")
     p.add_argument("--tenant-rate", type=float, default=None,
@@ -355,6 +380,11 @@ def main(argv=None) -> None:
             args.tenant_rate, args.tenant_burst,
             weights=parse_weights_arg(args.tenant_weights))
 
+    # request journal (off by default): one JSONL row per data-plane
+    # request, shared by both frontends — echo off, this is a data file
+    journal = (Logger(jsonl_path=args.request_journal, echo=False)
+               if args.request_journal else None)
+
     def make_frontends(backend):
         """The data planes the flags asked for: HTTP and/or binary."""
         from .binary_frontend import BinaryFrontend
@@ -362,12 +392,14 @@ def main(argv=None) -> None:
         if args.http_port is not None:
             fes.append(HttpFrontend(backend, args.http_port,
                                     args.http_host, tenants=tenants,
-                                    logger=log))
+                                    logger=log, journal=journal))
         if args.binary_port is not None:
             fes.append(BinaryFrontend(backend, args.binary_port,
                                       args.binary_host,
                                       io_threads=args.io_threads,
-                                      tenants=tenants, logger=log))
+                                      tenants=tenants, logger=log,
+                                      enable_shm=not args.no_shm,
+                                      journal=journal))
         return fes
 
     def make_fleet(router, sources):
@@ -403,8 +435,15 @@ def main(argv=None) -> None:
                 RouterConfig(workers=args.router_workers,
                              status_port=args.status_port,
                              heartbeat_path=args.heartbeat,
-                             heartbeat_every_s=args.heartbeat_every),
+                             heartbeat_every_s=args.heartbeat_every,
+                             hedge=args.hedge,
+                             hedge_budget=args.hedge_budget,
+                             coalesce=args.coalesce),
                 logger=log)
+            if tenants is not None:
+                # hedging reads the admission door's pressure: a
+                # saturated fleet must not pay for duplicate requests
+                router.attach_admission(tenants)
             sources = parse_models_arg(args.models)
             for name, src in sources:
                 ck = (args.checkpoint_dir.format(model=name)

@@ -55,6 +55,14 @@ class QueueFullError(RuntimeError):
     HTTP frontend maps this to 429 + Retry-After."""
 
 
+class RequestCancelledError(RuntimeError):
+    """The request was cancelled (hedging's losing leg, or an explicit
+    client CANCEL frame) while still queued — it never formed into a
+    batch. Cancellation is BEST-EFFORT: a request that already formed
+    cannot be cancelled and completes normally (the wire maps this to
+    the 499 `cancelled` error kind)."""
+
+
 class DeadlineExpiredError(RuntimeError):
     """The request's client deadline passed before a forward could run;
     it was shed instead of padded into a bucket. The HTTP frontend maps
@@ -159,6 +167,34 @@ class DynamicBatcher:
         if self.on_submit is not None:
             self.on_submit()
         return req.future
+
+    def cancel(self, future: Future) -> bool:
+        """Best-effort cancel of a QUEUED request by its future: remove
+        it from the queue and fail the future with
+        RequestCancelledError. Returns True iff the request was still
+        queued — False means it already formed into a batch (or was
+        never here) and will complete normally; the caller drops the
+        cancel, exactly-once delivery is preserved by the future's
+        first-resolution-wins semantics."""
+        hit: Optional[ServeRequest] = None
+        with self._nonempty:
+            for r in self._q:
+                if r.future is future:
+                    hit = r
+                    break
+            if hit is not None:
+                self._q.remove(hit)
+        if hit is None:
+            return False
+        if not hit.future.done():
+            hit.future.set_exception(RequestCancelledError(
+                "request cancelled while queued (never formed into a "
+                "batch)"))
+        with self._lock:
+            self.shed += 1
+        if self._c_shed is not None:
+            self._c_shed.inc(1, model=self.model, reason="cancelled")
+        return True
 
     def _pop_expired_locked(self, now: float) -> List[ServeRequest]:
         """Remove every queued request whose deadline has passed (caller

@@ -31,6 +31,21 @@ malformed frame (bad magic/version, oversized) fails ITS connection
 alone after a typed answer, and a wedged forward is reaped by the io
 loop's timeout sweep — a client of this transport never hangs.
 
+Tail-latency extensions (wire v3):
+  - CANCEL frames: a client may cancel an in-flight request id; if the
+    request is still queued server-side it resolves with the typed
+    `cancelled` error, otherwise the cancel is dropped and the normal
+    reply arrives — exactly one terminal frame per id either way. The
+    hedging router uses this to reap its losing leg.
+  - spkn-shm (serve/shm.py): same-host peers negotiate FLAG_SHM at
+    connect (SHM_HELLO + nonce proof); granted, tensor payloads ride
+    named shared-memory ring segments in BOTH directions and zero
+    payload bytes cross the socket (`payload_rx_bytes` /
+    `payload_tx_bytes` pin it). Remote peers fall back inline.
+  - Responses carry the request's measured queue wait (`queue_wait_ms`
+    in the meta, `BinaryClient.last_timing`), splitting the observed
+    tail into queueing vs compute.
+
 `BinaryClient` / `binary_infer` at the bottom are the matching client
 (keep-alive, pipelined submits, streaming reassembly, thread-cached) —
 `ModelRouter.add_remote_replica(..., transport="binary")` proxies over
@@ -49,10 +64,11 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..utils.logger import Logger
-from . import wire
+from . import shm, wire
 from .admission import (PriorityShedError, TenantAdmission,
                         TenantLimitError)
-from .batcher import DeadlineExpiredError, QueueFullError
+from .batcher import (DeadlineExpiredError, QueueFullError,
+                      RequestCancelledError)
 from .http_frontend import (BackendAdapter, lru_cache_drop,
                             lru_cache_get, register_transport_metrics)
 from .router import NoReplicaError, UnknownModelError
@@ -71,11 +87,16 @@ def _exception_to_err(e: BaseException) -> Tuple[Tuple[int, str], str]:
         return wire.ERR_QUEUE_FULL, str(e)
     if isinstance(e, DeadlineExpiredError):
         return wire.ERR_DEADLINE, str(e)
+    if isinstance(e, RequestCancelledError):
+        return wire.ERR_CANCELLED, str(e)
     if isinstance(e, NoReplicaError):
         return wire.ERR_NO_REPLICA, str(e)
     if isinstance(e, UnknownModelError):
         return wire.ERR_UNKNOWN_MODEL, str(e)
-    if isinstance(e, (ValueError, KeyError, TypeError, wire.WireError)):
+    # FileNotFoundError: a FLAG_SHM request named a segment this host
+    # cannot map — the CLIENT's framing was wrong, not the server
+    if isinstance(e, (ValueError, KeyError, TypeError, wire.WireError,
+                      FileNotFoundError)):
         return wire.ERR_BAD_REQUEST, str(e)
     return wire.ERR_INTERNAL, f"{type(e).__name__}: {e}"
 
@@ -95,6 +116,8 @@ def raise_for_error(code: int, kind: str, msg: str) -> None:
         raise QueueFullError(msg)
     if kind == "deadline":
         raise DeadlineExpiredError(msg)
+    if kind == "cancelled":
+        raise RequestCancelledError(msg)
     if code == 503:
         raise NoReplicaError(msg or f"replica shed ({kind})")
     if code == 404:
@@ -111,7 +134,8 @@ class _Conn:
 
     __slots__ = ("sock", "loop", "rbuf", "outbox", "lock", "wview",
                  "wcopied", "closed", "close_after_flush", "inflight",
-                 "copied_pending", "peak_copied", "reject_until")
+                 "copied_pending", "peak_copied", "reject_until",
+                 "shm_ok", "shm_ring", "shm_segs")
 
     def __init__(self, sock, loop):
         self.sock = sock
@@ -129,11 +153,18 @@ class _Conn:
         # and the reaper closes the connection at this deadline if the
         # client hasn't hung up first
         self.reject_until: Optional[float] = None
-        # req_id -> absolute reply bound (monotonic); popped on
-        # completion, or by the reaper (which answers a timeout frame)
-        self.inflight: Dict[int, float] = {}
+        # req_id -> (reply bound (monotonic), response future, model,
+        # journal row); popped on completion, or by the reaper (which
+        # answers a timeout frame). The future rides along so a CANCEL
+        # frame can reach the batcher's queue entry for this id.
+        self.inflight: Dict[int, Tuple[float, Any, str,
+                                       Optional[dict]]] = {}
         self.copied_pending = 0   # bytes of COPIED (header) data queued
         self.peak_copied = 0      # its high-water mark
+        # spkn-shm (serve/shm.py): granted after a verified SHM_HELLO
+        self.shm_ok = False
+        self.shm_ring = None      # response-segment ring (lazy)
+        self.shm_segs: Dict[str, Any] = {}  # attached request segments
 
 
 class _IoLoop(threading.Thread):
@@ -275,13 +306,14 @@ class _IoLoop(threading.Thread):
                 if now >= conn.reject_until:
                     self.close_conn(conn)
                 continue
-            expired: List[int] = []
+            expired: List[Tuple[int, Optional[dict]]] = []
             with conn.lock:
-                for rid, bound in list(conn.inflight.items()):
-                    if now >= bound:
-                        expired.append(rid)
+                for rid, entry in list(conn.inflight.items()):
+                    if now >= entry[0]:
+                        expired.append((rid, entry[3]))
                         del conn.inflight[rid]
-            for rid in expired:
+            for rid, jinfo in expired:
+                self.frontend._journal_row(jinfo, "timeout")
                 self.frontend._answer_error(
                     conn, rid, wire.ERR_TIMEOUT,
                     "response wait timed out")
@@ -301,6 +333,18 @@ class _IoLoop(threading.Thread):
             conn.sock.close()
         except OSError:
             pass
+        # shm teardown: drop request-segment mappings (the client owns
+        # and unlinks those) and unlink our response ring. A mapping
+        # pinned by a still-live tensor view refuses to close
+        # (BufferError) — it falls to process exit, never to a crash.
+        for seg in conn.shm_segs.values():
+            try:
+                seg.close()
+            except Exception:
+                pass
+        conn.shm_segs.clear()
+        if conn.shm_ring is not None:
+            conn.shm_ring.close()
         self.conns.discard(conn)
         self.frontend._conn_closed()
 
@@ -319,7 +363,9 @@ class BinaryFrontend:
                  default_deadline_s: Optional[float] = None,
                  max_connections: int = 4096,
                  tenants: Optional[TenantAdmission] = None,
-                 logger: Optional[Logger] = None):
+                 logger: Optional[Logger] = None,
+                 enable_shm: bool = True,
+                 journal: Optional[Logger] = None):
         assert io_threads >= 1
         self.backend = backend
         self.adapter = BackendAdapter(backend)
@@ -329,6 +375,20 @@ class BinaryFrontend:
         self.max_connections = int(max_connections)
         self.tenants = tenants
         self.log = logger
+        # spkn-shm: grant FLAG_SHM to same-host peers (serve/shm.py).
+        # Sweep segments orphaned by kill -9'd predecessors BEFORE any
+        # ring exists — a crashed replica must not leak /dev/shm forever.
+        self.enable_shm = bool(enable_shm) and shm.shm_available()
+        self.swept_segments = (shm.sweep_orphans()
+                               if self.enable_shm else [])
+        # request journal (ROADMAP 5a): one JSONL row per request frame
+        # — arrival shape + outcome — for replaying real traffic shapes
+        self.journal = journal
+        # tensor payload bytes that crossed THIS socket, per direction
+        # (headers/meta excluded). The shm bench arm pins rx == tx == 0.
+        self.payload_rx_bytes = 0
+        self.payload_tx_bytes = 0
+        self._byte_lock = threading.Lock()
         self.registry = backend.registry
         self._c_req, self._c_conns, self._g_active, self._c_shed = \
             register_transport_metrics(self.registry, self.transport)
@@ -356,7 +416,11 @@ class BinaryFrontend:
         if logger is not None:
             logger.log(f"serve: binary data plane at "
                        f"spkn://{self.address[0]}:{self.address[1]} "
-                       f"({io_threads} io threads)")
+                       f"({io_threads} io threads, shm "
+                       f"{'on' if self.enable_shm else 'off'})")
+            if self.swept_segments:
+                logger.log(f"serve: swept {len(self.swept_segments)} "
+                           f"orphaned shm segment(s) from dead peers")
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -450,6 +514,28 @@ class BinaryFrontend:
             payload = bytes(conn.rbuf[wire.HEADER_LEN + meta_len:
                                       frame_len])
             del conn.rbuf[:frame_len]
+            if ftype == wire.T_CANCEL:
+                # best-effort: reaches the batcher's queue entry if the
+                # request hasn't formed yet (its future then resolves
+                # with the typed `cancelled` error and answers the rid);
+                # a cancel that lost the race — or names an unknown/
+                # already-answered id — is silently dropped
+                with conn.lock:
+                    entry = conn.inflight.get(req_id)
+                if entry is not None:
+                    self.adapter.cancel(entry[2], entry[1])
+                continue
+            if ftype == wire.T_SHM_HELLO:
+                self._handle_shm_hello(conn, req_id, meta)
+                continue
+            if ftype == wire.T_SHM_RELEASE:
+                try:
+                    name = wire.unpack_shm_release_meta(meta)
+                except wire.WireError:
+                    continue  # malformed release: the slot stays busy
+                if conn.shm_ring is not None:
+                    conn.shm_ring.release(name)
+                continue
             if ftype != wire.T_REQUEST:
                 self._answer_error(
                     conn, req_id, wire.ERR_BAD_REQUEST,
@@ -457,6 +543,24 @@ class BinaryFrontend:
                     f"REQUEST frames)")
                 continue
             self._handle_request(conn, flags, req_id, meta, payload)
+
+    def _handle_shm_hello(self, conn: _Conn, req_id: int,
+                          meta: bytes) -> None:
+        """Grant FLAG_SHM iff the peer proved same-host residency by
+        writing a nonce we can read back through OUR filesystem. Any
+        failure is a quiet deny — the connection proceeds inline."""
+        ok = False
+        if self.enable_shm:
+            try:
+                path, nonce = wire.unpack_shm_hello_meta(meta)
+                ok = shm.check_nonce(path, nonce)
+            except wire.WireError:
+                ok = False
+        if ok and conn.shm_ring is None:
+            conn.shm_ring = shm.ShmRing()
+        conn.shm_ok = ok
+        self._enqueue(conn, [(wire.pack_shm_hello_ack(req_id, ok),
+                              None)])
 
     def _handle_request(self, conn: _Conn, flags: int, req_id: int,
                         meta: bytes, payload: bytes) -> None:
@@ -474,9 +578,18 @@ class BinaryFrontend:
                 f"request id {req_id} is already in flight on this "
                 f"connection")
             return
+        jinfo = None
         try:
-            model_s, tenant, priority, deadline_ms, descs = \
+            model_s, tenant, priority, deadline_ms, descs, seg = \
                 wire.unpack_request_meta(meta)
+            if self.journal is not None:
+                jinfo = {"transport": self.transport,
+                         "model": model_s or "",
+                         "tenant": tenant or "",
+                         "priority": priority or "",
+                         "deadline_ms": deadline_ms,
+                         "sizes": {d.name: int(d.nbytes)
+                                   for d in descs}}
             # admission runs BEFORE tensor decode / model resolution
             # (the HTTP rule): a shed tenant's flood must not buy
             # io-thread decode time, and a malformed request still
@@ -486,6 +599,7 @@ class BinaryFrontend:
                       if self.tenants is not None else None)
             if reason is not None:
                 self._c_shed.inc(model=model_s or "", reason=reason)
+                self._journal_row(jinfo, reason)
                 self._answer_error(
                     conn, req_id,
                     wire.ERR_TENANT_LIMIT if reason == "tenant_limit"
@@ -494,14 +608,35 @@ class BinaryFrontend:
                     if reason == "tenant_limit" else
                     "shed by priority class under admission pressure")
                 return
-            inputs = wire.tensors_from(descs, payload)
+            if seg is not None:
+                # spkn-shm request: the payload lives in the client's
+                # named segment; map it (cached per connection — the
+                # ring reuses names) and view the tensors in place.
+                # Batch formation copies rows into bucket buffers before
+                # the reply, so the client reusing the slot after its
+                # terminal reply can never race a live view.
+                if not conn.shm_ok:
+                    raise ValueError(
+                        "FLAG_SHM request without a granted SHM_HELLO "
+                        "on this connection")
+                segobj = conn.shm_segs.get(seg)
+                if segobj is None:
+                    segobj = shm.attach(seg)
+                    conn.shm_segs[seg] = segobj
+                inputs = wire.tensors_from(descs, segobj.buf)
+            else:
+                inputs = wire.tensors_from(descs, payload)
+                with self._byte_lock:
+                    self.payload_rx_bytes += len(payload)
             model = self.adapter.resolve(model_s or None)
             self.adapter.coerce(model, inputs)
             deadline_s = (deadline_ms / 1e3 if deadline_ms is not None
                           else self.default_deadline_s)
             fut = self.adapter.submit(model, inputs, deadline_s)
         except BaseException as e:
-            self._answer_error(conn, req_id, *_exception_to_err(e))
+            ck, msg = _exception_to_err(e)
+            self._journal_row(jinfo, ck[1])
+            self._answer_error(conn, req_id, ck, msg)
             return
         bound = time.monotonic() + (
             deadline_s + 5.0 if deadline_s is not None
@@ -509,7 +644,7 @@ class BinaryFrontend:
         with conn.lock:
             if conn.closed:
                 return
-            conn.inflight[req_id] = bound
+            conn.inflight[req_id] = (bound, fut, model, jinfo)
         fut.add_done_callback(
             lambda f, c=conn, r=req_id, s=stream, m=model:
             self._complete(c, r, s, m, f))
@@ -519,20 +654,57 @@ class BinaryFrontend:
     def _complete(self, conn: _Conn, req_id: int, stream: bool,
                   model: str, fut) -> None:
         with conn.lock:
-            live = conn.inflight.pop(req_id, None) is not None
-        if not live:
+            entry = conn.inflight.pop(req_id, None)
+        if entry is None:
             return  # reaped (already answered) or connection gone
+        jinfo = entry[3]
         exc = fut.exception()
         if exc is not None:
-            self._answer_error(conn, req_id, *_exception_to_err(exc))
+            ck, msg = _exception_to_err(exc)
+            self._journal_row(jinfo, ck[1])
+            self._answer_error(conn, req_id, ck, msg)
             return
+        # queue wait: stamped on the batcher future at batch formation
+        # (server.py) — rides the response meta so clients can split
+        # tail latency into queueing vs compute
+        qw = getattr(fut, "_spkn_queue_wait_s", None)
+        qw_ms = None if qw is None else qw * 1e3
         out = {k: np.asarray(v) for k, v in fut.result().items()}
-        items = wire.pack_response(req_id, model,
-                                   self.adapter.step(model), out,
-                                   stream=stream,
-                                   chunk_bytes=self.chunk_bytes)
+        items = None
+        if conn.shm_ok and not stream and conn.shm_ring is not None:
+            # spkn-shm response: copy the payload into a ring slot and
+            # send only the descriptor table. A full ring (all slots
+            # awaiting SHM_RELEASE) falls back to inline — the protocol
+            # never blocks on the ring.
+            descs, views, total = wire.build_table(out)
+            slot = conn.shm_ring.acquire(total) if total else None
+            if slot is not None:
+                name, view = slot
+                shm.copy_into(view, views)
+                items = wire.pack_response(
+                    req_id, model, self.adapter.step(model), out,
+                    queue_wait_ms=qw_ms, shm_seg=name)
+        if items is None:
+            items = wire.pack_response(req_id, model,
+                                       self.adapter.step(model), out,
+                                       stream=stream,
+                                       chunk_bytes=self.chunk_bytes,
+                                       queue_wait_ms=qw_ms)
+        self._journal_row(jinfo, "ok", queue_wait_ms=qw_ms)
         self._c_req.inc(code="200", transport=self.transport)
         self._enqueue(conn, items)
+
+    def _journal_row(self, jinfo: Optional[dict], outcome: str,
+                     queue_wait_ms: Optional[float] = None) -> None:
+        """One JSONL row per answered request frame (--request-journal).
+        Best-effort: a journal failure must never fail the data plane."""
+        if jinfo is None or self.journal is None:
+            return
+        try:
+            self.journal.metrics(0, kind="request", outcome=outcome,
+                                 queue_wait_ms=queue_wait_ms, **jinfo)
+        except Exception:
+            pass
 
     # -- reply plumbing (any thread) ------------------------------------------
 
@@ -549,6 +721,7 @@ class BinaryFrontend:
                  items: List[Tuple[bytes, Optional[memoryview]]]) -> None:
         if conn.closed:
             return
+        tx = 0
         with conn.lock:
             for head, view in items:
                 if head:
@@ -556,8 +729,12 @@ class BinaryFrontend:
                     conn.copied_pending += len(head)
                 if view is not None and len(view):
                     conn.outbox.append((view, False))
+                    tx += len(view)
             conn.peak_copied = max(conn.peak_copied, conn.copied_pending)
             peak = conn.peak_copied
+        if tx:
+            with self._byte_lock:
+                self.payload_tx_bytes += tx
         # the bench's buffer_bounded_by_chunk acceptance reads this
         # high-water mark: the max-update must not lose a racing larger
         # sample to an unsynchronized read-compare-write
@@ -594,13 +771,25 @@ class BinaryClient:
     server chose) until that id resolves — so N submits followed by N
     collects is a pipelined burst on one connection. `infer` is the
     one-shot convenience and records `last_timing` (first-byte /
-    complete, seconds from submit) — the streaming bench reads it.
+    complete, seconds from submit; plus the server-reported
+    `queue_wait_ms` when known) — the bench reads it.
 
-    Thread-safety: one connection, one user thread (the thread-cached
-    `binary_infer` below gives each thread its own client)."""
+    spkn-shm: `use_shm=None` auto-offers the shared-memory transport to
+    loopback servers (SHM_HELLO handshake at connect); the server's
+    same-host nonce check decides. Granted, tensor payloads ride named
+    segments in both directions and zero payload bytes cross the
+    socket; denied (remote peer, shm-less build), everything falls back
+    inline transparently.
+
+    Thread-safety: one connection, one user thread — except `cancel`,
+    which the router's hedge scheduler may call from its own thread
+    (all socket WRITES serialize on `_wlock`; reads stay single-owner).
+    The thread-cached `binary_infer` below gives each thread its own
+    client."""
 
     def __init__(self, host, port: Optional[int] = None,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0,
+                 use_shm: Optional[bool] = None):
         if port is None:
             host, port = _parse_address(host)
         self.addr = (host, int(port))
@@ -613,13 +802,54 @@ class BinaryClient:
             pass
         self._rbuf = bytearray()
         self._ids = itertools.count(1)
+        self._wlock = threading.Lock()
         # req_id -> reassembly state (supports out-of-order completion)
         self._pending: Dict[int, Dict[str, Any]] = {}
         self.last_timing: Optional[Dict[str, float]] = None
         self.closed = False
+        # tensor payload bytes that crossed the socket, per direction
+        self.payload_tx_bytes = 0
+        self.payload_rx_bytes = 0
+        self._shm_granted: Optional[bool] = None
+        self._ring = None   # request-segment ring (ours; slots freed on
+        #                     the rid's terminal reply)
+        self._segs: Dict[str, Any] = {}  # attached response segments
+        if use_shm is None:
+            use_shm = host in ("127.0.0.1", "localhost", "::1")
+        if use_shm and shm.shm_available():
+            self._shm_handshake()
+
+    def _shm_handshake(self) -> None:
+        """Offer spkn-shm: write the same-host nonce, send SHM_HELLO,
+        block (briefly) for the ack. Any failure — old server, remote
+        filesystem, timeout — quietly leaves the connection inline."""
+        path, nonce = shm.write_nonce()
+        try:
+            rid = next(self._ids)
+            self.sock.settimeout(self.timeout)
+            with self._wlock:
+                self.sock.sendall(wire.pack_shm_hello(rid, path, nonce))
+            deadline = time.perf_counter() + min(self.timeout, 5.0)
+            while self._shm_granted is None:
+                self._read_frame(deadline)
+        except (OSError, TimeoutError, ConnectionError, wire.WireError):
+            self._shm_granted = False
+        finally:
+            shm.cleanup_nonce(path)
+        if self._shm_granted:
+            self._ring = shm.ShmRing()
 
     def close(self) -> None:
         self.closed = True
+        if self._ring is not None:
+            self._ring.close()
+            self._ring = None
+        for seg in self._segs.values():
+            try:
+                seg.close()
+            except Exception:
+                pass  # a live tensor view pins the mapping; leave it
+        self._segs.clear()
         try:
             self.sock.close()
         except OSError:
@@ -633,22 +863,54 @@ class BinaryClient:
                priority: Optional[str] = None,
                stream: bool = False) -> int:
         rid = next(self._ids)
+        arrays = {k: np.asarray(v) for k, v in payload.items()}
+        seg_name = None
+        if self._ring is not None:
+            # spkn-shm: copy the payload into a ring slot; the frame
+            # then carries only the descriptor table. Ring full -> None
+            # -> this request goes inline (never blocks).
+            descs, pviews, total = wire.build_table(arrays)
+            slot = self._ring.acquire(total) if total else None
+            if slot is not None:
+                seg_name, view = slot
+                shm.copy_into(view, pviews)
         head, views = wire.pack_request(
-            rid, model, {k: np.asarray(v) for k, v in payload.items()},
+            rid, model, arrays,
             deadline_ms=None if deadline_s is None else deadline_s * 1e3,
-            tenant=tenant, priority=priority, stream=stream)
+            tenant=tenant, priority=priority, stream=stream,
+            shm_seg=seg_name)
         self._pending[rid] = {"t_submit": time.perf_counter(),
                               "t_first": None, "done": False,
                               "outputs": None, "exc": None,
                               "buf": None, "descs": None, "got": 0,
-                              "total": 0, "model": None, "step": None}
+                              "total": 0, "model": None, "step": None,
+                              "queue_wait_ms": None,
+                              "shm_seg": seg_name}
         # _fill shrinks the socket timeout toward a deadline; a cached
         # client's NEXT send must not inherit that sliver
         self.sock.settimeout(self.timeout)
-        self.sock.sendall(head)
-        for v in views:
-            self.sock.sendall(v)
+        with self._wlock:
+            self.sock.sendall(head)
+            for v in views:
+                self.sock.sendall(v)
+        self.payload_tx_bytes += sum(len(v) for v in views)
         return rid
+
+    def cancel(self, rid: int) -> None:
+        """Fire-and-forget CANCEL for an in-flight request id (the
+        hedging router's losing leg). If the server's batcher still
+        holds the request, the rid resolves with the typed `cancelled`
+        error; otherwise the normal reply arrives — either way exactly
+        one terminal frame. Safe to call from a thread other than the
+        connection's owner (write-locked); send failures are swallowed
+        (cancel is an optimization, never a correctness dependency)."""
+        if self.closed or rid not in self._pending:
+            return
+        try:
+            with self._wlock:
+                self.sock.sendall(wire.pack_cancel(rid))
+        except OSError:
+            pass
 
     # -- receive side --------------------------------------------------------
 
@@ -700,15 +962,41 @@ class BinaryClient:
                 if st["t_first"] is None:
                     st["t_first"] = now
             return
+        if ftype == wire.T_SHM_HELLO:
+            # the handshake ack (FLAG_LAST); rid is the hello's own id
+            try:
+                self._shm_granted = wire.unpack_shm_hello_ack_meta(meta)
+            except wire.WireError:
+                self._shm_granted = False
+            return
         st = self._pending.get(rid)
         if st is None:
             return  # reply to an abandoned id: drop it
         if st["t_first"] is None:
             st["t_first"] = now
         if ftype == wire.T_RESPONSE:
-            model, step, descs = wire.unpack_response_meta(meta)
+            model, step, queue_wait_ms, descs, seg = \
+                wire.unpack_response_meta(meta)
             st["model"], st["step"], st["descs"] = model, step, descs
-            if flags & wire.FLAG_STREAM:
+            st["queue_wait_ms"] = queue_wait_ms
+            if flags & wire.FLAG_SHM and seg is not None:
+                # spkn-shm response: map the server's segment, copy the
+                # tensors OUT (np.array), then release the slot — the
+                # returned arrays must outlive the server's reuse of it
+                segobj = self._segs.get(seg)
+                if segobj is None:
+                    segobj = shm.attach(seg)
+                    self._segs[seg] = segobj
+                outs = wire.tensors_from(descs, segobj.buf)
+                st["outputs"] = {k: np.array(v)
+                                 for k, v in outs.items()}
+                st["done"] = True
+                try:
+                    with self._wlock:
+                        self.sock.sendall(wire.pack_shm_release(seg))
+                except OSError:
+                    pass  # a dead socket surfaces on the next read
+            elif flags & wire.FLAG_STREAM:
                 st["total"] = payload_len
                 st["buf"] = bytearray(payload_len)
                 if payload_len == 0:
@@ -717,6 +1005,7 @@ class BinaryClient:
             else:
                 st["outputs"] = wire.tensors_from(descs, payload)
                 st["done"] = True
+                self.payload_rx_bytes += len(payload)
         elif ftype == wire.T_CHUNK:
             off = wire.unpack_chunk_meta(meta)
             if st["buf"] is None or off + len(payload) > st["total"]:
@@ -725,6 +1014,7 @@ class BinaryClient:
                     f"payload")
             st["buf"][off:off + len(payload)] = payload
             st["got"] += len(payload)
+            self.payload_rx_bytes += len(payload)
             if st["got"] >= st["total"] or flags & wire.FLAG_LAST:
                 if st["got"] < st["total"]:
                     raise wire.WireError(
@@ -752,10 +1042,16 @@ class BinaryClient:
                 raise KeyError(f"unknown request id {rid}")
             if st["done"]:
                 self._pending.pop(rid)
+                # terminal reply: the server is done reading our shm
+                # request slot (formation copied the rows before the
+                # forward) — free it for the next submit
+                if st["shm_seg"] is not None and self._ring is not None:
+                    self._ring.release(st["shm_seg"])
                 self.last_timing = {
                     "t_first_byte_s": st["t_first"] - st["t_submit"],
                     "t_complete_s":
-                        time.perf_counter() - st["t_submit"]}
+                        time.perf_counter() - st["t_submit"],
+                    "queue_wait_ms": st["queue_wait_ms"]}
                 if st["exc"] is not None:
                     raise_for_error(*st["exc"])
                 return st["outputs"]
@@ -778,17 +1074,23 @@ _client_cache = threading.local()
 MAX_CACHED_CLIENTS = 8  # per thread; LRU-evicted past this
 
 
-def _cached_client(host: str, port: int, timeout: float) -> BinaryClient:
+def _cached_client(host: str, port: int, timeout: float,
+                   use_shm: Optional[bool] = None) -> BinaryClient:
+    # use_shm is part of the key: an A/B driver forcing the transport
+    # per call must never be handed a cached client negotiated the
+    # other way
     cli = lru_cache_get(
-        _client_cache, "clients", (host, port),
-        lambda: BinaryClient(host, port, timeout=timeout),
+        _client_cache, "clients", (host, port, use_shm),
+        lambda: BinaryClient(host, port, timeout=timeout,
+                             use_shm=use_shm),
         MAX_CACHED_CLIENTS)
     cli.timeout = float(timeout)
     return cli
 
 
-def _drop_client(host: str, port: int) -> None:
-    lru_cache_drop(_client_cache, "clients", (host, port))
+def _drop_client(host: str, port: int,
+                 use_shm: Optional[bool] = None) -> None:
+    lru_cache_drop(_client_cache, "clients", (host, port, use_shm))
 
 
 def binary_infer(address, model: str,
@@ -797,37 +1099,50 @@ def binary_infer(address, model: str,
                  timeout: float = 30.0,
                  tenant: Optional[str] = None,
                  priority: Optional[str] = None,
-                 stream: bool = False) -> Dict[str, np.ndarray]:
+                 stream: bool = False,
+                 cancel_box: Optional[dict] = None,
+                 use_shm: Optional[bool] = None
+                 ) -> Dict[str, np.ndarray]:
     """One inference request over the binary transport (thread-cached
     keep-alive client — the `http_infer` counterpart the router's
     binary remote replicas and the bench drivers ride). The http_infer
     cache rules apply: ANY failure mid-exchange evicts this address's
     cached client (never re-use a stream in an unknown state); a stale
-    server-closed socket gets ONE retry on a fresh connection."""
+    server-closed socket gets ONE retry on a fresh connection.
+
+    `cancel_box`: when given, a best-effort `cancel` callable for THIS
+    request is stored under "cancel" once it is on the wire — the
+    hedging router calls it (from its scheduler thread) to cancel the
+    losing leg."""
     host, port = _parse_address(address)
     for attempt in (0, 1):
-        cli = _cached_client(host, port, timeout)
+        cli = _cached_client(host, port, timeout, use_shm)
         try:
-            return cli.infer(payload, model=model, deadline_s=deadline_s,
-                             tenant=tenant, priority=priority,
-                             stream=stream, timeout=timeout)
+            rid = cli.submit(payload, model=model,
+                             deadline_s=deadline_s, tenant=tenant,
+                             priority=priority, stream=stream)
+            if cancel_box is not None:
+                cancel_box["cancel"] = \
+                    lambda c=cli, r=rid: c.cancel(r)
+            return cli.collect(rid, timeout=timeout)
         except (TenantLimitError, QueueFullError, DeadlineExpiredError,
+                RequestCancelledError,
                 NoReplicaError, UnknownModelError, ValueError):
             # typed sheds arrived ON the stream, which is usually still
             # good — except a connection-level frame (rid 0, e.g.
             # over_capacity), whose delivery closed the client
             if cli.closed:
-                _drop_client(host, port)
+                _drop_client(host, port, use_shm)
             raise
         except TimeoutError:
-            _drop_client(host, port)
+            _drop_client(host, port, use_shm)
             raise  # a slow server is not a stale socket: no retry
         except ConnectionError as e:
             # a server-closed cached connection: retry once fresh
-            _drop_client(host, port)
+            _drop_client(host, port, use_shm)
             if attempt:
                 raise ConnectionError(
                     f"binary_infer to {address}: {e}") from e
         except BaseException:
-            _drop_client(host, port)
+            _drop_client(host, port, use_shm)
             raise

@@ -187,6 +187,21 @@ class BackendAdapter:
         lane = self.lanes().get(model)
         return None if lane is None else lane.manager.step
 
+    def cancel(self, model: str, fut) -> bool:
+        """Best-effort cancel of a submitted request BY ITS FUTURE:
+        reaches the lane batcher's queue entry if the request hasn't
+        formed into a batch yet. Returns False when the future is not a
+        queued batcher future (already formed, remote-proxied, or a
+        router-chained wrapper) — the caller drops the cancel and the
+        request completes normally."""
+        lane = self.lanes().get(model)
+        if lane is None:
+            return False
+        try:
+            return bool(lane.batcher.cancel(fut))
+        except Exception:
+            return False
+
     def healthy(self) -> bool:
         return (self.backend.healthy()
                 if hasattr(self.backend, "healthy") else True)
@@ -230,11 +245,15 @@ class HttpFrontend:
                  idle_timeout_s: float = 60.0,
                  max_connections: int = 256,
                  tenants: Optional[TenantAdmission] = None,
-                 logger: Optional[Logger] = None):
+                 logger: Optional[Logger] = None,
+                 journal: Optional[Logger] = None):
         self.backend = backend
         self.adapter = BackendAdapter(backend)
         self.is_router = self.adapter.is_router
         self.default_deadline_s = default_deadline_s
+        # request journal (ROADMAP 5a): one JSONL row per decoded
+        # request — arrival shape, not outcome — for trace replay
+        self.journal = journal
         self.retry_after_s = float(retry_after_s)
         self.max_body_bytes = int(max_body_bytes)
         self.idle_timeout_s = float(idle_timeout_s)
@@ -407,11 +426,29 @@ class HttpFrontend:
             payload, deadline_ms = self._decode(model, body, ctype, h)
             deadline_s = (deadline_ms / 1e3 if deadline_ms is not None
                           else self.default_deadline_s)
+            if self.journal is not None:
+                try:
+                    self.journal.metrics(
+                        0, kind="request", transport="http",
+                        model=model or "",
+                        tenant=h.headers.get("X-Tenant") or "",
+                        priority=h.headers.get("X-Priority") or "",
+                        deadline_ms=deadline_ms,
+                        sizes={k: int(np.asarray(v).nbytes)
+                               for k, v in payload.items()})
+                except Exception:
+                    pass  # the journal must never fail the data plane
             model, fut = self._submit(model, payload, deadline_s)
             # shed-not-hang: the batcher fails the future at the deadline
             # (DeadlineExpiredError); without one we still bound the wait
             wait_s = deadline_s + 5.0 if deadline_s is not None else 30.0
             out = fut.result(timeout=wait_s)
+            # time-in-queue before forward start, stamped on the future
+            # at batch formation — lets a client split its observed
+            # latency into queueing vs compute
+            qw = getattr(fut, "_spkn_queue_wait_s", None)
+            qw_hdr = ({} if qw is None
+                      else {"X-Queue-Wait-Ms": f"{qw * 1e3:.3f}"})
             if want_npz:
                 step = self._step(model)
                 self._reply_bytes(h, 200, _encode_npz(out),
@@ -419,14 +456,15 @@ class HttpFrontend:
                                   extra={"X-Model": model,
                                          "X-Model-Step":
                                          str(-1 if step is None
-                                             else step)})
+                                             else step), **qw_hdr})
             else:
                 self._reply(h, 200, {
                     "model": model, "step": self._step(model),
                     "latency_ms": round(
                         (time.perf_counter() - t0) * 1e3, 3),
                     "outputs": {k: np.asarray(v).tolist()
-                                for k, v in out.items()}})
+                                for k, v in out.items()}},
+                    extra=qw_hdr)
         except _BodyReadTimeout:
             # half-read body: the stream is desynced — answer AND close
             self._reply(h, 408, {"error": "timed out reading the "
@@ -531,10 +569,11 @@ class HttpFrontend:
     # -- replies -------------------------------------------------------------
 
     def _reply(self, h, code: int, obj: Dict[str, Any],
-               retry_after: bool = False, close: bool = False) -> None:
+               retry_after: bool = False, close: bool = False,
+               extra: Optional[Dict[str, str]] = None) -> None:
         self._reply_bytes(h, code, json.dumps(obj).encode(),
                           "application/json", retry_after=retry_after,
-                          close=close)
+                          close=close, extra=extra)
 
     def _reply_bytes(self, h, code: int, data: bytes, ctype: str,
                      retry_after: bool = False, close: bool = False,

@@ -29,16 +29,19 @@ than refuse), then `NoReplicaError` — which the HTTP frontend maps to
 """
 from __future__ import annotations
 
+import heapq
+import itertools
 import threading
 import time
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import Future, InvalidStateError, ThreadPoolExecutor
 from dataclasses import dataclass, replace
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..obs import MetricsRegistry, StatusServer, register_build_info
 from ..utils.heartbeat import HeartbeatWriter, read_heartbeat, staleness_s
 from ..utils.logger import Logger
 from ..utils.metrics import LatencyStats
+from .batcher import RequestCancelledError
 from .server import InferenceServer, ServeConfig
 
 
@@ -73,6 +76,33 @@ def heartbeat_health(path: str, stale_after_s: float = 60.0,
     return probe
 
 
+def heartbeat_fill(path: str, model: str, min_refresh_s: float = 1.0
+                   ) -> Callable[[], Optional[float]]:
+    """A replica batch-fill probe over the same heartbeat rows health
+    rides on: reads `models[model].recent_occupancy` (falling back to
+    `batch_fill` for older replicas) from the beat, cached
+    `min_refresh_s` — the coalescing trigger's remote signal."""
+    state = {"t": 0.0, "fill": None}
+    lock = threading.Lock()
+
+    def probe() -> Optional[float]:
+        with lock:
+            now = time.monotonic()
+            if now - state["t"] >= min_refresh_s:
+                hb = read_heartbeat(path)
+                row = ((hb or {}).get("models") or {}).get(model) or {}
+                # prefer the occupancy signal (capacity-relative, what
+                # coalescing improves); older replicas only beat the
+                # bucket-relative cumulative fill
+                fill = row.get("recent_occupancy")
+                if fill is None:
+                    fill = row.get("batch_fill")
+                state["fill"] = float(fill) if fill is not None else None
+                state["t"] = now
+            return state["fill"]
+    return probe
+
+
 class Replica:
     """One serving copy of a model: the local lane, or a remote frontend
     address. `health_fn` (remote) answers "is it alive" — typically
@@ -84,7 +114,8 @@ class Replica:
     def __init__(self, name: str, lane: Optional[InferenceServer] = None,
                  url: Optional[str] = None,
                  health_fn: Optional[Callable[[], bool]] = None,
-                 transport: str = "http"):
+                 transport: str = "http",
+                 fill_fn: Optional[Callable[[], Optional[float]]] = None):
         assert (lane is None) != (url is None), \
             "a replica is exactly one of: local lane, remote url"
         assert transport in ("http", "binary"), transport
@@ -93,8 +124,24 @@ class Replica:
         self.url = url.rstrip("/") if url else None
         self.transport = transport
         self.health_fn = health_fn
+        # batch-fill signal for coalesced formation: local lanes read
+        # their FillMeter's recent window; remotes read batch_fill out
+        # of the same cached heartbeat rows health rides on. None =
+        # no signal (this replica neither triggers nor vetoes)
+        self.fill_fn = fill_fn
         self._draining = False
         self._fail_t = 0.0  # monotonic time of the last transport error
+
+    def fill_signal(self) -> Optional[float]:
+        """Recent batch occupancy in [0,1], or None with no signal."""
+        if self.lane is not None:
+            return self.lane.fill_signal()
+        if self.fill_fn is not None:
+            try:
+                return self.fill_fn()
+            except Exception:
+                return None
+        return None
 
     def note_failure(self) -> None:
         """A proxy hop to this replica just failed at the transport
@@ -148,6 +195,37 @@ class RouterConfig:
     # demoted for this long (note_failure): the fast complement of the
     # heartbeat staleness rule
     conn_fail_cooldown_s: float = 1.0
+    # -- request hedging (Dean & Barroso's tied requests, on the
+    # pipelined wire): after an adaptive delay a still-unanswered
+    # request is re-issued to a SECOND healthy replica; first answer
+    # wins, the loser is cancelled best-effort (batcher removal locally,
+    # a CANCEL frame remotely). Needs >= 2 replicas to do anything.
+    hedge: bool = False
+    # the adaptive delay: this quantile of the model's live windowed
+    # routed latency (requests slower than p95 are, by construction, the
+    # tail worth re-issuing), floored at hedge_min_delay_ms (also the
+    # delay used before the window has any signal)
+    hedge_quantile: float = 0.95
+    hedge_window_s: float = 30.0
+    hedge_min_delay_ms: float = 2.0
+    # hedges are capped at this fraction of routed requests so hedging
+    # can't melt an overloaded fleet — and they are disabled entirely
+    # while admission pressure is nonzero (attach_admission): an
+    # overload signal means extra copies are the LAST thing to add
+    hedge_budget: float = 0.05
+    # spkn-shm on binary proxy hops: None = the client's loopback
+    # autodetect (shared-memory transport to colocated replicas, inline
+    # to remote ones), True/False force it — the bench A/B arms pin the
+    # transport per arm with this
+    proxy_shm: Optional[bool] = None
+    # -- coalesced batch formation: when every replica reporting a fill
+    # signal shows recent fill below the threshold, route consecutive
+    # requests to ONE focus replica per formation window (rotated per
+    # window for fairness) instead of round-robin spraying a trickle
+    # into N fragmented batches
+    coalesce: bool = False
+    coalesce_window_ms: float = 25.0
+    coalesce_fill_threshold: float = 0.5
     # observability (shared across all lanes)
     status_port: Optional[int] = None   # None = no HTTP; 0 = ephemeral
     status_host: str = "127.0.0.1"
@@ -197,6 +275,20 @@ class ModelRouter:
         self._running = False
         self._http = None
         self.fleet = None  # FleetController attaches here (attach_fleet)
+        # PriorityAdmission attaches here (attach_admission): its
+        # .pressure gates hedging — no extra copies under overload
+        self.admission = None
+        # hedging: pending (fire_t, seq, entry) heap drained by one
+        # scheduler thread; per-model [routed, hedged] counts enforce
+        # the budget
+        self._hedge_heap: List[Any] = []
+        self._hedge_cv = threading.Condition()
+        self._hedge_seq = itertools.count()
+        self._hedge_counts: Dict[str, List[int]] = {}
+        self._hedge_thread: Optional[threading.Thread] = None
+        # coalesced formation: per-model {"until", "focus", "active"}
+        self._co: Dict[str, Dict[str, Any]] = {}
+        self._co_lock = threading.Lock()
         self.heartbeat = (HeartbeatWriter(cfg.heartbeat_path, role="serve",
                                           interval_s=cfg.heartbeat_every_s,
                                           registry=self.registry)
@@ -216,6 +308,19 @@ class ModelRouter:
             "sparknet_serve_replica_failovers_total",
             "proxy hops that failed at the transport level and were "
             "retried on another replica", labels=("model", "replica"))
+        self._c_hedged = self.registry.counter(
+            "sparknet_serve_hedged_total",
+            "hedged requests by which leg answered first "
+            "(won=primary|hedge)", labels=("model", "won"))
+        self._c_hedge_cancelled = self.registry.counter(
+            "sparknet_serve_hedge_cancelled_total",
+            "hedge losers confirmed cancelled before forming into a "
+            "batch (a cancel that lost the race is just dropped)",
+            labels=("model",))
+        self._c_coalesced = self.registry.counter(
+            "sparknet_serve_coalesced_total",
+            "requests routed by coalesced formation (focus replica "
+            "instead of round-robin)", labels=("model",))
         self.registry.gauge(
             "sparknet_serve_pool_workers",
             "live shared-pool worker threads (set_pool_size resizes)"
@@ -263,14 +368,18 @@ class ModelRouter:
         decides otherwise). Health comes from `health_fn`, or from
         `heartbeat_path` through the shared staleness rule; with
         neither, the replica is trusted until drained."""
+        fill_fn = None
         if health_fn is None and heartbeat_path is not None:
             health_fn = heartbeat_health(heartbeat_path,
                                          self.cfg.stale_after_s,
                                          self.cfg.health_refresh_s)
+        if heartbeat_path is not None:
+            fill_fn = heartbeat_fill(heartbeat_path, model,
+                                     self.cfg.health_refresh_s)
         if transport is None:
             transport = "binary" if url.startswith("spkn://") else "http"
         rep = Replica(f"remote:{url}", url=url, health_fn=health_fn,
-                      transport=transport)
+                      transport=transport, fill_fn=fill_fn)
         self.replicas.setdefault(model, []).append(rep)
         self._rr.setdefault(model, -1)
         self._ensure_latency(model)
@@ -307,6 +416,10 @@ class ModelRouter:
             max_workers=max(4, 2 * self.cfg.workers),
             thread_name_prefix="serve-proxy")
         self.set_pool_size(self.cfg.workers)
+        if self.cfg.hedge:
+            self._hedge_thread = threading.Thread(
+                target=self._hedge_run, name="serve-hedge", daemon=True)
+            self._hedge_thread.start()
         if self.cfg.status_port is not None:
             self._http = StatusServer(
                 self.cfg.status_port, self.registry,
@@ -319,6 +432,19 @@ class ModelRouter:
         """Bind a FleetController: /fleet/status starts answering with
         its view (the route itself is always registered)."""
         self.fleet = controller
+
+    def attach_admission(self, admission) -> None:
+        """Bind the PriorityAdmission whose `.pressure` gates hedging:
+        under any admission pressure the fleet is already shedding, and
+        a hedge is an extra copy of exactly the load being shed."""
+        self.admission = admission
+
+    def _pressure(self) -> float:
+        adm = self.admission
+        try:
+            return float(getattr(adm, "pressure", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
 
     def _fleet_status(self) -> Dict[str, Any]:
         if self.fleet is None:
@@ -364,6 +490,11 @@ class ModelRouter:
             lane.batcher.close()
         with self._wakeup:
             self._wakeup.notify_all()
+        with self._hedge_cv:
+            self._hedge_cv.notify_all()
+        if self._hedge_thread is not None:
+            self._hedge_thread.join(timeout=2.0)
+            self._hedge_thread = None
         with self._pool_lock:
             # snapshot under the lock: a racing set_pool_size (a fleet
             # controller not yet stopped) must not mutate the dict
@@ -456,6 +587,12 @@ class ModelRouter:
                         return reps[j]
             return None
 
+        if self.cfg.coalesce and exclude is None and len(reps) > 1:
+            rep = self._coalesce_pick(model, reps)
+            if rep is not None:
+                self._c_coalesced.inc(model=model)
+                return rep
+
         rep = scan(self._replica_routable)
         if rep is None:
             # degrade before refusing: a cooling-down or stale-beat
@@ -468,6 +605,82 @@ class ModelRouter:
                 f"model {model!r}: every replica is draining or down")
         return rep
 
+    def _coalesce_pick(self, model: str,
+                       reps: List[Replica]) -> Optional[Replica]:
+        """Coalesced formation: when every replica REPORTING a fill
+        signal shows recent fill under the threshold (and at least one
+        reports), consecutive requests inside one formation window all
+        go to a single FOCUS replica — a trickle that round-robin would
+        fragment into N under-filled batches forms one fuller batch
+        instead. The focus rotates to the next routable replica every
+        window, so over W windows each replica leads ~W/n of them
+        (fairness; pinned in tests). Returns None when coalescing is
+        inactive this window — the caller falls through to round-robin."""
+        now = time.monotonic()
+        with self._co_lock:
+            st = self._co.setdefault(
+                model, {"until": 0.0, "focus": -1, "active": False})
+            if now >= st["until"]:
+                st["until"] = now + self.cfg.coalesce_window_ms / 1e3
+                fills = [f for f in (r.fill_signal() for r in reps)
+                         if f is not None]
+                st["active"] = bool(fills) and all(
+                    f < self.cfg.coalesce_fill_threshold for f in fills)
+                if st["active"]:
+                    # rotate focus to the NEXT routable replica (probe
+                    # outside any hot lock is the _pick rule; this lock
+                    # is coalescing-private and probes are cached)
+                    n = len(reps)
+                    for i in range(1, n + 1):
+                        j = (st["focus"] + i) % n
+                        if self._replica_routable(reps[j]):
+                            st["focus"] = j
+                            break
+                    else:
+                        st["active"] = False
+            if not st["active"]:
+                return None
+            rep = reps[st["focus"] % len(reps)]
+        # re-check outside the window decision: a focus replica that
+        # went unroutable MID-window falls back to round-robin rather
+        # than eating requests it cannot serve
+        return rep if self._replica_routable(rep) else None
+
+    def _issue(self, rep: Replica, model: str, payload: Dict[str, Any],
+               deadline_s: Optional[float]
+               ) -> Tuple[Future, Callable[[], None]]:
+        """Issue one request LEG on a specific replica -> (future,
+        cancel_fn). cancel_fn is best-effort and idempotent: locally it
+        pulls the request out of the lane's batcher queue (a no-op once
+        it formed into a batch); remotely over the binary wire it sends
+        a CANCEL frame on the leg's request id (http legs have no cancel
+        — the loser just completes unobserved). A confirmed cancel
+        resolves the leg future with RequestCancelledError either way,
+        which is what the hedge accounting counts."""
+        if rep.lane is not None:
+            fut = rep.lane.submit(payload, deadline_s=deadline_s)
+            lane = rep.lane
+            return fut, (lambda: (lane.batcher.cancel(fut), None)[1])
+        proxy = self._proxy
+        if proxy is None or not self._running:
+            # racing stop() (or called before start): a typed shed,
+            # not an AttributeError surfacing as a 500
+            raise NoReplicaError(
+                f"model {model!r}: router is not running")
+        fut = Future()
+        cancel_box: Dict[str, Any] = {}
+        proxy.submit(self._proxy_call, rep, model, payload,
+                     deadline_s, fut, False, cancel_box)
+
+        def cancel() -> None:
+            fn = cancel_box.get("cancel")
+            if fn is not None:
+                try:
+                    fn()
+                except Exception:
+                    pass  # best-effort: a dead socket drops the cancel
+        return fut, cancel
+
     def submit(self, model: str, payload: Dict[str, Any],
                deadline_s: Optional[float] = None,
                _exclude: Optional[Replica] = None) -> Future:
@@ -476,27 +689,148 @@ class ModelRouter:
         propagates from the chosen local lane (backpressure is
         per-replica — the caller may retry, which re-routes). Served
         requests feed the per-model `self.latency` window (the fleet
-        controller's SLO-burn signal) whichever replica answered."""
+        controller's SLO-burn signal) whichever replica answered.
+
+        With hedging enabled (and >= 2 replicas, no admission pressure)
+        the returned future is an OUTER future: if the primary leg has
+        not answered within the adaptive delay, a second leg is issued
+        to another replica and the first answer wins — the loser's
+        cancel is best-effort and exactly-once delivery is the outer
+        future's first-resolution-wins."""
         rep = self._pick(model, exclude=_exclude)
         self._c_routed.inc(model=model, replica=rep.name)
-        if rep.lane is not None:
-            fut = rep.lane.submit(payload, deadline_s=deadline_s)
-        else:
-            proxy = self._proxy
-            if proxy is None or not self._running:
-                # racing stop() (or called before start): a typed shed,
-                # not an AttributeError surfacing as a 500
-                raise NoReplicaError(
-                    f"model {model!r}: router is not running")
-            fut = Future()
-            proxy.submit(self._proxy_call, rep, model, payload,
-                         deadline_s, fut)
+        fut, cancel = self._issue(rep, model, payload, deadline_s)
+        ret = fut
+        if (self.cfg.hedge and _exclude is None
+                and len(self.replicas.get(model, ())) >= 2):
+            counts = self._hedge_counts.setdefault(model, [0, 0])
+            counts[0] += 1
+            ret = self._hedge_arm(model, payload, deadline_s, rep,
+                                  fut, cancel)
         t0 = time.perf_counter()
         lat = self._ensure_latency(model)
-        fut.add_done_callback(
+        ret.add_done_callback(
             lambda f: lat.add(time.perf_counter() - t0)
             if f.exception() is None else None)
-        return fut
+        return ret
+
+    # -- hedging (tail-at-scale tied requests) --------------------------------
+
+    def _hedge_arm(self, model: str, payload: Dict[str, Any],
+                   deadline_s: Optional[float], rep: Replica,
+                   fut: Future, cancel: Callable[[], None]) -> Future:
+        """Wrap the primary leg in an OUTER future and schedule the
+        hedge decision. At fire time (adaptive delay past submit) an
+        unanswered request gets a second leg on another replica; the
+        first leg to complete resolves the outer future (exactly-once:
+        the winner is chosen under one lock) and the loser is cancelled
+        best-effort. The loser's confirmed cancellation — its future
+        resolving with RequestCancelledError — feeds
+        hedge_cancelled_total; a cancel that lost the race to batch
+        formation just means two computed answers, one delivered."""
+        outer: Future = Future()
+        lock = threading.Lock()
+        state: Dict[str, Any] = {"won": None, "hedged": False}
+        cancels: Dict[str, Optional[Callable[[], None]]] = {
+            "primary": cancel, "hedge": None}
+
+        def leg_done(which: str, f: Future) -> None:
+            loser_cancel = None
+            with lock:
+                won = state["won"] is None
+                if won:
+                    state["won"] = which
+                    other = "hedge" if which == "primary" else "primary"
+                    loser_cancel = cancels.get(other)
+                hedged = state["hedged"]
+            if not won:
+                # the losing leg: meter a CONFIRMED cancellation
+                if isinstance(f.exception(), RequestCancelledError):
+                    self._c_hedge_cancelled.inc(model=model)
+                return
+            self._chain_once(f, outer)
+            if hedged:
+                self._c_hedged.inc(model=model, won=which)
+            if loser_cancel is not None:
+                loser_cancel()
+
+        fut.add_done_callback(lambda f: leg_done("primary", f))
+
+        def fire() -> None:
+            if outer.done() or not self._running:
+                return
+            if self._pressure() > 0:
+                return  # the fleet is shedding: no extra copies
+            counts = self._hedge_counts.setdefault(model, [0, 0])
+            if counts[1] + 1 > self.cfg.hedge_budget * counts[0]:
+                return  # budget-capped: hedges can't melt the fleet
+            try:
+                rep2 = self._pick(model, exclude=rep)
+            except Exception:
+                return  # hedge target draining/down: primary stands alone
+            try:
+                fut2, cancel2 = self._issue(rep2, model, payload,
+                                            deadline_s)
+            except Exception:
+                return  # a refused hedge leg must never hurt the primary
+            counts[1] += 1
+            self._c_routed.inc(model=model, replica=rep2.name)
+            with lock:
+                state["hedged"] = True
+                cancels["hedge"] = cancel2
+                won = state["won"]
+            if won is not None:
+                cancel2()  # primary won while the leg was being issued
+            fut2.add_done_callback(lambda f: leg_done("hedge", f))
+
+        lat = self._ensure_latency(model)
+        delay = lat.windowed_quantile(self.cfg.hedge_quantile,
+                                      self.cfg.hedge_window_s)
+        delay = max(delay or 0.0, self.cfg.hedge_min_delay_ms / 1e3)
+        self._hedge_schedule(time.monotonic() + delay, fire)
+        return outer
+
+    def _hedge_schedule(self, fire_t: float,
+                        fn: Callable[[], None]) -> None:
+        with self._hedge_cv:
+            heapq.heappush(self._hedge_heap,
+                           (fire_t, next(self._hedge_seq), fn))
+            self._hedge_cv.notify()
+
+    def _hedge_run(self) -> None:
+        """The one scheduler thread: pops due hedge decisions off the
+        time heap. Decisions are cheap (a pick + an issue), so one
+        thread keeps up with any request rate the pool itself survives."""
+        while True:
+            with self._hedge_cv:
+                if not self._running:
+                    return
+                now = time.monotonic()
+                if not self._hedge_heap:
+                    self._hedge_cv.wait(timeout=0.5)
+                    continue
+                fire_t = self._hedge_heap[0][0]
+                if fire_t > now:
+                    self._hedge_cv.wait(timeout=min(fire_t - now, 0.5))
+                    continue
+                _, _, fn = heapq.heappop(self._hedge_heap)
+            try:
+                fn()
+            except Exception:
+                pass  # a failed hedge decision never takes routing down
+
+    @staticmethod
+    def _chain_once(src: Future, dst: Future) -> None:
+        """_chain, tolerant of a concurrently-resolved destination (the
+        hedging first-wins path)."""
+        try:
+            exc = src.exception()
+            if exc is not None:
+                dst.set_exception(exc)
+            else:
+                dst.set_result(src.result())
+        except InvalidStateError:
+            pass
 
     def infer(self, model: str, payload: Dict[str, Any],
               timeout: float = 30.0) -> Dict[str, Any]:
@@ -511,17 +845,23 @@ class ModelRouter:
     def _proxy_call(self, rep: Replica, model: str,
                     payload: Dict[str, Any],
                     deadline_s: Optional[float], fut: Future,
-                    retried: bool = False) -> None:
+                    retried: bool = False,
+                    cancel_box: Optional[Dict[str, Any]] = None) -> None:
         try:
             if rep.transport == "binary":
                 from .binary_frontend import binary_infer  # cycle guard
                 out = binary_infer(rep.url, model, payload,
-                                   deadline_s=deadline_s)
+                                   deadline_s=deadline_s,
+                                   cancel_box=cancel_box,
+                                   use_shm=self.cfg.proxy_shm)
             else:
                 from .http_frontend import http_infer  # cycle guard
                 out = http_infer(rep.url, model, payload,
                                  deadline_s=deadline_s)
             fut.set_result(out)
+        except RequestCancelledError as e:
+            fut.set_exception(e)  # a hedge loser's confirmed cancel —
+            #                       never a failover (nothing failed)
         except ConnectionError as e:
             # the replica refused/reset at the transport level (a kill
             # -9'd process does this long before its heartbeat goes
@@ -549,7 +889,7 @@ class ModelRouter:
                 f2.add_done_callback(lambda f: self._chain(f, fut))
             else:
                 self._proxy_call(rep2, model, payload, deadline_s, fut,
-                                 retried=True)
+                                 retried=True, cancel_box=cancel_box)
         except Exception as e:
             fut.set_exception(e)
 
@@ -690,6 +1030,8 @@ class ModelRouter:
                          for m, reps in self.replicas.items()},
             "routed_latency": {m: s.summary()
                                for m, s in self.latency.items()},
+            "hedging": {m: {"routed": c[0], "hedged": c[1]}
+                        for m, c in self._hedge_counts.items()},
             "autoscale": self.fleet is not None,
         }
 

@@ -553,8 +553,17 @@ class InferenceServer:
                 "requests_shed": self.batcher.shed,
                 "p50_ms": lat["p50_ms"], "p99_ms": lat["p99_ms"],
                 "batch_fill": round(self.fill.ratio(), 4),
+                "recent_occupancy": self.fill_signal(),
                 "swaps": self.manager.swaps,
                 "swap_failures": self.manager.swap_failures}
+
+    def fill_signal(self) -> Optional[float]:
+        """Recent batch occupancy vs max_batch in [0,1] (None until a
+        batch forms) — the router's coalesced-formation trigger. NOT
+        bucket-relative fill: a fragmented trickle pads into bucket 1
+        at fill 1.0, while its occupancy is 1/max_batch."""
+        occ = self.fill.recent_occupancy(self.cfg.max_batch)
+        return None if occ is None else round(occ, 4)
 
     def _serve_batch(self, reqs: List[ServeRequest]) -> None:
         # heterogeneous traffic: group by input signature so one
@@ -630,6 +639,13 @@ class InferenceServer:
     def _forward_group_inner(self, reqs: List[ServeRequest]) -> None:
         n = len(reqs)
         bucket = next(b for b in self.buckets if b >= n)
+        # queue-wait: submit -> forward start, stamped on each future so
+        # the frontends can surface it on the wire (RESPONSE meta /
+        # X-Queue-Wait-Ms) — the split that tells a hedging tuner
+        # whether the tail is queueing or compute
+        t_form = time.perf_counter()
+        for r in reqs:
+            r.future._spkn_queue_wait_s = t_form - r.t_enqueue
         try:
             full = self._bucket_batch(reqs, bucket)
             t_fwd0 = time.perf_counter()

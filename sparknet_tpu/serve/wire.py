@@ -15,9 +15,14 @@ Frame layout (everything little-endian)::
 
     offset  size  field
     0       4     magic      b"SPK1"
-    4       1     version    2 (1 = the pre-priority REQUEST meta)
+    4       1     version    3 (2 = pre-queue-wait RESPONSE meta,
+                             1 = the pre-priority REQUEST meta)
     5       1     type       1=REQUEST 2=RESPONSE 3=ERROR 4=CHUNK
-    6       2     flags      bit0 STREAM, bit1 LAST (final chunk)
+                             5=CANCEL 6=SHM_HELLO 7=SHM_RELEASE
+    6       2     flags      bit0 STREAM, bit1 LAST (final chunk),
+                             bit2 SHM (payload rides a shared-memory
+                             segment named in the meta; zero payload
+                             bytes follow on the socket)
     8       8     request_id client-chosen; replies carry it back
                              (pipelining: many ids in flight per
                              connection, replies in COMPLETION order)
@@ -34,15 +39,35 @@ Meta sections (str8 = u8 length + utf-8 bytes; str16 = u16 length):
   REQUEST:  model str8 | tenant str8 | priority str8 ("" = normal;
             the admission priority class, serve/admission.py) |
             deadline_ms f64 (NaN = none) |
-            n_tensors u16 | descriptor*
-  RESPONSE: model str8 | step i64 (-1 = unknown) | n_tensors u16 |
-            descriptor*   (with FLAG_STREAM: descriptors announce the
-            full payload, which follows as CHUNK frames instead of
-            inline bytes — payload_len in the RESPONSE header is the
-            TOTAL streamed size, its own inline payload is empty)
+            n_tensors u16 | descriptor* |
+            [seg str8 — only with FLAG_SHM: the shared-memory segment
+            holding the payload bytes the descriptors index into]
+  RESPONSE: model str8 | step i64 (-1 = unknown) |
+            queue_wait_ms f64 (NaN = unknown: time the request sat in
+            the batcher queue before its forward started) |
+            n_tensors u16 | descriptor* | [seg str8, as above]
+            (with FLAG_STREAM: descriptors announce the full payload,
+            which follows as CHUNK frames instead of inline bytes —
+            payload_len in the RESPONSE header is the TOTAL streamed
+            size, its own inline payload is empty)
   ERROR:    code u16 (the HTTP status analog) | kind str8 | msg str16
   CHUNK:    offset u64 into the logical response payload; the frame
             payload is that slice. FLAG_LAST marks the final chunk.
+  CANCEL:   (empty meta) — best-effort cancel of the in-flight
+            request_id. If the request is still queued it is shed with
+            a typed `cancelled` (499) error frame; if it already formed
+            into a batch the cancel is DROPPED and the normal response
+            arrives — the client must tolerate either reply order.
+  SHM_HELLO: client->server: nonce_path str16 | nonce str16 — the
+            same-host proof (the server reads nonce_path and grants shm
+            only if the contents match the nonce; a remote peer cannot
+            read the client's filesystem). server->client: ok u8 —
+            1 grants FLAG_SHM frames on this connection, 0 means
+            inline payloads only (transparent fallback, not an error).
+  SHM_RELEASE: seg str8 — receiver is done with this response segment;
+            the sender's ring may reuse the slot. (Request segments
+            need no release frame: the terminal reply for the rid IS
+            the release.)
 
   descriptor: name str8 | dtype str8 (numpy dtype.str, e.g. "<f4") |
               ndim u8 | dim u32 * ndim | offset u64 | nbytes u64
@@ -68,18 +93,22 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 MAGIC = b"SPK1"
-# version 2: REQUEST meta grew the priority str8 field (between tenant
-# and deadline_ms). The bump is what makes a rolling upgrade honest: a
-# v1 peer gets the TYPED bad_version error frame instead of silently
-# misparsing the deadline bytes as a priority string.
-VERSION = 2
+# version 3: RESPONSE meta grew the queue_wait_ms f64 field (between
+# step and the descriptor table), plus the CANCEL/SHM_HELLO/SHM_RELEASE
+# frame types and FLAG_SHM. The bump is what makes a rolling upgrade
+# honest: a v2 peer gets the TYPED bad_version error frame instead of
+# silently misparsing the queue-wait bytes as a descriptor count.
+# (version 2 grew the REQUEST priority str8; same discipline.)
+VERSION = 3
 HEADER = struct.Struct("<4sBBHQQQ")
 HEADER_LEN = HEADER.size  # 32
 
 T_REQUEST, T_RESPONSE, T_ERROR, T_CHUNK = 1, 2, 3, 4
+T_CANCEL, T_SHM_HELLO, T_SHM_RELEASE = 5, 6, 7
 
 FLAG_STREAM = 1  # request: "stream my response"; response: "chunks follow"
 FLAG_LAST = 2    # final CHUNK of a streamed response
+FLAG_SHM = 4     # payload bytes live in the shm segment named in meta
 
 # the HTTP error table, spelled for the binary wire: (code, kind)
 ERR_BAD_REQUEST = (400, "bad_request")
@@ -90,6 +119,7 @@ ERR_TOO_LARGE = (413, "too_large")
 ERR_QUEUE_FULL = (429, "queue_full")
 ERR_TENANT_LIMIT = (429, "tenant_limit")
 ERR_PRIORITY = (429, "priority")
+ERR_CANCELLED = (499, "cancelled")
 ERR_OVER_CAPACITY = (503, "over_capacity")
 ERR_DEADLINE = (503, "deadline")
 ERR_NO_REPLICA = (503, "no_replica")
@@ -261,27 +291,39 @@ def pack_request(request_id: int, model: str,
                  deadline_ms: Optional[float] = None,
                  tenant: Optional[str] = None,
                  priority: Optional[str] = None,
-                 stream: bool = False
+                 stream: bool = False,
+                 shm_seg: Optional[str] = None
                  ) -> Tuple[bytes, List[memoryview]]:
     """(header+meta bytes, payload byte views). The caller writes the
-    bytes then each view — the tensors are never re-serialized."""
+    bytes then each view — the tensors are never re-serialized. With
+    `shm_seg` the caller has ALREADY copied the payload into that
+    shared-memory segment (at the descriptors' offsets): the frame sets
+    FLAG_SHM, names the segment in the meta, carries payload_len 0, and
+    the returned view list is empty — zero tensor bytes on the socket."""
     descs, views, total = build_table(payload)
+    flags = FLAG_STREAM if stream else 0
+    tail = b""
+    if shm_seg is not None:
+        flags |= FLAG_SHM
+        tail = _pack_str8(shm_seg)
+        views, total = [], 0
     meta = b"".join((
         _pack_str8(model),
         _pack_str8(tenant or ""),
         _pack_str8(priority or ""),
         struct.pack("<d", float("nan") if deadline_ms is None
                     else float(deadline_ms)),
-        _pack_table(descs)))
-    head = _header(T_REQUEST, FLAG_STREAM if stream else 0, request_id,
-                   len(meta), total)
+        _pack_table(descs),
+        tail))
+    head = _header(T_REQUEST, flags, request_id, len(meta), total)
     return head + meta, views
 
 
 def unpack_request_meta(meta: bytes
                         ) -> Tuple[str, str, str, Optional[float],
-                                   List[TensorDesc]]:
-    """-> (model, tenant, priority, deadline_ms, descriptors)."""
+                                   List[TensorDesc], Optional[str]]:
+    """-> (model, tenant, priority, deadline_ms, descriptors, shm_seg).
+    shm_seg is None for inline payloads (no trailing segment name)."""
     r = _Reader(meta)
     model = r.str8()
     tenant = r.str8()
@@ -291,12 +333,16 @@ def unpack_request_meta(meta: bytes
         deadline = None
     else:
         deadline = float(deadline_ms)
-    return model, tenant, priority, deadline, _read_table(r)
+    descs = _read_table(r)
+    seg = r.str8() if r.pos < len(meta) else None
+    return model, tenant, priority, deadline, descs, seg
 
 
 def pack_response(request_id: int, model: str, step: Optional[int],
                   arrays: Dict[str, np.ndarray], stream: bool = False,
-                  chunk_bytes: int = 256 << 10
+                  chunk_bytes: int = 256 << 10,
+                  queue_wait_ms: Optional[float] = None,
+                  shm_seg: Optional[str] = None
                   ) -> List[Tuple[bytes, Optional[memoryview]]]:
     """The response as a list of (copied header/meta bytes, optional
     zero-copy payload view) write items.
@@ -306,12 +352,23 @@ def pack_response(request_id: int, model: str, step: Optional[int],
     payload_len = total, then CHUNK frames each carrying <= chunk_bytes
     of payload (FLAG_LAST on the final one). Either way the only COPIED
     bytes are the headers — per-connection buffering is bounded by the
-    header size, never the blob size."""
+    header size, never the blob size. With `shm_seg` (mutually
+    exclusive with stream) the caller has already copied the payload
+    into that segment: one FLAG_SHM frame, zero payload bytes on the
+    socket."""
     descs, views, total = build_table(arrays)
     meta = b"".join((_pack_str8(model),
                      struct.pack("<q", -1 if step is None else int(step)),
+                     struct.pack("<d", float("nan") if queue_wait_ms is
+                                 None else float(queue_wait_ms)),
                      _pack_table(descs)))
     items: List[Tuple[bytes, Optional[memoryview]]] = []
+    if shm_seg is not None:
+        assert not stream, "shm responses are single-frame"
+        meta += _pack_str8(shm_seg)
+        items.append((_header(T_RESPONSE, FLAG_SHM, request_id,
+                              len(meta), 0) + meta, None))
+        return items
     if not stream:
         items.append((_header(T_RESPONSE, 0, request_id, len(meta),
                               total) + meta, None))
@@ -345,12 +402,17 @@ def pack_response(request_id: int, model: str, step: Optional[int],
 
 
 def unpack_response_meta(meta: bytes
-                         ) -> Tuple[str, Optional[int],
-                                    List[TensorDesc]]:
+                         ) -> Tuple[str, Optional[int], Optional[float],
+                                    List[TensorDesc], Optional[str]]:
+    """-> (model, step, queue_wait_ms, descriptors, shm_seg)."""
     r = _Reader(meta)
     model = r.str8()
     step = r.i64()
-    return model, (None if step < 0 else step), _read_table(r)
+    qw = r.f64()
+    queue_wait = None if qw != qw else float(qw)  # NaN = unknown
+    descs = _read_table(r)
+    seg = r.str8() if r.pos < len(meta) else None
+    return model, (None if step < 0 else step), queue_wait, descs, seg
 
 
 def pack_error(request_id: int, code_kind: Tuple[int, str],
@@ -368,6 +430,50 @@ def unpack_error_meta(meta: bytes) -> Tuple[int, str, str]:
 
 def unpack_chunk_meta(meta: bytes) -> int:
     return _Reader(meta).u64()
+
+
+def pack_cancel(request_id: int) -> bytes:
+    """Best-effort cancel of an in-flight request_id (empty meta). The
+    hedging router sends this for the losing leg; a cancel that loses
+    the race to batch formation is simply dropped server-side."""
+    return _header(T_CANCEL, 0, request_id, 0, 0)
+
+
+def pack_shm_hello(request_id: int, nonce_path: str, nonce: str) -> bytes:
+    """Client->server shm capability offer. `nonce_path` names a file the
+    CLIENT wrote containing `nonce`; a server that can read the matching
+    bytes shares the client's filesystem — the same-host proof that
+    makes granting named-segment access safe."""
+    meta = _pack_str16(nonce_path) + _pack_str16(nonce)
+    return _header(T_SHM_HELLO, 0, request_id, len(meta), 0) + meta
+
+
+def unpack_shm_hello_meta(meta: bytes) -> Tuple[str, str]:
+    r = _Reader(meta)
+    return r.str16(), r.str16()
+
+
+def pack_shm_hello_ack(request_id: int, ok: bool) -> bytes:
+    """Server->client answer to SHM_HELLO: ok u8 (1 = FLAG_SHM granted
+    on this connection, 0 = inline payloads only)."""
+    meta = bytes((1 if ok else 0,))
+    return _header(T_SHM_HELLO, FLAG_LAST, request_id, len(meta), 0) \
+        + meta
+
+
+def unpack_shm_hello_ack_meta(meta: bytes) -> bool:
+    return _Reader(meta).u8() == 1
+
+
+def pack_shm_release(seg: str) -> bytes:
+    """Receiver->sender: done with this response segment, the ring slot
+    may be reused. rid 0: releases are per-segment, not per-request."""
+    meta = _pack_str8(seg)
+    return _header(T_SHM_RELEASE, 0, 0, len(meta), 0) + meta
+
+
+def unpack_shm_release_meta(meta: bytes) -> str:
+    return _Reader(meta).str8()
 
 
 def parse_header(buf) -> Tuple[int, int, int, int, int]:

@@ -145,10 +145,15 @@ class LatencyStats:
     def __init__(self, window: int = 4096,
                  registry: Optional[MetricsRegistry] = None,
                  name: str = "sparknet_serve_request_latency_seconds",
-                 model: Optional[str] = None):
+                 model: Optional[str] = None,
+                 max_age_s: float = 300.0):
         """`model` labels the registry histogram (serve lanes sharing one
         registry across models); None keeps the unlabeled family — but
-        the two modes must not mix within one registry/name."""
+        the two modes must not mix within one registry/name. `max_age_s`
+        is the on-record pruning horizon: observations older than it are
+        dropped from the left at `add` time, so memory is bounded by
+        BOTH the count window and the age horizon — sustained load never
+        accumulates stale timestamps between `windowed()` calls."""
         self._obs: deque = deque(maxlen=max(2, window))
         # enqueue times of the SAME observations (parallel deque, same
         # maxlen, appended under the same lock): the fleet controller's
@@ -156,6 +161,7 @@ class LatencyStats:
         # — 4096 trickle observations can span an hour, and an autoscaler
         # acting on an hour-old tail would chase ghosts
         self._obs_t: deque = deque(maxlen=max(2, window))
+        self.max_age_s = float(max_age_s)
         self._lock = threading.Lock()
         self.count = 0
         self._hist = None
@@ -166,9 +172,17 @@ class LatencyStats:
                 labels=tuple(self._labels))
 
     def add(self, seconds: float) -> None:
+        now = time.monotonic()
         with self._lock:
+            # prune-to-window on record: both deques stay parallel, and
+            # entries older than max_age_s never outlive the next add —
+            # len(self._obs) <= min(maxlen, arrivals within max_age_s)
+            cutoff = now - self.max_age_s
+            while self._obs_t and self._obs_t[0] < cutoff:
+                self._obs_t.popleft()
+                self._obs.popleft()
             self._obs.append(float(seconds))
-            self._obs_t.append(time.monotonic())
+            self._obs_t.append(now)
             self.count += 1
         if self._hist is not None:
             self._hist.observe(seconds, **self._labels)
@@ -178,6 +192,18 @@ class LatencyStats:
         with no observations."""
         with self._lock:
             xs = sorted(self._obs)
+        return _rank(xs, q) if xs else None
+
+    def windowed_quantile(self, q: float, window_s: float
+                          ) -> Optional[float]:
+        """Exact order statistic (SECONDS) over the observations of the
+        last `window_s` seconds, or None if the window holds nothing —
+        the hedging delay's input (e.g. p95 of routed latency): hedge
+        timing must track the LIVE distribution, not an hour-old one."""
+        cutoff = time.monotonic() - float(window_s)
+        with self._lock:
+            xs = sorted(v for v, t in zip(self._obs, self._obs_t)
+                        if t >= cutoff)
         return _rank(xs, q) if xs else None
 
     def windowed(self, window_s: float) -> Dict[str, Optional[float]]:
@@ -240,6 +266,11 @@ class FillMeter:
         self.padded = 0
         self.batches = 0
         self.size_counts: Dict[int, int] = {}
+        # the last few formed batches as (real, bucket) pairs: the
+        # router's coalesced-formation trigger reads RECENT fill, not
+        # the cumulative ratio (which a long full-batch history would
+        # pin near 1.0 long after the load turned to trickle)
+        self._recent: deque = deque(maxlen=64)
         self._lock = threading.Lock()
         self._labels = {} if model is None else {"model": str(model)}
         self._c_rows = self._c_batches = self._g_fill = None
@@ -269,6 +300,7 @@ class FillMeter:
             self.batches += 1
             self.size_counts[int(n_real)] = \
                 self.size_counts.get(int(n_real), 0) + 1
+            self._recent.append((int(n_real), int(bucket)))
         if self._c_rows is not None:
             self._c_rows.inc(int(n_real), kind="real", **self._labels)
             self._c_rows.inc(int(bucket) - int(n_real), kind="padding",
@@ -280,6 +312,29 @@ class FillMeter:
     def ratio(self) -> float:
         with self._lock:
             return self.real / self.padded if self.padded else 0.0
+
+    def recent_ratio(self, n: int = 16) -> Optional[float]:
+        """Fill over the last `n` formed batches, or None with no recent
+        batches: real rows over the PADDED BUCKET slots they ran in."""
+        with self._lock:
+            tail = list(self._recent)[-int(n):]
+        real = sum(r for r, _ in tail)
+        padded = sum(b for _, b in tail)
+        return real / padded if padded else None
+
+    def recent_occupancy(self, capacity: int,
+                         n: int = 16) -> Optional[float]:
+        """Mean real rows per recent batch as a fraction of `capacity`
+        (max_batch) — the coalescing trigger (router). Bucket-relative
+        fill is blind to a fragmented trickle (a single request pads
+        into bucket 1 at fill 1.0); occupancy vs CAPACITY is what
+        routing consecutive requests to one replica can improve."""
+        with self._lock:
+            tail = list(self._recent)[-int(n):]
+        if not tail or capacity <= 0:
+            return None
+        real = sum(r for r, _ in tail)
+        return min(1.0, real / (len(tail) * capacity))
 
     def snapshot(self) -> Tuple[int, int, int]:
         """(real, padded, batches) read consistently under the lock."""
@@ -295,3 +350,4 @@ class FillMeter:
         with self._lock:
             self.real = self.padded = self.batches = 0
             self.size_counts.clear()
+            self._recent.clear()

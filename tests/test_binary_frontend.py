@@ -76,8 +76,9 @@ def test_wire_request_roundtrip():
     assert (ftype, rid) == (wire.T_REQUEST, 7)
     assert flags & wire.FLAG_STREAM
     meta = buf[wire.HEADER_LEN:wire.HEADER_LEN + meta_len]
-    model, tenant, priority, deadline_ms, descs = \
+    model, tenant, priority, deadline_ms, descs, seg = \
         wire.unpack_request_meta(meta)
+    assert seg is None  # inline payload: no trailing shm segment
     assert (model, tenant, priority, deadline_ms) == \
         ("m", "t1", "low", 125.0)
     out = wire.tensors_from(descs,
@@ -121,8 +122,9 @@ def test_wire_streamed_response_chunks_cover_payload():
         buf[off:off + plen] = bytes(view)
         saw_last |= bool(flags & wire.FLAG_LAST)
     assert saw_last
-    model, step, descs = wire.unpack_response_meta(
+    model, step, queue_wait_ms, descs, seg = wire.unpack_response_meta(
         head0[wire.HEADER_LEN:])
+    assert queue_wait_ms is None and seg is None
     out = wire.tensors_from(descs, bytes(buf))
     np.testing.assert_array_equal(out["a"], arrs["a"])
     np.testing.assert_array_equal(out["b"], arrs["b"])

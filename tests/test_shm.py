@@ -1,0 +1,286 @@
+"""spkn-shm, the shared-memory local transport (serve/shm.py + the
+FLAG_SHM wire surface):
+
+  - `ShmRing` mechanics: slot reuse, resize-with-fresh-generation-name,
+    full-ring -> None (inline fallback, never blocks), payload cap,
+    close-unlinks.
+  - the same-host proof: nonce file grants, wrong/missing/oversized
+    nonce degrades to inline — a remote peer can never be granted shm.
+  - orphan reclamation: a kill -9'd creator's segments are swept at the
+    next frontend startup; live creators' segments are left alone.
+  - end to end over a real frontend: ZERO tensor payload bytes cross
+    the socket in either direction (pinned by byte counters on BOTH
+    ends), results bitwise-identical to the inline wire, ring slots
+    fully recycled after the burst.
+  - capability fallback: a client denied shm (server disabled, or
+    client opted out) serves inline transparently — same results, the
+    payload bytes back on the socket.
+  - wire-v2 peers still get the typed bad_version frame with shm
+    enabled — capability negotiation never misparses an old peer.
+
+Tier-1: CPU backend, lenet shapes, ephemeral ports.
+"""
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.net_api import JaxNet
+from sparknet_tpu.serve import (BinaryClient, BinaryFrontend,
+                                InferenceServer, ServeConfig)
+from sparknet_tpu.serve import shm, wire
+from sparknet_tpu.zoo import lenet
+
+pytestmark = pytest.mark.skipif(not shm.shm_available(),
+                                reason="no POSIX shared memory")
+
+
+def _example(i: int) -> dict:
+    r = np.random.default_rng(7000 + i)
+    return {"data": r.standard_normal((28, 28, 1)).astype(np.float32)}
+
+
+@pytest.fixture(scope="module")
+def net():
+    return JaxNet(lenet(batch=4))
+
+
+@pytest.fixture()
+def srv(net):
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      metrics_every_batches=0)
+    with InferenceServer(net, cfg) as s:
+        yield s
+
+
+# -- ring mechanics -----------------------------------------------------------
+
+def test_ring_reuse_resize_full_and_cap():
+    ring = shm.ShmRing(n_slots=2, slot_bytes=4096, max_bytes=1 << 20)
+    try:
+        # acquire-write-release-reacquire reuses the SAME segment
+        name1, view1 = ring.acquire(100)
+        view1[:3] = b"abc"
+        assert ring.in_flight() == 1
+        assert ring.release(name1)
+        name2, _ = ring.acquire(100)
+        assert name2 == name1  # recycled, not re-created
+        ring.release(name2)
+
+        # a payload over the slot size resizes: FRESH generation name,
+        # old name becomes unknown to release (the resize race rule)
+        name3, view3 = ring.acquire(8192)
+        assert name3 != name1
+        assert len(view3) == 8192
+        assert not ring.release(name1)  # old name: quiet miss
+        # both slots in flight -> None, the caller sends inline
+        name4, _ = ring.acquire(10)
+        assert ring.acquire(10) is None
+        ring.release(name3)
+        ring.release(name4)
+
+        # payload over max_bytes never touches the ring
+        assert ring.acquire((1 << 20) + 1) is None
+    finally:
+        ring.close()
+    # closed ring: every acquire is an inline fallback
+    assert ring.acquire(10) is None
+
+
+def test_ring_close_unlinks_segments():
+    ring = shm.ShmRing(n_slots=1, slot_bytes=4096)
+    name, _ = ring.acquire(16)
+    assert os.path.exists(f"/dev/shm/{name}")
+    ring.release(name)
+    ring.close()
+    assert not os.path.exists(f"/dev/shm/{name}")
+
+
+# -- the same-host proof ------------------------------------------------------
+
+def test_nonce_grants_only_matching_bytes(tmp_path):
+    path, nonce = shm.write_nonce(dir=str(tmp_path))
+    assert shm.check_nonce(path, nonce)
+    assert not shm.check_nonce(path, "not-the-nonce")
+    assert not shm.check_nonce(path + ".gone", nonce)
+    assert not shm.check_nonce(path, "")        # empty is never proof
+    assert not shm.check_nonce(path, "x" * 300)  # oversized claim
+    shm.cleanup_nonce(path)
+    assert not os.path.exists(path)
+    assert not shm.check_nonce(path, nonce)  # a swept proof is no proof
+
+
+# -- orphan reclamation -------------------------------------------------------
+
+def test_sweep_reclaims_kill9_orphan_spares_live(tmp_path):
+    """A creator killed -9 (tracker cleanup simulated away, as when the
+    whole process group dies) leaks its segment in /dev/shm; the startup
+    sweep reclaims exactly that — a LIVE creator's segment survives."""
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         "import os, sys, time\n"
+         "from sparknet_tpu.serve import shm\n"
+         "seg = shm._Segment(\n"
+         "    name=f'{shm.SEG_PREFIX}_{os.getpid()}_dead_0g1',\n"
+         "    create=True, size=4096)\n"
+         "shm._untrack(seg.name)  # a kill -9 takes the tracker too\n"
+         "print(seg.name, flush=True)\n"
+         "time.sleep(120)\n"],
+        stdout=subprocess.PIPE, text=True,
+        env={**os.environ,
+             "PYTHONPATH": os.path.dirname(os.path.dirname(
+                 os.path.abspath(__file__)))})
+    try:
+        orphan = child.stdout.readline().strip()
+        assert orphan.startswith(shm.SEG_PREFIX)
+        assert os.path.exists(f"/dev/shm/{orphan}")
+    finally:
+        child.kill()  # SIGKILL: no atexit, no unlink
+        child.wait(timeout=10)
+        child.stdout.close()
+
+    live = shm.ShmRing(n_slots=1)
+    live_name, _ = live.acquire(16)
+    try:
+        swept = shm.sweep_orphans()
+        assert orphan in swept
+        assert not os.path.exists(f"/dev/shm/{orphan}")
+        assert live_name not in swept
+        assert os.path.exists(f"/dev/shm/{live_name}")
+    finally:
+        live.release(live_name)
+        live.close()
+
+
+# -- end to end: zero payload bytes on the socket -----------------------------
+
+def test_shm_transport_zero_socket_payload_both_directions(net, srv):
+    bfe = BinaryFrontend(srv, port=0)
+    assert bfe.enable_shm
+    cli = BinaryClient(*bfe.address, use_shm=True)
+    try:
+        assert cli._shm_granted is True
+        xs = [_example(i) for i in range(8)]
+        outs = [cli.infer(x, model="default", deadline_s=30.0)
+                for x in xs]
+        # the pin: zero tensor payload bytes crossed the shm
+        # connection's socket, measured on BOTH ends (snapshot the
+        # frontend counters BEFORE the inline reference client below
+        # shares them)
+        assert cli.payload_tx_bytes == 0
+        assert cli.payload_rx_bytes == 0
+        assert bfe.payload_rx_bytes == 0
+        assert bfe.payload_tx_bytes == 0
+        # results match the inline wire bitwise
+        ref = BinaryClient(*bfe.address, use_shm=False)
+        try:
+            for x, out in zip(xs, outs):
+                inline = ref.infer(x, model="default", deadline_s=30.0)
+                np.testing.assert_array_equal(out["prob"],
+                                              inline["prob"])
+        finally:
+            ref.close()
+        # queue-wait rides the response meta
+        qw = cli.last_timing["queue_wait_ms"]
+        assert qw is not None and qw >= 0.0
+        # every ring slot recycled once the burst drained
+        assert cli._ring.in_flight() == 0
+    finally:
+        cli.close()
+        bfe.stop()
+
+
+def test_shm_denied_by_server_falls_back_inline(net, srv):
+    """`enable_shm=False` on the frontend: the client's SHM_HELLO is
+    answered with a denial, and every request serves inline — same
+    results, payload bytes back on the socket."""
+    bfe = BinaryFrontend(srv, port=0, enable_shm=False)
+    cli = BinaryClient(*bfe.address, use_shm=True)
+    try:
+        assert cli._shm_granted is False
+        assert cli._ring is None
+        out = cli.infer(_example(0), model="default", deadline_s=30.0)
+        assert out["prob"].shape == (10,)
+        nbytes = 28 * 28 * 4
+        assert cli.payload_tx_bytes == nbytes
+        assert bfe.payload_rx_bytes == nbytes
+        assert cli.payload_rx_bytes > 0  # reply payload came inline too
+    finally:
+        cli.close()
+        bfe.stop()
+
+
+def test_shm_client_optout_never_handshakes(net, srv):
+    bfe = BinaryFrontend(srv, port=0)
+    cli = BinaryClient(*bfe.address, use_shm=False)
+    try:
+        assert cli._shm_granted is None  # no HELLO ever sent
+        out = cli.infer(_example(1), model="default", deadline_s=30.0)
+        assert out["prob"].shape == (10,)
+        assert cli.payload_tx_bytes == 28 * 28 * 4
+    finally:
+        cli.close()
+        bfe.stop()
+
+
+def test_frontend_startup_sweeps_orphans(net, srv):
+    """The frontend's constructor runs the orphan sweep before serving:
+    a dead-pid segment planted in /dev/shm is gone once the frontend is
+    up, and its name is reported in `swept_segments`."""
+    # plant an orphan under a pid that cannot be alive (pid 1 is init,
+    # alive - use a dead child's pid)
+    child = subprocess.Popen([sys.executable, "-c", "pass"])
+    child.wait(timeout=30)
+    name = f"{shm.SEG_PREFIX}_{child.pid}_plant_0g1"
+    seg = shm._Segment(name=name, create=True, size=4096)
+    shm._untrack(name)
+    seg.close()
+    assert os.path.exists(f"/dev/shm/{name}")
+    bfe = BinaryFrontend(srv, port=0)
+    try:
+        assert name in bfe.swept_segments
+        assert not os.path.exists(f"/dev/shm/{name}")
+    finally:
+        bfe.stop()
+
+
+# -- old peers ----------------------------------------------------------------
+
+def test_v2_frame_gets_typed_bad_version_with_shm_enabled(net, srv):
+    """A wire-v2 peer (pre-shm protocol) against an shm-enabled
+    frontend: typed bad_version error frame, connection closed, server
+    keeps serving — never a misparse into the shm surface."""
+    bfe = BinaryFrontend(srv, port=0)
+    assert bfe.enable_shm
+    try:
+        head, _ = wire.pack_request(1, "default", {})
+        s = socket.create_connection(bfe.address, timeout=10)
+        s.sendall(head[:4] + bytes([2]) + head[5:])
+        s.settimeout(10.0)
+        buf = b""
+        while len(buf) < wire.HEADER_LEN:
+            d = s.recv(4096)
+            assert d, "server closed without the typed frame"
+            buf += d
+        ftype, flags, rid, meta_len, plen = wire.parse_header(buf)
+        while len(buf) < wire.HEADER_LEN + meta_len + plen:
+            buf += s.recv(4096)
+        code, kind, _ = wire.unpack_error_meta(
+            buf[wire.HEADER_LEN:wire.HEADER_LEN + meta_len])
+        assert ftype == wire.T_ERROR and (code, kind) == \
+            (400, "bad_version")
+        assert s.recv(4096) == b""
+        s.close()
+        out = BinaryClient(*bfe.address, use_shm=True)
+        try:
+            assert out.infer(_example(2), model="default",
+                             deadline_s=30.0)["prob"].shape == (10,)
+        finally:
+            out.close()
+    finally:
+        bfe.stop()

@@ -1,0 +1,344 @@
+"""Tail-latency engineering (router hedging + coalesced formation +
+the observability satellites):
+
+  - hedged requests: exactly-once delivery (N submits -> N results even
+    when both legs answer), the hedge budget cap, the under-drain
+    fallback (no second healthy replica -> the primary stands alone,
+    zero dropped), and the admission-pressure gate.
+  - coalesced batch formation: one focus replica per window, focus
+    ROTATES across windows (fairness), inactive on high fill or no
+    fill signal.
+  - LatencyStats windowed memory is bounded (count window AND age
+    horizon) with exact order statistics over what remains.
+  - queue-wait surfaces on both wires: X-Queue-Wait-Ms on HTTP,
+    `last_timing["queue_wait_ms"]` on the binary client.
+  - the request journal: one JSONL row per request on both frontends,
+    off by default, per-row overhead pinned.
+
+Tier-1: CPU backend, lenet shapes, ephemeral ports.
+"""
+import http.client
+import json
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.net_api import JaxNet
+from sparknet_tpu.serve import (BinaryClient, BinaryFrontend,
+                                HttpFrontend, InferenceServer,
+                                ModelRouter, Replica, RouterConfig,
+                                ServeConfig, UnknownModelError)
+from sparknet_tpu.serve.http_frontend import (NPZ_CONTENT_TYPE,
+                                              _encode_npz)
+from sparknet_tpu.utils.logger import Logger
+from sparknet_tpu.utils.metrics import LatencyStats
+from sparknet_tpu.zoo import lenet
+
+
+def _example(i: int) -> dict:
+    r = np.random.default_rng(5000 + i)
+    return {"data": r.standard_normal((28, 28, 1)).astype(np.float32)}
+
+
+def _mk_replica(model: str = "m"):
+    net = JaxNet(lenet(batch=4))
+    cfg = ServeConfig(model_name=model, max_batch=4, max_wait_ms=2.0,
+                      outputs=("prob",), metrics_every_batches=0)
+    s = InferenceServer(net, cfg)
+    s.start()
+    fe = BinaryFrontend(s, port=0)
+    return s, fe
+
+
+@pytest.fixture()
+def two_replicas():
+    s1, fe1 = _mk_replica()
+    s2, fe2 = _mk_replica()
+    yield fe1, fe2
+    fe1.stop()
+    fe2.stop()
+    s1.stop()
+    s2.stop()
+
+
+def _router_over(fes, **cfg_kw):
+    router = ModelRouter(RouterConfig(workers=4, **cfg_kw))
+    for fe in fes:
+        router.add_remote_replica(
+            "m", f"spkn://127.0.0.1:{fe.address[1]}")
+    router.start()
+    return router
+
+
+# -- hedging ------------------------------------------------------------------
+
+def test_hedge_exactly_once_under_max_pressure_to_hedge(two_replicas):
+    """min-delay 0 fires the hedge decision immediately on every
+    request: near-every request grows a second leg, yet every submit
+    resolves EXACTLY one result (first-resolution-wins) and the hedged
+    counter never exceeds routed."""
+    router = _router_over(two_replicas, hedge=True,
+                          hedge_min_delay_ms=0.0, hedge_budget=1.0)
+    try:
+        futs = [router.submit("m", _example(i), deadline_s=30.0)
+                for i in range(24)]
+        outs = [f.result(timeout=30.0) for f in futs]
+        assert len(outs) == 24
+        for out in outs:
+            p = np.asarray(out["prob"])
+            assert p.shape[-1] == 10 and np.isfinite(p).all()
+        hg = router.status()["hedging"]["m"]
+        assert hg["routed"] == 24
+        assert 0 < hg["hedged"] <= hg["routed"]
+        # the metered counter agrees with the status rollup
+        c = router.registry.counter("sparknet_serve_hedged_total",
+                                    labels=("model", "won"))
+        won = ((c.value(model="m", won="primary") or 0.0)
+               + (c.value(model="m", won="hedge") or 0.0))
+        assert won == hg["hedged"]
+    finally:
+        router.stop()
+
+
+def test_hedge_budget_caps_second_legs(two_replicas):
+    router = _router_over(two_replicas, hedge=True,
+                          hedge_min_delay_ms=0.0, hedge_budget=0.2)
+    try:
+        futs = [router.submit("m", _example(i), deadline_s=30.0)
+                for i in range(40)]
+        for f in futs:
+            f.result(timeout=30.0)
+        hg = router.status()["hedging"]["m"]
+        assert hg["routed"] == 40
+        assert hg["hedged"] <= 0.2 * hg["routed"]
+    finally:
+        router.stop()
+
+
+def test_hedge_under_drain_primary_stands_alone(two_replicas):
+    """With the only other replica draining, the hedge decision finds
+    no second target: every request still completes on the primary,
+    zero dropped, zero hedged."""
+    router = _router_over(two_replicas, hedge=True,
+                          hedge_min_delay_ms=0.0, hedge_budget=1.0)
+    try:
+        reps = router.replicas["m"]
+        reps[1].drain()
+        futs = [router.submit("m", _example(i), deadline_s=30.0)
+                for i in range(10)]
+        outs = [f.result(timeout=30.0) for f in futs]
+        assert all(np.asarray(o["prob"]).shape[-1] == 10 for o in outs)
+        hg = router.status()["hedging"]["m"]
+        assert hg["routed"] == 10 and hg["hedged"] == 0
+    finally:
+        router.stop()
+
+
+def test_hedge_disabled_under_admission_pressure(two_replicas):
+    """A shedding fleet must not grow extra request copies: with the
+    pressure signal up, the fire-time gate skips every hedge."""
+    router = _router_over(two_replicas, hedge=True,
+                          hedge_min_delay_ms=0.0, hedge_budget=1.0)
+    try:
+        router._pressure = lambda: 0.7  # the admission door's signal
+        futs = [router.submit("m", _example(i), deadline_s=30.0)
+                for i in range(10)]
+        for f in futs:
+            f.result(timeout=30.0)
+        hg = router.status()["hedging"]["m"]
+        assert hg["routed"] == 10 and hg["hedged"] == 0
+    finally:
+        router.stop()
+
+
+# -- coalesced formation ------------------------------------------------------
+
+def _stub_reps(n: int, fill):
+    return [Replica(f"r{i}", url=f"spkn://h{i}:1", transport="binary",
+                    health_fn=lambda: True, fill_fn=fill)
+            for i in range(n)]
+
+
+def test_coalesce_one_focus_per_window_rotating_fairly():
+    router = ModelRouter(RouterConfig(
+        workers=1, coalesce=True, coalesce_window_ms=10.0,
+        coalesce_fill_threshold=0.5))
+    reps = _stub_reps(3, lambda: 0.1)
+    focus_seq = []
+    for _ in range(6):
+        picks = set()
+        t_end = time.monotonic() + 0.008
+        while time.monotonic() < t_end:
+            rep = router._coalesce_pick("m", reps)
+            assert rep is not None
+            picks.add(rep.name)
+        assert len(picks) == 1, picks  # ONE focus inside a window
+        focus_seq.append(picks.pop())
+        time.sleep(0.004)  # cross the window boundary
+    # fairness: over 2n windows every replica led at least once, in
+    # rotation order
+    assert len(set(focus_seq)) == 3, focus_seq
+    assert focus_seq[:3] != [focus_seq[0]] * 3
+
+
+def test_coalesce_inactive_on_high_fill_or_no_signal():
+    router = ModelRouter(RouterConfig(
+        workers=1, coalesce=True, coalesce_window_ms=10.0,
+        coalesce_fill_threshold=0.5))
+    # well-filled replicas: round-robin stands
+    assert router._coalesce_pick("m", _stub_reps(3, lambda: 0.9)) is None
+    # no replica reports a signal: coalescing never triggers blind
+    assert router._coalesce_pick("m2", _stub_reps(3, None)) is None
+
+
+def test_coalesce_skips_unroutable_focus():
+    """A drained replica is never chosen as focus; the rotation walks
+    past it."""
+    router = ModelRouter(RouterConfig(
+        workers=1, coalesce=True, coalesce_window_ms=5.0,
+        coalesce_fill_threshold=0.5))
+    reps = _stub_reps(3, lambda: 0.1)
+    reps[1].drain()
+    leads = set()
+    for _ in range(6):
+        rep = router._coalesce_pick("m", reps)
+        assert rep is not None and rep.name != "r1"
+        leads.add(rep.name)
+        time.sleep(0.007)
+    assert leads == {"r0", "r2"}
+
+
+# -- LatencyStats memory bound ------------------------------------------------
+
+def test_latency_stats_bounded_at_window_exact_order_stats():
+    st = LatencyStats(window=10_000)
+    for i in range(25_000):
+        st.add(float(i))
+    assert len(st._obs) == 10_000  # bounded: only the last window
+    assert st.count == 25_000      # ...but the lifetime count survives
+    assert st.quantile(0.0) == 15_000.0
+    assert st.quantile(1.0) == 24_999.0
+    mid = st.quantile(0.5)
+    assert 19_900.0 <= mid <= 20_100.0
+
+
+def test_latency_stats_age_horizon_prunes_stale():
+    st = LatencyStats(window=1000, max_age_s=0.05)
+    for _ in range(50):
+        st.add(1.0)
+    time.sleep(0.12)
+    st.add(5.0)  # the add prunes everything past the horizon
+    assert len(st._obs) == 1
+    assert st.quantile(0.5) == 5.0
+
+
+# -- queue-wait on the wire ---------------------------------------------------
+
+def test_queue_wait_surfaces_on_both_wires():
+    net = JaxNet(lenet(batch=4))
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      metrics_every_batches=0)
+    with InferenceServer(net, cfg) as srv:
+        bfe = BinaryFrontend(srv, port=0)
+        hfe = HttpFrontend(srv, port=0)
+        cli = BinaryClient(*bfe.address, use_shm=False)
+        try:
+            cli.infer(_example(0), model="default", deadline_s=30.0)
+            qw = cli.last_timing["queue_wait_ms"]
+            assert qw is not None and 0.0 <= qw < 60_000.0
+            # HTTP: the X-Queue-Wait-Ms response header
+            conn = http.client.HTTPConnection(*hfe.address, timeout=30)
+            conn.request(
+                "POST", "/v1/models/default/infer",
+                body=_encode_npz(_example(1)),
+                headers={"Content-Type": NPZ_CONTENT_TYPE,
+                         "Accept": NPZ_CONTENT_TYPE,
+                         "X-Deadline-Ms": "30000"})
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+            hdr = resp.getheader("X-Queue-Wait-Ms")
+            assert hdr is not None and 0.0 <= float(hdr) < 60_000.0
+            conn.close()
+        finally:
+            cli.close()
+            bfe.stop()
+            hfe.stop()
+
+
+# -- the request journal ------------------------------------------------------
+
+def test_request_journal_rows_both_frontends(tmp_path):
+    net = JaxNet(lenet(batch=4))
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      metrics_every_batches=0)
+    jpath = tmp_path / "journal.jsonl"
+    journal = Logger(jsonl_path=str(jpath), echo=False)
+    with InferenceServer(net, cfg) as srv:
+        bfe = BinaryFrontend(srv, port=0, journal=journal)
+        hfe = HttpFrontend(srv, port=0, journal=journal)
+        cli = BinaryClient(*bfe.address, use_shm=False)
+        try:
+            cli.infer(_example(0), model="default", deadline_s=30.0,
+                      tenant="t1")
+            with pytest.raises(UnknownModelError):
+                cli.infer(_example(1), model="nope", deadline_s=30.0)
+            conn = http.client.HTTPConnection(*hfe.address, timeout=30)
+            conn.request(
+                "POST", "/v1/models/default/infer",
+                body=_encode_npz(_example(2)),
+                headers={"Content-Type": NPZ_CONTENT_TYPE,
+                         "Accept": NPZ_CONTENT_TYPE})
+            conn.getresponse().read()
+            conn.close()
+        finally:
+            cli.close()
+            bfe.stop()
+            hfe.stop()
+    journal.close()
+    rows = [json.loads(l) for l in
+            jpath.read_text().strip().splitlines()]
+    assert all(r["kind"] == "request" for r in rows)
+    by_transport = {}
+    for r in rows:
+        by_transport.setdefault(r["transport"], []).append(r)
+    ok_bin = [r for r in by_transport["binary"]
+              if r["outcome"] == "ok"]
+    assert len(ok_bin) == 1
+    assert ok_bin[0]["model"] == "default"
+    assert ok_bin[0]["tenant"] == "t1"
+    assert ok_bin[0]["sizes"] == {"data": 28 * 28 * 4}
+    assert ok_bin[0]["queue_wait_ms"] >= 0.0
+    # the typed shed is journaled with its reason, not dropped
+    assert any(r["outcome"] != "ok" for r in by_transport["binary"])
+    assert len(by_transport["http"]) == 1
+    assert by_transport["http"][0]["model"] == "default"
+
+
+def test_request_journal_off_by_default_and_cheap(tmp_path):
+    net = JaxNet(lenet(batch=4))
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, outputs=("prob",),
+                      metrics_every_batches=0)
+    with InferenceServer(net, cfg) as srv:
+        bfe = BinaryFrontend(srv, port=0)
+        try:
+            assert bfe.journal is None  # off unless asked for
+            # journaling cost when ON: bounded per row (line-buffered
+            # JSONL append — must stay far under a request's budget)
+            journal = Logger(jsonl_path=str(tmp_path / "j.jsonl"),
+                             echo=False)
+            bfe.journal = journal
+            jinfo = {"transport": "binary", "model": "default",
+                     "tenant": None, "priority": None,
+                     "deadline_ms": 1000.0,
+                     "sizes": {"data": 3136}}
+            n = 500
+            t0 = time.perf_counter()
+            for _ in range(n):
+                bfe._journal_row(dict(jinfo), "ok", queue_wait_ms=1.0)
+            per_row_ms = (time.perf_counter() - t0) / n * 1e3
+            journal.close()
+            assert per_row_ms < 2.0, per_row_ms
+        finally:
+            bfe.stop()
