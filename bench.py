@@ -3849,6 +3849,376 @@ def tail_bench(out_path: str | None = "BENCH_TAIL.json",
     return out
 
 
+def batch_bench(out_path: str | None = "BENCH_BATCH.json",
+                duration_s: float = 2.0, max_batch: int = 8,
+                rows: int = 192, keep: str | None = None) -> dict:
+    """The r14 bulk-inference audit (writes BENCH_BATCH.json): a
+    `sparknet-batch` job run as a SCAVENGER tenant (priority=low,
+    tenant=batch) against the real serve stack, colocated with online
+    traffic — the coexistence contract, both directions, plus the two
+    kill -9 chaos claims.
+
+    Arms:
+      - coexist: online open-loop high-priority load (a sustainable
+        fraction of measured capacity) + a low-priority open-loop flood
+        at ~4x capacity + the batch job, all through binary front doors
+        sharing ONE PriorityAdmission, pressure driven by the
+        FleetController from SLO burn. Gates: the batch job makes
+        progress while the flood runs (units committed > 0 — the
+        starvation-relief clamp guarantees the door re-opens), every
+        low shed is TYPED (shed_priority > 0 for the flood; the online
+        class is never priority-shed), the driver takes ZERO hard
+        failures, and online dropped == timed_out == hung == 0. The
+        online tail p99 is compared to the SLO; on this shared-CPU box
+        (clients + replicas + driver on the same cores) a miss is
+        stamped structure_proof — the number needs per-replica
+        hardware.
+      - release: the flood stops; the SAME job shape reruns on a quiet
+        fleet. Gate: rows/s STRICTLY rises vs the coexist run — the
+        scavenger was actually being held back by admission, not by
+        its own pipeline. This run's fleet-aggregate img/s and
+        cost-per-million-embeddings are the headline numbers.
+      - driver_kill: a subprocess `sparknet-batch` is SIGKILL'd
+        mid-job; a second run must resume from completed units only
+        and finish with every row exactly once (disjoint manifest
+        ranges covering the input — manifest-last commit semantics).
+      - replica_kill: one of two subprocess `sparknet-serve` replicas
+        is SIGKILL'd mid-job; the driver must finish on the survivor
+        (hard retries > 0, job done) — a replica death is a retry,
+        never a job failure.
+    """
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from sparknet_tpu.batch import BatchConfig, BatchDriver, load_manifest
+    from sparknet_tpu.batch import manifest as _mf
+    from sparknet_tpu.fleet import (FleetConfig, FleetController,
+                                    FleetPolicy,
+                                    SubprocessReplicaProvider)
+    from sparknet_tpu.net_api import JaxNet
+    from sparknet_tpu.serve import (BinaryFrontend, ModelRouter,
+                                    PriorityAdmission, RouterConfig,
+                                    ServeConfig, binary_infer)
+    from sparknet_tpu.utils.logger import Logger
+    from sparknet_tpu.zoo import lenet
+
+    model = "lenet"
+    slo_ms = 60.0
+    workdir = keep or tempfile.mkdtemp(prefix="batch-bench-")
+    os.makedirs(workdir, exist_ok=True)
+    logger = Logger(path=os.path.join(workdir, "batch_bench.log"),
+                    echo=False,
+                    jsonl_path=os.path.join(workdir,
+                                            "batch_bench.jsonl"))
+    rng = np.random.default_rng(0)
+    req = {"data": rng.standard_normal((28, 28, 1)).astype(np.float32)}
+    inp = os.path.join(workdir, "input.npz")
+    np.savez(inp, data=rng.standard_normal(
+        (rows, 28, 28, 1)).astype(np.float32))
+
+    def job_cfg(out_name: str, addrs: list, **kw) -> BatchConfig:
+        base = dict(input=inp, output=os.path.join(workdir, out_name),
+                    replicas=addrs, outputs=("fc1",), unit_rows=16,
+                    window=8, concurrency=2, deadline_s=15.0,
+                    request_timeout_s=60.0, max_attempts=8,
+                    cost_per_replica_hour=1.0,
+                    jsonl_path=os.path.join(workdir,
+                                            "batch_bench.jsonl"))
+        base.update(kw)
+        return BatchConfig(**base)
+
+    def retry_counts(drv: BatchDriver) -> dict:
+        return {"shed": int(drv._c_retries.value(kind="shed") or 0),
+                "error": int(drv._c_retries.value(kind="error") or 0)}
+
+    def coverage_exact(out_dir: str) -> bool:
+        """Exactly-once, from the committed artifacts: the manifest's
+        unit ranges are exactly the plan (disjoint, covering), and
+        every listed part holds exactly its unit's rows."""
+        m = load_manifest(out_dir)
+        if m is None or not m["done"]:
+            return False
+        plan = _mf.plan_units(m["n_rows"], m["unit_rows"])
+        got = sorted((u["start"], u["stop"])
+                     for u in m["units"].values())
+        if got != sorted(plan):
+            return False
+        for uid_s, u in m["units"].items():
+            with np.load(os.path.join(
+                    out_dir, _mf.part_name(int(uid_s)))) as z:
+                if z["fc1"].shape[0] != u["rows"]:
+                    return False
+        return True
+
+    rows_out: dict = {}
+
+    # -- arms 1+2: coexist under flood, then release --------------------------
+    admission = PriorityAdmission()
+    router = ModelRouter(RouterConfig(workers=2), logger=logger)
+    router.add_model(
+        model, JaxNet(lenet(batch=max_batch)),
+        cfg=ServeConfig(model_name=model, max_batch=max_batch,
+                        max_wait_ms=5.0, outputs=("prob",),
+                        slo_p99_ms=slo_ms, metrics_every_batches=0))
+    fc = FleetController(
+        router, provider=None,
+        cfg=FleetConfig(interval_s=0.2, window_s=3.0,
+                        policy=FleetPolicy(up_ticks=2, down_ticks=6,
+                                           min_window_n=16,
+                                           pressure_start=0.6,
+                                           pressure_full=1.0,
+                                           batch_max_starvation_s=5.0)),
+        admission=admission, logger=logger)
+    with router:
+        # two front doors over one lane: the driver's replica rotation
+        # has somewhere to rotate TO, and both doors share the admission
+        fes = [BinaryFrontend(router, port=0, logger=logger,
+                              tenants=admission) for _ in range(2)]
+        try:
+            addrs = [f"{fe.address[0]}:{fe.address[1]}" for fe in fes]
+            base_rps = _calibrate_rps(fes[0].address, model, req)
+            online_rps = max(5.0, 0.3 * base_rps)
+            flood_rps = min(300.0, max(40.0, 4.0 * base_rps))
+            secs = max(10.0, 5.0 * duration_s)
+            fc.start()
+            res: dict = {}
+
+            def run_class(name, rps, prio, tenant):
+                res[name] = _open_load(fes[0].address, req=req,
+                                       model=model, rps=rps, secs=secs,
+                                       deadline_s=0.25, priority=prio,
+                                       tenant=tenant)
+            th = threading.Thread(target=run_class,
+                                  args=("online", online_rps, "high",
+                                        "online"))
+            tl = threading.Thread(target=run_class,
+                                  args=("lowflood", flood_rps, "low",
+                                        "lowflood"))
+            drv1 = BatchDriver(job_cfg("job-coexist", addrs),
+                               logger=logger)
+            job1: dict = {}
+
+            def run_job1():
+                job1["summary"] = drv1.run()
+            tj = threading.Thread(target=run_job1)
+            th.start()
+            tl.start()
+            tj.start()
+            th.join(timeout=secs + 60.0)
+            tl.join(timeout=secs + 60.0)
+            units_during_flood = drv1.units_done  # flood just ended
+            tj.join(timeout=secs + 240.0)
+            if "online" not in res or "lowflood" not in res or \
+                    "summary" not in job1:
+                raise RuntimeError(
+                    f"coexist arm: a load class or the batch job never "
+                    f"finished (got loads={sorted(res)}, job done="
+                    f"{'summary' in job1})")
+            oc, ol, oh = res["online"]
+            lc, _, lh = res["lowflood"]
+            online_p99_tail = _lat_p99_ms(ol, secs / 2.0)
+            reliefs = [a for a in fc.audit
+                       if a.get("reason") == "batch_starvation"]
+            within = (online_p99_tail is not None
+                      and online_p99_tail <= slo_ms)
+            rows_out["coexist"] = {
+                "base_rps": round(base_rps, 1),
+                "online_rps": round(online_rps, 1),
+                "flood_rps": round(flood_rps, 1), "secs": secs,
+                "online": {**oc, "hung_clients": oh,
+                           "p99_ms": _lat_p99_ms(ol),
+                           "p99_tail_ms": online_p99_tail},
+                "lowflood": {**lc, "hung_clients": lh},
+                "slo_p99_ms": slo_ms,
+                "online_p99_within_slo": within,
+                # shared-core box: clients + replicas + driver contend
+                # for the same CPUs; the SLO number needs per-replica
+                # hardware when it misses here
+                "structure_proof": not within,
+                "units_during_flood": units_during_flood,
+                "job": job1["summary"],
+                "driver_retries": retry_counts(drv1),
+                "pressure_final": round(fc.pressure, 3),
+                "starvation_relief_events": len(reliefs),
+            }
+
+            # release: the flood is gone — the same job shape must run
+            # strictly faster than it did under admission pressure
+            drv2 = BatchDriver(job_cfg("job-release", addrs),
+                               logger=logger)
+            job2 = drv2.run()
+            rows_out["release"] = {
+                "job": job2,
+                "driver_retries": retry_counts(drv2),
+                "img_per_s": job2["img_per_s"],
+                "cost_per_million_embeddings":
+                    job2["cost_per_million_embeddings"],
+            }
+        finally:
+            fc.stop()
+            for fe in fes:
+                fe.stop()
+
+        # -- arm 3: kill -9 the DRIVER mid-job, resume ------------------------
+        fes = [BinaryFrontend(router, port=0, logger=logger)
+               for _ in range(2)]
+        try:
+            addrs = [f"{fe.address[0]}:{fe.address[1]}" for fe in fes]
+            out3 = os.path.join(workdir, "job-driver-kill")
+            env = dict(os.environ)
+            env["PYTHONPATH"] = os.path.dirname(
+                os.path.abspath(__file__)) + os.pathsep + \
+                env.get("PYTHONPATH", "")
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "sparknet_tpu.batch.driver",
+                 "--input", inp, "--out", out3,
+                 "--replicas", ",".join(addrs), "--outputs", "fc1",
+                 "--unit-rows", "8", "--window", "8",
+                 "--concurrency", "1", "--pace-s", "0.2",
+                 "--deadline-ms", "15000", "--timeout-s", "60"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                env=env)
+            t0 = time.monotonic()
+            killed_after_units = 0
+            while time.monotonic() - t0 < 120.0:
+                m = load_manifest(out3)
+                if m is not None and len(m["units"]) >= 2:
+                    killed_after_units = len(m["units"])
+                    break
+                time.sleep(0.1)
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30.0)
+            partial = load_manifest(out3)
+            resumed = BatchDriver(job_cfg(
+                "job-driver-kill", addrs, unit_rows=8)).run()
+            rows_out["driver_kill"] = {
+                "killed_after_units": killed_after_units,
+                "partial_units": (len(partial["units"])
+                                  if partial else 0),
+                "units_total": resumed["units_total"],
+                "units_skipped_resume":
+                    resumed["units_skipped_resume"],
+                "resumed_done": resumed["done"],
+                "exactly_once": coverage_exact(out3),
+            }
+        finally:
+            for fe in fes:
+                fe.stop()
+
+    # -- arm 4: kill -9 a REPLICA mid-job -------------------------------------
+    prov = SubprocessReplicaProvider(
+        {model: "lenet"},
+        workdir=os.path.join(workdir, "replicas"),
+        max_batch=max_batch,
+        compile_cache_dir=os.path.join(workdir, "compile-cache"),
+        heartbeat_every_s=0.3)
+    try:
+        h1 = prov.grow(model)
+        h2 = prov.grow(model)
+        addrs = [h.url.split("://", 1)[-1] for h in (h1, h2)]
+        for a in addrs:  # warm both children's buckets outside the job
+            host, port = a.rsplit(":", 1)
+            binary_infer((host, int(port)), model, req, deadline_s=60.0,
+                         timeout=120.0)
+        drv4 = BatchDriver(job_cfg("job-replica-kill", addrs,
+                                   unit_rows=8, pace_s=0.05),
+                           logger=logger)
+        job4: dict = {}
+        err4: dict = {}
+
+        def run_job4():
+            try:
+                job4["summary"] = drv4.run()
+            except Exception as e:
+                err4["err"] = f"{type(e).__name__}: {e}"
+        tj = threading.Thread(target=run_job4)
+        tj.start()
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 120.0 and drv4.units_done < 1:
+            time.sleep(0.05)
+        h1.meta["proc"].send_signal(signal.SIGKILL)
+        tj.join(timeout=300.0)
+        if tj.is_alive():
+            raise RuntimeError("replica_kill arm: the driver hung past "
+                               "its join bound")
+        r4 = retry_counts(drv4)
+        rows_out["replica_kill"] = {
+            "job": job4.get("summary"),
+            "driver_error": err4.get("err"),
+            "driver_retries": r4,
+            "completed": bool(job4.get("summary", {}).get("done")),
+            "hard_retries_nonzero": r4["error"] > 0,
+            "exactly_once": coverage_exact(
+                os.path.join(workdir, "job-replica-kill")),
+        }
+    finally:
+        prov.stop()
+        logger.close()
+
+    co, rel = rows_out["coexist"], rows_out["release"]
+    asserts = {
+        # the hard gate, online side: every request answered
+        "zero_dropped_timed_out_hung_online":
+            co["online"]["dropped"] == co["online"]["timed_out"] == 0
+            and co["online"]["hung_clients"] == 0
+            and co["lowflood"]["dropped"]
+            == co["lowflood"]["timed_out"] == 0
+            and co["lowflood"]["hung_clients"] == 0,
+        # coexistence, batch side: progress WHILE the flood ran, and
+        # every rejection the driver saw was a typed shed, not a break
+        "batch_progress_under_flood": co["units_during_flood"] > 0,
+        "batch_job_completed_coexist": co["job"]["done"],
+        "driver_zero_hard_failures_coexist":
+            co["driver_retries"]["error"] == 0
+            and rel["driver_retries"]["error"] == 0,
+        # coexistence, online side: the low class shed typed; the
+        # online class NEVER priority-shed
+        "low_sheds_typed": co["lowflood"]["shed_priority"] > 0,
+        "online_never_priority_shed":
+            co["online"]["shed_priority"] == 0,
+        # the release claim: admission was the brake, not the pipeline
+        "post_flood_throughput_rises":
+            rel["job"]["rows_per_s"] > co["job"]["rows_per_s"],
+        "cost_per_million_reported":
+            rel["cost_per_million_embeddings"] is not None,
+        # chaos
+        "driver_kill_resumes_exactly_once":
+            rows_out["driver_kill"]["resumed_done"]
+            and rows_out["driver_kill"]["units_skipped_resume"] > 0
+            and rows_out["driver_kill"]["exactly_once"],
+        "replica_kill_is_retry_not_failure":
+            rows_out["replica_kill"]["completed"]
+            and rows_out["replica_kill"]["hard_retries_nonzero"]
+            and rows_out["replica_kill"]["exactly_once"],
+    }
+    out = {"bench": "batch", "duration_s": duration_s,
+           "max_batch": max_batch, "input_rows": rows,
+           "arms": rows_out, "asserts": asserts,
+           "ok": all(asserts.values())}
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps({
+        "bench": "batch", "ok": out["ok"], "asserts": asserts,
+        "coexist_rows_per_s": co["job"]["rows_per_s"],
+        "release_rows_per_s": rel["job"]["rows_per_s"],
+        "online_p99_tail_ms": co["online"]["p99_tail_ms"],
+        "cost_per_million_embeddings":
+            rel["cost_per_million_embeddings"]}))
+    if keep is None and out["ok"]:
+        shutil.rmtree(workdir, ignore_errors=True)
+    if not out["ok"]:
+        raise SystemExit("batch bench gate failed: " + ", ".join(
+            k for k, v in asserts.items() if not v))
+    return out
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--scaling", action="store_true",
@@ -3938,7 +4308,16 @@ def main() -> None:
                    "backend (GraphTrainer over build_alexnet_graph)")
     p.add_argument("--profile", metavar="DIR", default=None,
                    help="capture a jax.profiler trace of the timed section")
-    p.add_argument("--batch", type=int, default=None,
+    p.add_argument("--batch", action="store_true",
+                   help="r14 bulk-inference audit: a sparknet-batch "
+                   "scavenger job colocated with open-loop online "
+                   "traffic (typed low sheds, post-flood throughput "
+                   "rise) + driver/replica kill -9 chaos; writes "
+                   "BENCH_BATCH")
+    p.add_argument("--batch-rows", type=int, default=192,
+                   help="input rows for --batch (CI short config uses "
+                   "fewer)")
+    p.add_argument("--batch-size", type=int, default=None,
                    help=f"per-chip batch (headline default {BATCH}; "
                    f"--featurize default 64)")
     p.add_argument("--tau", type=int, default=TAU,
@@ -3959,24 +4338,28 @@ def main() -> None:
         fresh_train_child(args.fresh_train_child)
     elif args.fresh:
         fresh_bench(rounds=args.fresh_rounds,
-                    max_batch=args.batch or 8, keep=args.keep)
+                    max_batch=args.batch_size or 8, keep=args.keep)
     elif args.econ:
         econ_bench(duration_s=args.serve_secs,
-                   max_batch=args.batch or 8, keep=args.keep)
+                   max_batch=args.batch_size or 8, keep=args.keep)
     elif args.serve:
         serve_bench(duration_s=args.serve_secs,
-                    max_batch=args.batch or 8, keep=args.keep)
+                    max_batch=args.batch_size or 8, keep=args.keep)
     elif args.tail:
         tail_bench(duration_s=args.serve_secs,
-                   max_batch=args.batch or 8, keep=args.keep)
+                   max_batch=args.batch_size or 8, keep=args.keep)
     elif args.fleet:
         fleet_bench(duration_s=args.serve_secs,
-                    max_batch=args.batch or 8, keep=args.keep)
+                    max_batch=args.batch_size or 8, keep=args.keep)
+    elif args.batch:
+        batch_bench(duration_s=args.serve_secs,
+                    max_batch=args.batch_size or 8,
+                    rows=args.batch_rows, keep=args.keep)
     elif args.obs:
         obs_bench()
     elif args.mfu:
         import jax as _jax
-        mfu_bench(batch=args.batch or BATCH, tau=args.tau,
+        mfu_bench(batch=args.batch_size or BATCH, tau=args.tau,
                   small=_jax.default_backend() != "tpu")
     elif args.ckpt_shard:
         ckpt_shard_bench()
@@ -3985,12 +4368,12 @@ def main() -> None:
     elif args.elastic:
         elastic_bench(rounds=args.elastic_rounds, keep=args.keep)
     elif args.featurize:
-        featurize_bench(batch=args.batch or 64)
+        featurize_bench(batch=args.batch_size or 64)
     elif args.graph:
-        graph_headline(batch=args.batch or BATCH, tau=args.tau,
+        graph_headline(batch=args.batch_size or BATCH, tau=args.tau,
                        profile_dir=args.profile)
     else:
-        headline(profile_dir=args.profile, batch=args.batch or BATCH,
+        headline(profile_dir=args.profile, batch=args.batch_size or BATCH,
                  tau=args.tau)
 
 

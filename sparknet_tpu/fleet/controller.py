@@ -144,6 +144,12 @@ class FleetController:
             "the fast lever: [0,1] overload level pushed into "
             "priority admission each tick")
         self._g_pressure.set(0.0)
+        self._g_starvation = self.registry.gauge(
+            "sparknet_fleet_batch_starvation_s",
+            "seconds the low (scavenger/batch) class has been "
+            "continuously admission-shed with nothing admitted")
+        self._g_starvation.set(0.0)
+        self._batch_relieving = False  # audit edge detector
         self._state: Dict[str, _ModelState] = {}
         # provider-grown replicas: model -> [(router Replica, handle)]
         self._owned: Dict[str, List[Tuple[Any, ReplicaHandle]]] = {}
@@ -247,9 +253,12 @@ class FleetController:
                else {"n": 0, "p99_ms": None})
         lane = self.router.lanes.get(model)
         queue_frac = 0.0
+        low_queue_frac = 0.0
         shed_total = 0.0
         if lane is not None:
             queue_frac = lane.batcher.depth() / max(
+                lane.cfg.max_queue, 1)
+            low_queue_frac = lane.batcher.low_depth() / max(
                 lane.cfg.max_queue, 1)
             shed_total = float(lane.batcher.shed)
             rej = self.registry.counter(
@@ -271,7 +280,17 @@ class FleetController:
                             n_window=int(win["n"]),
                             queue_frac=queue_frac,
                             shed_per_s=shed_per_s,
-                            replicas=len(reps), routable=routable)
+                            replicas=len(reps), routable=routable,
+                            low_queue_frac=low_queue_frac,
+                            batch_starvation_s=self._starvation_s())
+
+    def _starvation_s(self) -> float:
+        """How long the low class has been continuously pressure-shed
+        at the attached admission door (0 without one)."""
+        if self.admission is not None and \
+                hasattr(self.admission, "starvation_s"):
+            return float(self.admission.starvation_s())
+        return 0.0
 
     # -- the control step ----------------------------------------------------
 
@@ -299,6 +318,23 @@ class FleetController:
         # fast lever: admission pressure, every tick, no hysteresis —
         # shedding low-priority load is cheap and instantly reversible
         self.pressure = self.policy.pressure_from_burn(burn_max)
+        # scavenger relief: sustained pressure must not weld the door
+        # shut on the low class forever. Past the policy's starvation
+        # bound the pressure is clamped just under low's shed threshold
+        # for the tick — online traffic still outranks batch at every
+        # queue, the door just stops being airtight.
+        starvation = self._starvation_s()
+        self._g_starvation.set(round(starvation, 3))
+        if self.policy.batch_relief(starvation, self.pressure):
+            if not self._batch_relieving:
+                self._batch_relieving = True
+                self._event("_batch", "relief", "batch_starvation",
+                            starvation_s=round(starvation, 3),
+                            pressure=round(self.pressure, 4),
+                            clamped=self.policy.batch_relief_pressure)
+            self.pressure = self.policy.batch_relief_pressure
+        else:
+            self._batch_relieving = False
         self._g_pressure.set(round(self.pressure, 4))
         if self.admission is not None and \
                 hasattr(self.admission, "set_pressure"):
@@ -602,6 +638,7 @@ class FleetController:
             "window_s": self.cfg.window_s,
             "ticks": self.ticks,
             "pressure": round(self.pressure, 4),
+            "batch_starvation_s": round(self._starvation_s(), 3),
             "provider": (type(self.provider).__name__
                          if self.provider is not None else None),
             "pool": {"size": self.router.pool_size(),

@@ -51,6 +51,14 @@ class ModelSignals:
     shed_per_s: float               # sheds per second since last tick
     replicas: int                   # registered replicas (incl. local)
     routable: int                   # currently routable replicas
+    # scavenger (batch-tenant) pressure: the fraction of the queue that
+    # is low-priority work, and how long the low class has been
+    # continuously admission-shed. Low backlog must never read as
+    # online demand (the autoscaler would grow the fleet to chase work
+    # that exists precisely to soak SLACK) — but neither may pressure
+    # pin the door shut on it forever.
+    low_queue_frac: float = 0.0
+    batch_starvation_s: float = 0.0
 
 
 def slo_burn(p99_ms: Optional[float],
@@ -93,6 +101,14 @@ class FleetPolicy:
     rollout_wave_size: int = 2
     rollout_halt_burn: float = 1.5
     rollout_timeout_s: float = 30.0
+    # scavenger coexistence: the low class may be pressure-starved for
+    # at most batch_max_starvation_s; past that the controller clamps
+    # admission pressure to batch_relief_pressure (just UNDER low's
+    # default shed threshold, 0.5) until scavenger traffic flows again.
+    # Online work still outranks batch at every queue and bucket — the
+    # relief only stops the door being welded shut.
+    batch_max_starvation_s: float = 60.0
+    batch_relief_pressure: float = 0.45
 
     def __post_init__(self) -> None:
         # fail at construction, not mid-control-loop (the ElasticConfig
@@ -121,6 +137,13 @@ class FleetPolicy:
             raise ValueError(
                 f"rollout_halt_burn/rollout_timeout_s must be > 0 (got "
                 f"{self.rollout_halt_burn}, {self.rollout_timeout_s})")
+        if self.batch_max_starvation_s <= 0:
+            raise ValueError(f"batch_max_starvation_s must be > 0 (got "
+                             f"{self.batch_max_starvation_s})")
+        if not 0.0 <= self.batch_relief_pressure < 1.0:
+            raise ValueError(
+                f"batch_relief_pressure must be in [0, 1) (got "
+                f"{self.batch_relief_pressure})")
 
     # -- signal -> verdict ---------------------------------------------------
 
@@ -135,6 +158,14 @@ class FleetPolicy:
         span = self.pressure_full - self.pressure_start
         return min(1.0, max(0.0, (burn - self.pressure_start) / span))
 
+    @staticmethod
+    def online_queue_frac(sig: ModelSignals) -> float:
+        """Queue fraction attributable to ONLINE (non-low) work. The
+        scale verdicts read this, not the raw fraction: a scavenger job
+        keeping the queue full of low-priority units is soaking slack,
+        not demanding capacity."""
+        return max(0.0, sig.queue_frac - sig.low_queue_frac)
+
     def hot_reason(self, sig: ModelSignals) -> Optional[str]:
         """The scale-up trigger that fired, or None. Named because the
         reason lands in `fleet_scale_events_total{reason}` and the audit
@@ -142,7 +173,7 @@ class FleetPolicy:
         shed_rate" is."""
         if self.burn(sig) >= self.burn_up:
             return "slo_burn"
-        if sig.queue_frac >= self.queue_high:
+        if self.online_queue_frac(sig) >= self.queue_high:
             return "queue"
         if sig.shed_per_s >= self.shed_high_per_s:
             return "shed"
@@ -151,8 +182,17 @@ class FleetPolicy:
     def is_cold(self, sig: ModelSignals) -> bool:
         """Quiet enough to consider giving a replica back: below every
         hot trigger with margin. (An UNKNOWN p99 — idle model — is cold:
-        idleness is exactly when shrink should happen.)"""
+        idleness is exactly when shrink should happen. A queue full of
+        scavenger units does NOT hold replicas: only online depth
+        counts, same as hot_reason.)"""
         burn = self.burn(sig)
         return (burn < self.burn_down
-                and sig.queue_frac < self.queue_low
+                and self.online_queue_frac(sig) < self.queue_low
                 and sig.shed_per_s < self.shed_high_per_s / 2.0)
+
+    def batch_relief(self, starvation_s: float, pressure: float) -> bool:
+        """True when admission pressure should be clamped to
+        batch_relief_pressure this tick: the low class has been starved
+        past the bound AND the computed pressure would keep shedding it."""
+        return (starvation_s >= self.batch_max_starvation_s
+                and pressure > self.batch_relief_pressure)

@@ -132,6 +132,9 @@ def summarize(recs: List[Dict[str, Any]], tail: int = 10) -> Dict[str, Any]:
     fleet = _fleet_view(recs)
     if fleet is not None:
         out["fleet"] = fleet
+    batch = _batch_view(recs)
+    if batch is not None:
+        out["batch"] = batch
     return out
 
 
@@ -213,6 +216,45 @@ def _fleet_view(recs: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
                   for e in events[-20:]],
         "models": models,
         "pressure_max": max(pressures) if pressures else None,
+    }
+
+
+def _batch_view(recs: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """The `sparknet-batch` driver's record: per-unit commit rows
+    (`event="batch_unit"`), the retry trail (`event="batch_retry"`,
+    split shed-vs-error — backpressure is not breakage), and the final
+    job summary (`event="batch_done"` — fleet-aggregate rows/s and
+    cost-per-million). None when the records carry no batch rows."""
+    units = [r for r in recs if r.get("event") == "batch_unit"]
+    retries = [r for r in recs if r.get("event") == "batch_retry"]
+    dones = [r for r in recs if r.get("event") == "batch_done"]
+    if not units and not retries and not dones:
+        return None
+    by_replica: Dict[str, int] = {}
+    for u in units:
+        key = str(u.get("replica", "?"))
+        by_replica[key] = by_replica.get(key, 0) + 1
+    by_kind: Dict[str, int] = {}
+    for r in retries:
+        key = str(r.get("kind", "?"))
+        by_kind[key] = by_kind.get(key, 0) + 1
+    jobs: Dict[str, Any] = {}
+    for d in dones:  # last row per job wins (a resume re-summarizes)
+        jobs[str(d.get("job_id", "?"))] = {
+            k: d.get(k) for k in
+            ("done", "units_total", "units_done",
+             "units_skipped_resume", "rows_total", "elapsed_s",
+             "rows_per_s", "retries", "cost_per_million_embeddings")}
+    return {
+        "units": len(units),
+        "rows": sum(int(u.get("rows", 0)) for u in units),
+        "output_bytes": sum(int(u.get("bytes", 0)) for u in units),
+        "attempts_max": max((int(u.get("attempts", 1)) for u in units),
+                            default=None),
+        "retries": len(retries),
+        "retries_by_kind": dict(sorted(by_kind.items())),
+        "units_by_replica": dict(sorted(by_replica.items())),
+        "jobs": jobs,
     }
 
 
@@ -438,6 +480,26 @@ def format_text(s: Dict[str, Any]) -> str:
             lines.append(f"    {e.get('model', '?')}: "
                          f"{e.get('direction', '?')} "
                          f"({e.get('reason', '?')}) {rest}".rstrip())
+    batch = s.get("batch")
+    if batch:
+        lines.append("")
+        lines.append(f"batch view (scavenger bulk-inference; "
+                     f"{batch['units']} units committed):")
+        lines.append(f"  rows {batch['rows']}  output "
+                     f"{batch['output_bytes'] / 1e6:.2f} MB  retries "
+                     f"{batch['retries']}"
+                     + ("".join(f"  {k}={n}" for k, n in
+                                batch["retries_by_kind"].items())))
+        for addr, n in batch["units_by_replica"].items():
+            lines.append(f"    replica {addr}: {n} units")
+        for jid, j in sorted(batch["jobs"].items()):
+            cpm = j.get("cost_per_million_embeddings")
+            lines.append(
+                f"  job {jid}: "
+                f"{'done' if j.get('done') else 'INCOMPLETE'}  units "
+                f"{j.get('units_done')}/{j.get('units_total')}  "
+                f"{j.get('rows_per_s')} rows/s"
+                + (f"  ${cpm}/M embeddings" if cpm is not None else ""))
     if s["event_trail"]:
         lines.append("")
         lines.append("health/event audit trail:")
@@ -497,7 +559,46 @@ def _selfcheck_jsonl(n_workers: int = 1,
         paths.append(jsonl)
     paths.append(_selfcheck_serve_jsonl(root))
     paths.append(_selfcheck_fleet_jsonl(root))
+    paths.append(_selfcheck_batch_jsonl(root))
     return paths
+
+
+def _selfcheck_batch_jsonl(root: str) -> str:
+    """Run a tiny real `sparknet-batch` job (lenet replica behind a
+    binary frontend, an 8-row npz swept as tenant=batch/priority=low)
+    and return the driver JSONL it wrote — so the batch view (unit
+    commits, retry trail, the rows/s + cost-per-million job summary)
+    cannot rot against the driver's live record schema without failing
+    the selfcheck."""
+    import os
+
+    import numpy as np
+
+    from ..batch import BatchConfig, BatchDriver
+    from ..net_api import JaxNet
+    from ..serve import BinaryFrontend, InferenceServer, ServeConfig
+    from ..zoo import lenet
+
+    jsonl = os.path.join(root, "selfcheck_batch_metrics.jsonl")
+    r = np.random.default_rng(0)
+    inp = os.path.join(root, "selfcheck_batch_input.npz")
+    np.savez(inp, data=r.standard_normal(
+        (8, 28, 28, 1)).astype(np.float32))
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, buckets=(1, 4),
+                      outputs=("prob",), metrics_every_batches=0)
+    with InferenceServer(JaxNet(lenet(batch=4)), cfg) as srv:
+        fe = BinaryFrontend(srv, port=0)
+        try:
+            BatchDriver(BatchConfig(
+                input=inp,
+                output=os.path.join(root, "selfcheck_batch_out"),
+                replicas=[f"{fe.address[0]}:{fe.address[1]}"],
+                outputs=("fc1",), unit_rows=4, window=4,
+                concurrency=1, cost_per_replica_hour=1.0,
+                jsonl_path=jsonl)).run()
+        finally:
+            fe.stop()
+    return jsonl
 
 
 def _selfcheck_fleet_jsonl(root: str) -> str:
@@ -694,6 +795,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.selfcheck and not (s.get("fleet") or {}).get("scale_events"):
         print("selfcheck: fleet run produced no scale-event audit "
               "(the fleet view's input)", file=sys.stderr)
+        return 1
+    if args.selfcheck and not (s.get("batch") or {}).get("units"):
+        print("selfcheck: batch run produced no unit-commit rows "
+              "(the batch view's input)", file=sys.stderr)
         return 1
     return 0
 

@@ -54,7 +54,9 @@ from .http_frontend import BackendAdapter, HttpFrontend, http_infer
 from .model_manager import ModelManager, ServeModelError
 from .router import (ModelRouter, NoReplicaError, Replica, RouterConfig,
                      UnknownModelError, heartbeat_fill, heartbeat_health)
-from .server import InferenceServer, ServeConfig, parity_batch, zeros_batch
+from .server import (OUTPUTS_KEY, InferenceServer, ServeConfig,
+                     encode_outputs, parity_batch, pop_outputs,
+                     zeros_batch)
 from .wire import WireError
 
 __all__ = [
@@ -62,6 +64,7 @@ __all__ = [
     "RequestCancelledError", "ServeRequest",
     "ModelManager", "ServeModelError",
     "InferenceServer", "ServeConfig", "zeros_batch", "parity_batch",
+    "OUTPUTS_KEY", "encode_outputs", "pop_outputs",
     "QuantConfig", "derive_buckets", "fill_ratio", "size_hist_from_jsonl",
     "ModelRouter", "RouterConfig", "Replica", "NoReplicaError",
     "UnknownModelError", "heartbeat_health", "heartbeat_fill",

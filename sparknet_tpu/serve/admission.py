@@ -214,6 +214,11 @@ class PriorityAdmission(TenantAdmission):
         self.pressure = 0.0
         self.shed_priority = 0     # lifetime "priority" sheds
         self.shed_tenant_limit = 0
+        # scavenger-starvation clock: monotonic time since the LAST
+        # low-priority request was admitted while at least one has been
+        # pressure-shed since — the fleet controller's batch_starvation_s
+        # signal. None = low traffic is flowing (or none has been shed).
+        self._low_starved_since: Optional[float] = None
 
     def set_pressure(self, p: float) -> None:
         """The fleet controller's fast lever (clamped to [0, 1])."""
@@ -239,7 +244,16 @@ class PriorityAdmission(TenantAdmission):
             with self._lock:
                 self.shed += 1
                 self.shed_priority += 1
+                if cls == "low" and self._low_starved_since is None:
+                    self._low_starved_since = time.monotonic()
             return "priority"
+        if cls == "low":
+            # a low request made it past the pressure gate: the
+            # scavenger class is flowing again, whatever the tenant
+            # bucket says next (tenant_limit is that tenant's own
+            # budget, not class starvation)
+            with self._lock:
+                self._low_starved_since = None
         if self.rate_rps is None:
             return None
         if self.allow(tenant):
@@ -247,6 +261,16 @@ class PriorityAdmission(TenantAdmission):
         with self._lock:
             self.shed_tenant_limit += 1
         return "tenant_limit"
+
+    def starvation_s(self) -> float:
+        """Seconds the "low" class has been continuously pressure-shed
+        with nothing admitted — 0 while scavenger traffic flows. The
+        fleet controller exports this as `batch_starvation_s` and
+        relieves pressure when it exceeds the policy bound."""
+        with self._lock:
+            since = self._low_starved_since
+        return 0.0 if since is None else max(0.0,
+                                             time.monotonic() - since)
 
     def status(self) -> Dict[str, object]:
         """The /fleet/status admission row."""
@@ -258,4 +282,5 @@ class PriorityAdmission(TenantAdmission):
                 "weights": dict(self.weights),
                 "tracked_tenants": self.tracked_tenants(),
                 "shed_priority": self.shed_priority,
-                "shed_tenant_limit": self.shed_tenant_limit}
+                "shed_tenant_limit": self.shed_tenant_limit,
+                "batch_starvation_s": round(self.starvation_s(), 3)}

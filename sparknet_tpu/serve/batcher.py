@@ -43,7 +43,7 @@ import time
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -82,6 +82,13 @@ class ServeRequest:
     t_enqueue: float = field(default_factory=time.perf_counter)
     id: int = 0
     deadline: Optional[float] = None
+    # admission class the request arrived under ("high"/"normal"/"low");
+    # the fleet controller reads the queue's low-priority share so
+    # scavenger (batch-tenant) backlog never reads as online demand
+    priority: str = "normal"
+    # per-request named output blobs (the featurizer route): None =
+    # the lane's configured outputs / default per-row blobs
+    outputs: Optional[Tuple[str, ...]] = None
 
 
 class DynamicBatcher:
@@ -135,17 +142,30 @@ class DynamicBatcher:
     def depth(self) -> int:
         return len(self._q)  # len(deque) is atomic; hot path, no lock
 
+    def low_depth(self) -> int:
+        """Queued requests in the "low" class (scavenger/batch tenants).
+        Scanned under the lock at the fleet controller's tick cadence —
+        never on the submit hot path."""
+        with self._lock:
+            return sum(1 for r in self._q if r.priority == "low")
+
     def submit(self, payload: Dict[str, Any],
-               deadline_s: Optional[float] = None) -> Future:
+               deadline_s: Optional[float] = None,
+               priority: Optional[str] = None,
+               outputs: Optional[Tuple[str, ...]] = None) -> Future:
         """Enqueue one request; returns its response future. Raises
         QueueFullError at capacity and RuntimeError after close().
         `deadline_s` (relative seconds) is the client's answer-by bound:
         a request that cannot be formed into a batch before it expires
         is shed with DeadlineExpiredError instead of riding a bucket
         slot. An ALREADY-expired deadline returns a pre-failed future
-        without touching the queue."""
+        without touching the queue. `priority` tags the queued request
+        with its admission class (low-share telemetry); `outputs` pins
+        per-request named blobs for the forming forward."""
         req = ServeRequest(payload={k: np.asarray(v)
-                                    for k, v in payload.items()})
+                                    for k, v in payload.items()},
+                           priority=(priority or "normal"),
+                           outputs=(tuple(outputs) if outputs else None))
         if deadline_s is not None:
             req.deadline = req.t_enqueue + float(deadline_s)
             if deadline_s <= 0:

@@ -72,6 +72,7 @@ from .batcher import (DeadlineExpiredError, QueueFullError,
 from .http_frontend import (BackendAdapter, lru_cache_drop,
                             lru_cache_get, register_transport_metrics)
 from .router import NoReplicaError, UnknownModelError
+from .server import encode_outputs, pop_outputs
 
 _DEFAULT_WAIT_S = 30.0  # reply bound for requests with no deadline
 
@@ -628,11 +629,17 @@ class BinaryFrontend:
                 inputs = wire.tensors_from(descs, payload)
                 with self._byte_lock:
                     self.payload_rx_bytes += len(payload)
+            # the outputs request rides the tensor table as a reserved
+            # key (no frame-format change); pop it before the net sees
+            # the payload
+            inputs, outputs = pop_outputs(inputs)
             model = self.adapter.resolve(model_s or None)
             self.adapter.coerce(model, inputs)
             deadline_s = (deadline_ms / 1e3 if deadline_ms is not None
                           else self.default_deadline_s)
-            fut = self.adapter.submit(model, inputs, deadline_s)
+            fut = self.adapter.submit(model, inputs, deadline_s,
+                                      priority=priority or None,
+                                      outputs=outputs)
         except BaseException as e:
             ck, msg = _exception_to_err(e)
             self._journal_row(jinfo, ck[1])
@@ -861,9 +868,11 @@ class BinaryClient:
                model: str = "", deadline_s: Optional[float] = None,
                tenant: Optional[str] = None,
                priority: Optional[str] = None,
-               stream: bool = False) -> int:
+               stream: bool = False,
+               outputs: Optional[Tuple[str, ...]] = None) -> int:
         rid = next(self._ids)
-        arrays = {k: np.asarray(v) for k, v in payload.items()}
+        arrays = {k: np.asarray(v)
+                  for k, v in encode_outputs(payload, outputs).items()}
         seg_name = None
         if self._ring is not None:
             # spkn-shm: copy the payload into a ring slot; the frame
@@ -1061,10 +1070,12 @@ class BinaryClient:
               deadline_s: Optional[float] = None,
               tenant: Optional[str] = None,
               priority: Optional[str] = None, stream: bool = False,
-              timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+              timeout: Optional[float] = None,
+              outputs: Optional[Tuple[str, ...]] = None
+              ) -> Dict[str, np.ndarray]:
         rid = self.submit(payload, model=model, deadline_s=deadline_s,
                           tenant=tenant, priority=priority,
-                          stream=stream)
+                          stream=stream, outputs=outputs)
         return self.collect(rid, timeout=timeout)
 
 
@@ -1101,7 +1112,8 @@ def binary_infer(address, model: str,
                  priority: Optional[str] = None,
                  stream: bool = False,
                  cancel_box: Optional[dict] = None,
-                 use_shm: Optional[bool] = None
+                 use_shm: Optional[bool] = None,
+                 outputs: Optional[Tuple[str, ...]] = None
                  ) -> Dict[str, np.ndarray]:
     """One inference request over the binary transport (thread-cached
     keep-alive client — the `http_infer` counterpart the router's
@@ -1120,7 +1132,8 @@ def binary_infer(address, model: str,
         try:
             rid = cli.submit(payload, model=model,
                              deadline_s=deadline_s, tenant=tenant,
-                             priority=priority, stream=stream)
+                             priority=priority, stream=stream,
+                             outputs=outputs)
             if cancel_box is not None:
                 cancel_box["cancel"] = \
                     lambda c=cli, r=rid: c.cancel(r)

@@ -90,7 +90,8 @@ from .admission import (PriorityShedError, TenantAdmission,
                         TenantLimitError)
 from .batcher import DeadlineExpiredError, QueueFullError
 from .router import ModelRouter, NoReplicaError, UnknownModelError
-from .server import InferenceServer, net_input_specs
+from .server import (InferenceServer, encode_outputs, net_input_specs,
+                     pop_outputs)
 
 NPZ_CONTENT_TYPE = "application/x-npz"
 
@@ -163,13 +164,20 @@ class BackendAdapter:
         return names[0]
 
     def submit(self, model: str, payload: Dict[str, np.ndarray],
-               deadline_s: Optional[float]):
+               deadline_s: Optional[float],
+               priority: Optional[str] = None,
+               outputs: Optional[Tuple[str, ...]] = None):
         if self.is_router:
-            return self.backend.submit(model, payload,
-                                       deadline_s=deadline_s)
+            # the router's remote legs only speak tensors — fold the
+            # outputs request back into the payload (the terminal
+            # frontend, or a local lane's submit, pops it again)
+            return self.backend.submit(
+                model, encode_outputs(payload, outputs),
+                deadline_s=deadline_s, priority=priority)
         if model != self.backend.model_name:
             raise UnknownModelError(model)
-        return self.backend.submit(payload, deadline_s=deadline_s)
+        return self.backend.submit(payload, deadline_s=deadline_s,
+                                   priority=priority, outputs=outputs)
 
     def coerce(self, model: Optional[str],
                payload: Dict[str, np.ndarray]) -> None:
@@ -322,9 +330,13 @@ class HttpFrontend:
 
     def _submit(self, model: Optional[str],
                 payload: Dict[str, np.ndarray],
-                deadline_s: Optional[float]):
+                deadline_s: Optional[float],
+                priority: Optional[str] = None,
+                outputs: Optional[Tuple[str, ...]] = None):
         model = self.adapter.resolve(model)
-        return model, self.adapter.submit(model, payload, deadline_s)
+        return model, self.adapter.submit(model, payload, deadline_s,
+                                          priority=priority,
+                                          outputs=outputs)
 
     def _step(self, model: str) -> Optional[int]:
         return self.adapter.step(model)
@@ -423,7 +435,8 @@ class HttpFrontend:
             ctype = (h.headers.get("Content-Type") or "").split(";")[0]
             want_npz = ctype == NPZ_CONTENT_TYPE or \
                 NPZ_CONTENT_TYPE in (h.headers.get("Accept") or "")
-            payload, deadline_ms = self._decode(model, body, ctype, h)
+            payload, deadline_ms, outputs = self._decode(
+                model, body, ctype, h)
             deadline_s = (deadline_ms / 1e3 if deadline_ms is not None
                           else self.default_deadline_s)
             if self.journal is not None:
@@ -438,7 +451,9 @@ class HttpFrontend:
                                for k, v in payload.items()})
                 except Exception:
                     pass  # the journal must never fail the data plane
-            model, fut = self._submit(model, payload, deadline_s)
+            model, fut = self._submit(
+                model, payload, deadline_s,
+                priority=h.headers.get("X-Priority"), outputs=outputs)
             # shed-not-hang: the batcher fails the future at the deadline
             # (DeadlineExpiredError); without one we still bound the wait
             wait_s = deadline_s + 5.0 if deadline_s is not None else 30.0
@@ -542,29 +557,34 @@ class HttpFrontend:
 
     def _decode(self, model: Optional[str], body: bytes, ctype: str,
                 h: BaseHTTPRequestHandler
-                ) -> Tuple[Dict[str, np.ndarray], Optional[float]]:
+                ) -> Tuple[Dict[str, np.ndarray], Optional[float],
+                           Optional[Tuple[str, ...]]]:
         """Wire -> per-example arrays, ON THIS (accept) THREAD. Returns
-        (payload, deadline_ms)."""
+        (payload, deadline_ms, requested output blob names)."""
         hdr_deadline = h.headers.get("X-Deadline-Ms")
         deadline_ms = float(hdr_deadline) if hdr_deadline else None
+        outputs: Optional[Tuple[str, ...]] = None
         if ctype in (NPZ_CONTENT_TYPE, "application/octet-stream"):
-            payload = _decode_npz(body)
+            # npz carries the outputs request as the reserved tensor key
+            payload, outputs = pop_outputs(_decode_npz(body))
         else:
             d = json.loads(body)
             if not isinstance(d, dict) or \
                     not isinstance(d.get("inputs"), dict):
                 raise ValueError(
                     'JSON body must be {"inputs": {<name>: array}, '
-                    '"deadline_ms"?: number}')
+                    '"deadline_ms"?: number, "outputs"?: [names]}')
             if d.get("deadline_ms") is not None:
                 deadline_ms = float(d["deadline_ms"])
+            if d.get("outputs"):
+                outputs = tuple(str(o) for o in d["outputs"])
             payload = {str(k): np.asarray(v)
                        for k, v in d["inputs"].items()}
         # dtype coercion per the net's input schema (JSON numbers land
         # float64/int64; the worker-side stack would cast anyway, but
         # HERE the cast runs on the accept thread)
         self.adapter.coerce(model, payload)
-        return payload, deadline_ms
+        return payload, deadline_ms, outputs
 
     # -- replies -------------------------------------------------------------
 
@@ -685,7 +705,9 @@ def http_infer(base_url: str, model: str,
                deadline_s: Optional[float] = None,
                timeout: float = 30.0,
                tenant: Optional[str] = None,
-               priority: Optional[str] = None) -> Dict[str, np.ndarray]:
+               priority: Optional[str] = None,
+               outputs: Optional[Tuple[str, ...]] = None
+               ) -> Dict[str, np.ndarray]:
     """POST one inference request (npz wire format, keep-alive) and
     return the output arrays. Maps the frontend's shed codes back to the
     serve exceptions, so a remote replica behaves like a local lane.
@@ -706,7 +728,7 @@ def http_infer(base_url: str, model: str,
         headers["X-Tenant"] = tenant
     if priority is not None:
         headers["X-Priority"] = priority
-    body = _encode_npz(payload)
+    body = _encode_npz(encode_outputs(payload, outputs))
     for attempt in (0, 1):
         conn = _connection(host, port, timeout)
         try:

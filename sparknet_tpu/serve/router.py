@@ -647,7 +647,8 @@ class ModelRouter:
         return rep if self._replica_routable(rep) else None
 
     def _issue(self, rep: Replica, model: str, payload: Dict[str, Any],
-               deadline_s: Optional[float]
+               deadline_s: Optional[float],
+               priority: Optional[str] = None
                ) -> Tuple[Future, Callable[[], None]]:
         """Issue one request LEG on a specific replica -> (future,
         cancel_fn). cancel_fn is best-effort and idempotent: locally it
@@ -658,7 +659,8 @@ class ModelRouter:
         resolves the leg future with RequestCancelledError either way,
         which is what the hedge accounting counts."""
         if rep.lane is not None:
-            fut = rep.lane.submit(payload, deadline_s=deadline_s)
+            fut = rep.lane.submit(payload, deadline_s=deadline_s,
+                                  priority=priority)
             lane = rep.lane
             return fut, (lambda: (lane.batcher.cancel(fut), None)[1])
         proxy = self._proxy
@@ -670,7 +672,7 @@ class ModelRouter:
         fut = Future()
         cancel_box: Dict[str, Any] = {}
         proxy.submit(self._proxy_call, rep, model, payload,
-                     deadline_s, fut, False, cancel_box)
+                     deadline_s, fut, False, cancel_box, priority)
 
         def cancel() -> None:
             fn = cancel_box.get("cancel")
@@ -683,6 +685,7 @@ class ModelRouter:
 
     def submit(self, model: str, payload: Dict[str, Any],
                deadline_s: Optional[float] = None,
+               priority: Optional[str] = None,
                _exclude: Optional[Replica] = None) -> Future:
         """Route one request; returns its response future. Raises
         UnknownModelError / NoReplicaError synchronously; QueueFullError
@@ -699,14 +702,19 @@ class ModelRouter:
         future's first-resolution-wins."""
         rep = self._pick(model, exclude=_exclude)
         self._c_routed.inc(model=model, replica=rep.name)
-        fut, cancel = self._issue(rep, model, payload, deadline_s)
+        fut, cancel = self._issue(rep, model, payload, deadline_s,
+                                  priority)
         ret = fut
+        # low-priority (scavenger/batch) requests never hedge: a hedge
+        # duplicates exactly the load the admission stack exists to
+        # shed, and a scavenger's tail is free to be long
         if (self.cfg.hedge and _exclude is None
+                and (priority or "normal").lower() != "low"
                 and len(self.replicas.get(model, ())) >= 2):
             counts = self._hedge_counts.setdefault(model, [0, 0])
             counts[0] += 1
             ret = self._hedge_arm(model, payload, deadline_s, rep,
-                                  fut, cancel)
+                                  fut, cancel, priority)
         t0 = time.perf_counter()
         lat = self._ensure_latency(model)
         ret.add_done_callback(
@@ -718,7 +726,8 @@ class ModelRouter:
 
     def _hedge_arm(self, model: str, payload: Dict[str, Any],
                    deadline_s: Optional[float], rep: Replica,
-                   fut: Future, cancel: Callable[[], None]) -> Future:
+                   fut: Future, cancel: Callable[[], None],
+                   priority: Optional[str] = None) -> Future:
         """Wrap the primary leg in an OUTER future and schedule the
         hedge decision. At fire time (adaptive delay past submit) an
         unanswered request gets a second leg on another replica; the
@@ -770,7 +779,7 @@ class ModelRouter:
                 return  # hedge target draining/down: primary stands alone
             try:
                 fut2, cancel2 = self._issue(rep2, model, payload,
-                                            deadline_s)
+                                            deadline_s, priority)
             except Exception:
                 return  # a refused hedge leg must never hurt the primary
             counts[1] += 1
@@ -846,18 +855,21 @@ class ModelRouter:
                     payload: Dict[str, Any],
                     deadline_s: Optional[float], fut: Future,
                     retried: bool = False,
-                    cancel_box: Optional[Dict[str, Any]] = None) -> None:
+                    cancel_box: Optional[Dict[str, Any]] = None,
+                    priority: Optional[str] = None) -> None:
         try:
             if rep.transport == "binary":
                 from .binary_frontend import binary_infer  # cycle guard
                 out = binary_infer(rep.url, model, payload,
                                    deadline_s=deadline_s,
+                                   priority=priority,
                                    cancel_box=cancel_box,
                                    use_shm=self.cfg.proxy_shm)
             else:
                 from .http_frontend import http_infer  # cycle guard
                 out = http_infer(rep.url, model, payload,
-                                 deadline_s=deadline_s)
+                                 deadline_s=deadline_s,
+                                 priority=priority)
             fut.set_result(out)
         except RequestCancelledError as e:
             fut.set_exception(e)  # a hedge loser's confirmed cancel —
@@ -882,14 +894,16 @@ class ModelRouter:
             self._c_routed.inc(model=model, replica=rep2.name)
             if rep2.lane is not None:
                 try:
-                    f2 = rep2.lane.submit(payload, deadline_s=deadline_s)
+                    f2 = rep2.lane.submit(payload, deadline_s=deadline_s,
+                                          priority=priority)
                 except Exception as e2:
                     fut.set_exception(e2)
                     return
                 f2.add_done_callback(lambda f: self._chain(f, fut))
             else:
                 self._proxy_call(rep2, model, payload, deadline_s, fut,
-                                 retried=True, cancel_box=cancel_box)
+                                 retried=True, cancel_box=cancel_box,
+                                 priority=priority)
         except Exception as e:
             fut.set_exception(e)
 

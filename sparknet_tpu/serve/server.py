@@ -104,6 +104,40 @@ def parity_batch(net, n: int, seed: int = 0) -> Dict[str, np.ndarray]:
     return out
 
 
+# Reserved payload key carrying a request's named output blobs across
+# transports that only speak tensors (the binary wire, npz POST bodies).
+# Encoded as a uint8 view of the comma-joined names so it rides the
+# existing frame format — no wire VERSION bump, and a proxy hop that
+# doesn't understand it forwards it untouched (the terminal frontend
+# pops it before the tensors reach the net).
+OUTPUTS_KEY = "__outputs__"
+
+
+def encode_outputs(payload: Dict[str, Any],
+                   outputs: Optional[Tuple[str, ...]]) -> Dict[str, Any]:
+    """Return payload with the outputs request folded in as a tensor
+    field (no-op when outputs is empty). Does not mutate the input."""
+    if not outputs:
+        return payload
+    names = ",".join(outputs)
+    out = dict(payload)
+    out[OUTPUTS_KEY] = np.frombuffer(names.encode("utf-8"), dtype=np.uint8)
+    return out
+
+
+def pop_outputs(payload: Dict[str, Any]) -> Tuple[Dict[str, Any],
+                                                  Optional[Tuple[str, ...]]]:
+    """Split a payload into (tensors, requested output names). The
+    inverse of encode_outputs; payloads without the key pass through."""
+    if OUTPUTS_KEY not in payload:
+        return payload, None
+    out = dict(payload)
+    raw = np.asarray(out.pop(OUTPUTS_KEY), dtype=np.uint8)
+    names = raw.tobytes().decode("utf-8", errors="replace")
+    parsed = tuple(n for n in (s.strip() for s in names.split(",")) if n)
+    return out, (parsed or None)
+
+
 def default_buckets(max_batch: int) -> Tuple[int, ...]:
     """Powers of two up to max_batch (max_batch itself always included)."""
     out = []
@@ -333,13 +367,42 @@ class InferenceServer:
     # -- client API ----------------------------------------------------------
 
     def submit(self, payload: Dict[str, Any],
-               deadline_s: Optional[float] = None):
+               deadline_s: Optional[float] = None,
+               priority: Optional[str] = None,
+               outputs: Optional[Tuple[str, ...]] = None):
         """Enqueue one example (dict of per-example arrays); returns a
         Future resolving to {blob name: per-example array}. `deadline_s`
         threads the client's answer-by bound into batch formation: an
         expired request is shed (DeadlineExpiredError) instead of
-        occupying a bucket slot."""
-        return self.batcher.submit(payload, deadline_s=deadline_s)
+        occupying a bucket slot. `outputs` names the blobs THIS request
+        wants (the featurizer's embedding route) — validated here
+        against the net's blob table because the forward's name filter
+        silently drops unknowns, and a typo should be a loud error, not
+        an empty response. `priority` tags the queued request so fleet
+        signals can tell scavenger backlog from online demand."""
+        payload, inline = pop_outputs(payload)
+        if outputs is None:
+            outputs = inline
+        if outputs:
+            known = self._known_blobs()
+            if known is not None:
+                bad = [o for o in outputs if o not in known]
+                if bad:
+                    raise ValueError(
+                        f"unknown output blob(s) {bad!r} "
+                        f"(net has {sorted(known)})")
+        return self.batcher.submit(payload, deadline_s=deadline_s,
+                                   priority=priority, outputs=outputs)
+
+    def _known_blobs(self) -> Optional[set]:
+        """The net's nameable blobs, or None when the backend can't
+        enumerate them (then unknown names fall back to the forward's
+        silent-drop behavior)."""
+        inner = getattr(self.net, "net", None)
+        shapes = getattr(inner, "blob_shapes", None)
+        if isinstance(shapes, dict) and shapes:
+            return set(shapes)
+        return None
 
     def infer(self, payload: Dict[str, Any], timeout: float = 30.0
               ) -> Dict[str, np.ndarray]:
@@ -648,10 +711,18 @@ class InferenceServer:
             r.future._spkn_queue_wait_s = t_form - r.t_enqueue
         try:
             full = self._bucket_batch(reqs, bucket)
+            # per-request named blobs (the featurizer route) widen the
+            # forward's fetch set; each request still receives only the
+            # names IT asked for below
+            extra = set()
+            for r in reqs:
+                if r.outputs:
+                    extra.update(r.outputs)
             t_fwd0 = time.perf_counter()
             with track_compiles() as tc:
                 out = self.net.forward(
-                    full, blob_names=list(self.cfg.outputs or ()))
+                    full,
+                    blob_names=list(set(self.cfg.outputs or ()) | extra))
             if bucket not in self._compiled_buckets:
                 # this forward traced+compiled the bucket's executable;
                 # cache_hit says whether the persistent compile cache
@@ -673,14 +744,19 @@ class InferenceServer:
             # clients should not need ml_dtypes to read a probability
             fields = [(k, self._wire_dtype(v), getattr(v, "ndim", 0) >= 1
                        and v.shape[0] == bucket)
-                      for k, v in out.items()
-                      if want is None or k in want]
-            if want is None:
-                fields = [f for f in fields if f[2]]
+                      for k, v in out.items()]
+            # lane defaults: cfg.outputs if configured, else every
+            # per-row blob — exactly the pre-outputs-route contract
+            if want is not None:
+                default = [f for f in fields if f[0] in want]
+            else:
+                default = [f for f in fields if f[2]]
             now = time.perf_counter()
             for i, r in enumerate(reqs):
+                sel = ([f for f in fields if f[0] in r.outputs]
+                       if r.outputs else default)
                 r.future.set_result({k: (v[i] if per_row else v)
-                                     for k, v, per_row in fields})
+                                     for k, v, per_row in sel})
                 self.latency.add(now - r.t_enqueue)
             self.requests_ok += n
             self._c_requests.inc(n, model=self.model_name, outcome="ok")

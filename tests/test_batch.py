@@ -1,0 +1,603 @@
+"""Bulk inference at fleet scale (r14) — the `sparknet_tpu.batch`
+subsystem and its serve/fleet satellites:
+
+  - work-unit planning + the resumable manifest (manifest-LAST commit
+    semantics, resume-identity pins);
+  - the batch object-store surface (atomic local writes, temp files
+    invisible to listings);
+  - the per-request named-output route on BOTH frontends (and through
+    the router's proxy hop), unknown blobs rejected TYPED;
+  - journal rows carry priority + deadline_ms on both frontends;
+  - hedging skips the low class (hedged_total flat under a low flood);
+  - admission's batch-starvation clock + the policy's scavenger
+    signals (low backlog is not online demand; relief bounds
+    starvation);
+  - the driver end-to-end: resume exactly-once, a dead replica is a
+    retry (not a job failure), kill -9 chaos against local and
+    fake-gs:// output stores.
+
+Tier-1: CPU backend, lenet shapes, ephemeral ports.
+"""
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.batch import (BatchConfig, BatchDriver, load_manifest,
+                                manifest as mf, store)
+from sparknet_tpu.net_api import JaxNet
+from sparknet_tpu.serve import (BinaryClient, BinaryFrontend,
+                                HttpFrontend, InferenceServer,
+                                ModelRouter, PriorityAdmission,
+                                RouterConfig, ServeConfig, binary_infer,
+                                http_infer)
+from sparknet_tpu.serve.http_frontend import (NPZ_CONTENT_TYPE,
+                                              _encode_npz)
+from sparknet_tpu.utils.logger import Logger
+from sparknet_tpu.zoo import lenet
+
+from fake_stores import bucket_store
+
+
+def _example(i: int) -> dict:
+    r = np.random.default_rng(9000 + i)
+    return {"data": r.standard_normal((28, 28, 1)).astype(np.float32)}
+
+
+def _input_npz(path, n: int) -> str:
+    r = np.random.default_rng(7)
+    np.savez(str(path),
+             data=r.standard_normal((n, 28, 28, 1)).astype(np.float32))
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One lenet replica behind both front doors, shared per module."""
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, buckets=(1, 4),
+                      outputs=("prob",), metrics_every_batches=0)
+    srv = InferenceServer(JaxNet(lenet(batch=4)), cfg)
+    srv.start()
+    bfe = BinaryFrontend(srv, port=0)
+    hfe = HttpFrontend(srv, port=0)
+    yield srv, bfe, hfe
+    bfe.stop()
+    hfe.stop()
+    srv.stop()
+
+
+# -- manifest -----------------------------------------------------------------
+
+def test_plan_units_disjoint_cover_ragged():
+    units = mf.plan_units(20, 6)
+    assert units == [(0, 6), (6, 12), (12, 18), (18, 20)]
+    assert units[-1][1] - units[-1][0] == 2  # ragged tail kept
+    with pytest.raises(ValueError):
+        mf.plan_units(0, 6)
+    with pytest.raises(ValueError):
+        mf.plan_units(6, 0)
+
+
+def test_manifest_roundtrip_pending_and_done(tmp_path):
+    m = mf.new_manifest("j1", "in.npz", 20, 6, "m", ("fc1",))
+    assert m["n_units"] == 4 and not m["done"]
+    assert [(u, lo, hi) for u, lo, hi in mf.pending_units(m)] == \
+        [(0, 0, 6), (1, 6, 12), (2, 12, 18), (3, 18, 20)]
+    mf.record_unit(m, 1, 6, 12, 123, "r1", 1)
+    assert not m["done"]
+    assert [u for u, _, _ in mf.pending_units(m)] == [0, 2, 3]
+    for uid, lo, hi in mf.pending_units(m):
+        mf.record_unit(m, uid, lo, hi, 1, "r1", 1)
+    assert m["done"]
+    mf.save_manifest(str(tmp_path), m)
+    m2 = mf.load_manifest(str(tmp_path))
+    assert m2 == m
+    assert mf.load_manifest(str(tmp_path / "nowhere")) is None
+
+
+def test_manifest_resume_identity_pinned(tmp_path):
+    """A resume against a different input/plan/model/outputs must fail
+    loudly — silently interleaving two jobs' rows under one manifest is
+    exactly the bug the identity fields exist to stop."""
+    m = mf.new_manifest("j1", "in.npz", 20, 6, "m", ("fc1",))
+    mf.check_resume(m, "in.npz", 20, 6, "m", ("fc1",))  # same job: fine
+    for bad in (("OTHER.npz", 20, 6, "m", ("fc1",)),
+                ("in.npz", 21, 6, "m", ("fc1",)),
+                ("in.npz", 20, 7, "m", ("fc1",)),
+                ("in.npz", 20, 6, "m2", ("fc1",)),
+                ("in.npz", 20, 6, "m", ("fc2",))):
+        with pytest.raises(ValueError, match="resume"):
+            mf.check_resume(m, *bad)
+
+
+def test_manifest_version_pinned(tmp_path):
+    store.write_bytes(str(tmp_path / mf.MANIFEST_NAME),
+                      json.dumps({"version": 999}).encode())
+    with pytest.raises(ValueError, match="version"):
+        mf.load_manifest(str(tmp_path))
+
+
+# -- store --------------------------------------------------------------------
+
+def test_store_local_roundtrip_and_tmp_invisible(tmp_path):
+    url = str(tmp_path / "a" / "b.bin")
+    assert not store.exists(url)
+    store.write_bytes(url, b"xyz")
+    assert store.exists(url) and store.read_bytes(url) == b"xyz"
+    # an interrupted writer's temp file never appears in listings
+    (tmp_path / "a" / ".tmp-torn").write_bytes(b"partial")
+    assert store.list_names(str(tmp_path / "a")) == ["b.bin"]
+    store.delete(url)
+    store.delete(url)  # idempotent
+    assert not store.exists(url)
+    assert store.list_names(str(tmp_path / "missing")) == []
+
+
+def test_store_gs_roundtrip():
+    with bucket_store("gs") as (root, _srv):
+        url = store.join(root, "job", "part-00000.npz")
+        assert store.is_bucket(url)
+        assert not store.exists(url)
+        store.write_bytes(url, b"npzbytes")
+        assert store.exists(url)
+        assert store.read_bytes(url) == b"npzbytes"
+        assert store.list_names(store.join(root, "job")) == \
+            ["part-00000.npz"]
+
+
+# -- the named-output route ---------------------------------------------------
+
+def test_outputs_route_parity_both_frontends(served):
+    """Request fc1 by name over BOTH wires: each returns exactly that
+    blob, bitwise equal (same replica, same bucket, raw f32 both
+    ways); no outputs = the lane's configured default."""
+    srv, bfe, hfe = served
+    x = _example(0)
+    hurl = f"http://{hfe.address[0]}:{hfe.address[1]}"
+    out_b = binary_infer(bfe.address, "default", x, deadline_s=30.0,
+                         outputs=("fc1",))
+    out_h = http_infer(hurl, "default", x, deadline_s=30.0,
+                       outputs=("fc1",))
+    assert set(out_b) == set(out_h) == {"fc1"}
+    np.testing.assert_array_equal(out_b["fc1"], out_h["fc1"])
+    assert set(binary_infer(bfe.address, "default", x,
+                            deadline_s=30.0)) == {"prob"}
+
+
+def test_outputs_route_json_body(served):
+    """The JSON data plane names blobs via an `outputs` list."""
+    _, _, hfe = served
+    x = _example(1)
+    conn = http.client.HTTPConnection(*hfe.address, timeout=30)
+    conn.request("POST", "/v1/models/default/infer",
+                 body=json.dumps({
+                     "inputs": {"data": x["data"].tolist()},
+                     "outputs": ["fc2", "prob"]}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    conn.close()
+    assert resp.status == 200, body
+    assert set(body["outputs"]) == {"fc2", "prob"}
+
+
+def test_unknown_output_blob_rejected_typed(served):
+    """An unknown blob name must be a TYPED 400 at submit, not rows
+    silently missing from the reply (net.forward drops unknown names)."""
+    srv, bfe, hfe = served
+    x = _example(2)
+    with pytest.raises(ValueError, match="unknown output blob"):
+        binary_infer(bfe.address, "default", x, deadline_s=30.0,
+                     outputs=("not_a_blob",))
+    conn = http.client.HTTPConnection(*hfe.address, timeout=30)
+    conn.request("POST", "/v1/models/default/infer",
+                 body=json.dumps({"inputs": {"data": x["data"].tolist()},
+                                  "outputs": ["not_a_blob"]}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    conn.close()
+    assert resp.status == 400
+    assert body["error_kind"] == "bad_request"
+    assert "not_a_blob" in body["error"]
+
+
+def test_outputs_route_through_router_proxy_hop(served):
+    """The outputs selection rides the payload through the router's
+    proxy hop untouched and is honored by the TERMINAL lane."""
+    _, bfe, _ = served
+    router = ModelRouter(RouterConfig(workers=2))
+    router.add_remote_replica("default",
+                              f"spkn://127.0.0.1:{bfe.address[1]}")
+    router.start()
+    rfe = BinaryFrontend(router, port=0)
+    try:
+        out = binary_infer(rfe.address, "default", _example(3),
+                           deadline_s=30.0, outputs=("fc1", "prob"))
+        assert set(out) == {"fc1", "prob"}
+    finally:
+        rfe.stop()
+        router.stop()
+
+
+# -- journal rows carry the admission identity (satellite pin) ----------------
+
+def test_journal_rows_pin_priority_and_deadline(served, tmp_path):
+    srv, _, _ = served
+    jpath = tmp_path / "journal.jsonl"
+    journal = Logger(jsonl_path=str(jpath), echo=False)
+    bfe = BinaryFrontend(srv, port=0, journal=journal)
+    hfe = HttpFrontend(srv, port=0, journal=journal)
+    try:
+        binary_infer(bfe.address, "default", _example(4),
+                     deadline_s=2.5, tenant="batch", priority="low")
+        conn = http.client.HTTPConnection(*hfe.address, timeout=30)
+        conn.request("POST", "/v1/models/default/infer",
+                     body=_encode_npz(_example(5)),
+                     headers={"Content-Type": NPZ_CONTENT_TYPE,
+                              "Accept": NPZ_CONTENT_TYPE,
+                              "X-Priority": "low",
+                              "X-Deadline-Ms": "2500"})
+        conn.getresponse().read()
+        conn.close()
+    finally:
+        bfe.stop()
+        hfe.stop()
+        journal.close()
+    rows = [json.loads(l) for l in
+            jpath.read_text().strip().splitlines()]
+    by_transport = {r["transport"]: r for r in rows}
+    assert set(by_transport) == {"binary", "http"}
+    for r in by_transport.values():
+        assert r["priority"] == "low"
+        assert r["deadline_ms"] == pytest.approx(2500.0)
+
+
+# -- hedging skips the scavenger class (satellite pin) ------------------------
+
+def test_hedge_skips_low_priority():
+    """Under a config where NORMAL traffic hedges on nearly every
+    request (min-delay 0, budget 1.0), a low-priority flood must leave
+    hedged_total flat: a scavenger's latency is not worth a second
+    replica's cycles."""
+    reps = []
+    for _ in range(2):
+        cfg = ServeConfig(model_name="m", max_batch=4, max_wait_ms=2.0,
+                          outputs=("prob",), metrics_every_batches=0)
+        s = InferenceServer(JaxNet(lenet(batch=4)), cfg)
+        s.start()
+        reps.append((s, BinaryFrontend(s, port=0)))
+    router = ModelRouter(RouterConfig(workers=4, hedge=True,
+                                      hedge_min_delay_ms=0.0,
+                                      hedge_budget=1.0))
+    for _, fe in reps:
+        router.add_remote_replica(
+            "m", f"spkn://127.0.0.1:{fe.address[1]}")
+    router.start()
+    try:
+        # positive control first, on the FRESH router (empty latency
+        # window -> hedge delay 0): normal traffic hedges, so a flat
+        # counter below means the skip, not broken hedging
+        futs = [router.submit("m", _example(i), deadline_s=30.0)
+                for i in range(16)]
+        for f in futs:
+            f.result(timeout=30.0)
+        hedged_before = router.status()["hedging"]["m"]["hedged"]
+        assert hedged_before > 0
+        # now the scavenger flood: hedged_total stays flat
+        futs = [router.submit("m", _example(i), deadline_s=30.0,
+                              priority="low") for i in range(16)]
+        for f in futs:
+            f.result(timeout=30.0)
+        assert router.status()["hedging"]["m"]["hedged"] == \
+            hedged_before
+    finally:
+        router.stop()
+        for s, fe in reps:
+            fe.stop()
+            s.stop()
+
+
+# -- admission starvation clock + policy scavenger signals --------------------
+
+def test_admission_batch_starvation_clock():
+    adm = PriorityAdmission()
+    assert adm.starvation_s() == 0.0
+    adm.set_pressure(0.9)
+    assert adm.admit(None, "low") == "priority"
+    time.sleep(0.05)
+    s1 = adm.starvation_s()
+    assert s1 >= 0.05
+    assert adm.admit(None, "low") == "priority"
+    assert adm.starvation_s() >= s1  # one clock, not reset per shed
+    assert adm.status()["batch_starvation_s"] >= 0.05
+    adm.set_pressure(0.0)
+    assert adm.admit(None, "low") is None  # admitted: clock resets
+    assert adm.starvation_s() == 0.0
+
+
+def test_admission_high_sheds_do_not_start_the_clock():
+    adm = PriorityAdmission()
+    adm.set_pressure(1.0)  # everything below 'high' sheds
+    assert adm.admit(None, "normal") == "priority"
+    assert adm.starvation_s() == 0.0  # the clock is the LOW class's
+
+
+def test_policy_low_queue_is_not_online_demand():
+    from sparknet_tpu.fleet import FleetPolicy
+    from sparknet_tpu.fleet.policy import ModelSignals
+
+    pol = FleetPolicy()
+
+    def sig(queue_frac, low_frac):
+        return ModelSignals(model="m", p99_ms=None, slo_p99_ms=None,
+                            n_window=0, queue_frac=queue_frac,
+                            shed_per_s=0.0, replicas=1, routable=1,
+                            low_queue_frac=low_frac)
+    # a queue FULL of scavenger units: not hot, still cold
+    assert pol.hot_reason(sig(0.9, 0.9)) is None
+    assert pol.is_cold(sig(0.9, 0.9))
+    # the same depth of online work: hot, not cold
+    assert pol.hot_reason(sig(0.9, 0.0)) == "queue"
+    assert not pol.is_cold(sig(0.9, 0.0))
+
+
+def test_policy_batch_relief_bounds_starvation():
+    from sparknet_tpu.fleet import FleetPolicy
+
+    pol = FleetPolicy(batch_max_starvation_s=5.0,
+                      batch_relief_pressure=0.45)
+    assert not pol.batch_relief(4.9, 0.9)    # not starved long enough
+    assert not pol.batch_relief(60.0, 0.45)  # pressure already at/below
+    assert pol.batch_relief(5.0, 0.9)        # starved + door shut
+    with pytest.raises(ValueError):
+        FleetPolicy(batch_max_starvation_s=0.0)
+    with pytest.raises(ValueError):
+        FleetPolicy(batch_relief_pressure=1.0)
+
+
+# -- the driver ---------------------------------------------------------------
+
+def _job_cfg(inp, out, addrs, **kw):
+    base = dict(input=str(inp), output=str(out),
+                replicas=list(addrs), outputs=("fc1",), unit_rows=6,
+                window=4, concurrency=2, deadline_s=30.0,
+                request_timeout_s=60.0)
+    base.update(kw)
+    return BatchConfig(**base)
+
+
+def _assert_exactly_once(out_dir, n_rows, unit_rows, blob="fc1"):
+    """The committed artifacts ARE the exactly-once proof: manifest
+    ranges equal the plan (disjoint, covering), each part holds exactly
+    its unit's rows."""
+    m = load_manifest(str(out_dir))
+    assert m is not None and m["done"]
+    plan = mf.plan_units(n_rows, unit_rows)
+    got = sorted((u["start"], u["stop"]) for u in m["units"].values())
+    assert got == sorted(plan)
+    total = 0
+    for uid_s, u in m["units"].items():
+        with np.load(os.path.join(str(out_dir),
+                                  mf.part_name(int(uid_s)))) as z:
+            assert z[blob].shape[0] == u["rows"]
+            total += z[blob].shape[0]
+    assert total == n_rows
+
+
+def test_driver_end_to_end_and_resume(served, tmp_path):
+    _, bfe, _ = served
+    addr = f"{bfe.address[0]}:{bfe.address[1]}"
+    inp = _input_npz(tmp_path / "in.npz", 20)
+    out = tmp_path / "out"
+    res = BatchDriver(_job_cfg(inp, out, [addr])).run()
+    assert res["done"] and res["units_this_run"] == 4
+    assert res["rows_this_run"] == 20 and res["rows_per_s"] > 0
+    _assert_exactly_once(out, 20, 6)
+    # rerun on a done job: nothing recomputed
+    res2 = BatchDriver(_job_cfg(inp, out, [addr])).run()
+    assert res2["units_this_run"] == 0
+    assert res2["units_skipped_resume"] == 4
+    # an orphan part (crash between part write and manifest row) is
+    # redone: drop a unit from the manifest but leave its part behind
+    m = load_manifest(str(out))
+    del m["units"]["2"]
+    m["done"] = False
+    mf.save_manifest(str(out), m)
+    res3 = BatchDriver(_job_cfg(inp, out, [addr])).run()
+    assert res3["units_this_run"] == 1 and res3["done"]
+    _assert_exactly_once(out, 20, 6)
+
+
+def test_driver_resume_identity_mismatch_fails_loudly(served, tmp_path):
+    _, bfe, _ = served
+    addr = f"{bfe.address[0]}:{bfe.address[1]}"
+    inp = _input_npz(tmp_path / "in.npz", 12)
+    out = tmp_path / "out"
+    BatchDriver(_job_cfg(inp, out, [addr], unit_rows=6)).run()
+    with pytest.raises(ValueError, match="resume"):
+        BatchDriver(_job_cfg(inp, out, [addr], unit_rows=4)).run()
+
+
+def test_driver_cost_and_metrics_accounting(served, tmp_path):
+    _, bfe, _ = served
+    addr = f"{bfe.address[0]}:{bfe.address[1]}"
+    inp = _input_npz(tmp_path / "in.npz", 12)
+    drv = BatchDriver(_job_cfg(inp, tmp_path / "out", [addr],
+                               cost_per_replica_hour=3.6))
+    res = drv.run()
+    # summary fields are rounded independently; pin consistency, not
+    # the exact float
+    assert res["cost_usd"] > 0
+    assert res["cost_per_million_embeddings"] == pytest.approx(
+        res["cost_usd"] / (12 / 1e6), rel=2e-2)
+    reg = drv.registry
+    assert reg.counter("sparknet_batch_units_done_total").value() == 2
+    assert reg.counter("sparknet_batch_rows_total").value() == 12
+    assert reg.counter(
+        "sparknet_batch_output_bytes_total").value() == \
+        res["output_bytes"] > 0
+
+
+def test_driver_dead_replica_is_a_retry_not_a_job_failure(
+        served, tmp_path):
+    """One of the two 'replicas' is a dead port: every unit that
+    rotates onto it takes a typed hard retry and completes on the
+    living one — the fleet contract, without a subprocess."""
+    _, bfe, _ = served
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()  # nothing listens here now
+    addr = f"{bfe.address[0]}:{bfe.address[1]}"
+    inp = _input_npz(tmp_path / "in.npz", 24)
+    drv = BatchDriver(_job_cfg(inp, tmp_path / "out", [dead, addr],
+                               backoff_cap_s=0.05))
+    res = drv.run()
+    assert res["done"]
+    assert res["retries"] > 0
+    assert int(drv._c_retries.value(kind="error") or 0) > 0
+    _assert_exactly_once(tmp_path / "out", 24, 6)
+
+
+def test_driver_all_replicas_dead_fails_named(tmp_path):
+    from sparknet_tpu.batch.driver import UnitFailedError
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    inp = _input_npz(tmp_path / "in.npz", 6)
+    with pytest.raises(UnitFailedError, match="hard failures"):
+        BatchDriver(_job_cfg(inp, tmp_path / "out", [dead],
+                             max_attempts=2, backoff_cap_s=0.01)).run()
+
+
+def test_driver_rejects_bad_config():
+    with pytest.raises(ValueError):
+        BatchConfig(input="x", output="y", replicas=[])
+    with pytest.raises(ValueError):
+        BatchConfig(input="x", output="y", replicas=["a:1"],
+                    unit_rows=0)
+    with pytest.raises(ValueError):
+        BatchConfig(input="x", output="y", replicas=["a:1"],
+                    max_attempts=0)
+
+
+# -- kill -9 chaos ------------------------------------------------------------
+
+def _spawn_driver(inp, out, addrs, extra=()):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "sparknet_tpu.batch.driver",
+         "--input", str(inp), "--out", str(out),
+         "--replicas", ",".join(addrs), "--outputs", "fc1",
+         "--unit-rows", "6", "--window", "4", "--concurrency", "1",
+         "--pace-s", "0.25", "--timeout-s", "60",
+         "--deadline-ms", "30000", *extra],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+
+
+def _kill_mid_job(proc, out_dir, min_units=1):
+    """Wait for >= min_units committed units, then SIGKILL."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 120.0:
+        if proc.poll() is not None:
+            pytest.fail("driver exited before the kill window")
+        m = load_manifest(str(out_dir))
+        if m is not None and len(m["units"]) >= min_units:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("driver never committed a unit to kill against")
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=30.0)
+
+
+@pytest.mark.chaos
+def test_driver_kill9_resumes_exactly_once_local(served, tmp_path):
+    _, bfe, _ = served
+    addr = f"{bfe.address[0]}:{bfe.address[1]}"
+    inp = _input_npz(tmp_path / "in.npz", 48)
+    out = tmp_path / "out"
+    proc = _spawn_driver(inp, out, [addr])
+    _kill_mid_job(proc, out)
+    partial = load_manifest(str(out))
+    assert partial is not None and not partial["done"]
+    done_before = len(partial["units"])
+    assert 0 < done_before < partial["n_units"]
+    res = BatchDriver(_job_cfg(inp, out, [addr])).run()
+    assert res["done"]
+    assert res["units_skipped_resume"] == done_before
+    assert res["units_this_run"] == partial["n_units"] - done_before
+    _assert_exactly_once(out, 48, 6)
+
+
+@pytest.mark.chaos
+def test_driver_kill9_resumes_exactly_once_fake_gs(served, tmp_path):
+    """Same kill -9 contract with the output shards and manifest living
+    in a (fake) gs:// bucket: bucket objects finalize atomically, so
+    manifest-last holds there too. The killed subprocess inherits the
+    emulator env; the resuming in-process driver shares it."""
+    _, bfe, _ = served
+    addr = f"{bfe.address[0]}:{bfe.address[1]}"
+    inp = _input_npz(tmp_path / "in.npz", 48)
+    with bucket_store("gs") as (root, _srv):
+        out = store.join(root, "job-kill")
+        proc = _spawn_driver(inp, out, [addr])
+        _kill_mid_job(proc, out)
+        partial = load_manifest(out)
+        assert partial is not None and not partial["done"]
+        done_before = len(partial["units"])
+        assert 0 < done_before < partial["n_units"]
+        res = BatchDriver(_job_cfg(inp, out, [addr])).run()
+        assert res["done"]
+        assert res["units_skipped_resume"] == done_before
+        m = load_manifest(out)
+        got = sorted((u["start"], u["stop"])
+                     for u in m["units"].values())
+        assert got == sorted(mf.plan_units(48, 6))
+        names = store.list_names(out)
+        assert set(names) == {mf.MANIFEST_NAME} | {
+            mf.part_name(u) for u in range(m["n_units"])}
+
+
+# -- the metrics summary's batch view -----------------------------------------
+
+def test_summary_batch_view():
+    from sparknet_tpu.obs.summary import format_text, summarize
+
+    recs = [
+        {"step": 0, "event": "batch_unit", "unit": 0, "rows": 6,
+         "replica": "a:1", "attempts": 1, "bytes": 100, "dt_s": 0.1},
+        {"step": 1, "event": "batch_unit", "unit": 1, "rows": 6,
+         "replica": "b:2", "attempts": 2, "bytes": 100, "dt_s": 0.2},
+        {"step": 1, "event": "batch_retry", "unit": 1, "kind": "shed",
+         "replica": "a:1", "attempt": 1, "error": "PriorityShedError"},
+        {"step": 2, "event": "batch_done", "job_id": "j", "done": True,
+         "units_total": 2, "units_done": 2, "rows_total": 12,
+         "elapsed_s": 0.3, "rows_per_s": 40.0, "retries": 1,
+         "cost_per_million_embeddings": 1.5},
+    ]
+    s = summarize(recs)
+    b = s["batch"]
+    assert b["units"] == 2 and b["rows"] == 12
+    assert b["retries_by_kind"] == {"shed": 1}
+    assert b["units_by_replica"] == {"a:1": 1, "b:2": 1}
+    assert b["attempts_max"] == 2
+    assert b["jobs"]["j"]["done"] and \
+        b["jobs"]["j"]["cost_per_million_embeddings"] == 1.5
+    text = format_text(s)
+    assert "batch view" in text and "$1.5/M embeddings" in text
+    assert "batch" not in summarize([{"step": 0, "loss": 1.0}])
