@@ -2579,10 +2579,18 @@ def obs_bench(out_path: str | None = "BENCH_OBS.json", rounds: int = 40,
     fully on (per-run registry + per-round step-time breakdown rows +
     host-span tracing + a live /metrics status server being scraped +
     since the pod PR: device telemetry sampling, per-worker pod
-    heartbeats, and a live PodAggregator endpoint being polled) vs
-    telemetry disabled (`RunConfig.telemetry=False`, no trace, no status
-    server — the pre-obs loop). Headline: median steady-state per-round
-    overhead, acceptance target <= 2%.
+    heartbeats, and a live PodAggregator endpoint being polled, and
+    since the request-tracing PR: a live RequestTracer sharding to disk)
+    vs telemetry disabled (`RunConfig.telemetry=False`, no trace, no
+    status server — the pre-obs loop). Headline: median steady-state
+    per-round overhead, acceptance target <= 2%.
+
+    A second arm measures the request-tracing hot path where it
+    actually lives — the serve data plane: per-request latency over the
+    binary wire with tracing OFF vs ON at head_sample=1.0 (every
+    request captured — the worst case; production tail-sampling
+    captures ~1-5%). Reported as `reqtrace_per_request` in
+    BENCH_OBS.json.
 
     CPU backend, lenet shapes: rounds are a few ms, which makes this a
     WORST-CASE ratio — the fixed per-round telemetry cost is divided by
@@ -2598,7 +2606,7 @@ def obs_bench(out_path: str | None = "BENCH_OBS.json", rounds: int = 40,
 
     from sparknet_tpu.apps.train_loop import train
     from sparknet_tpu.data.dataset import ArrayDataset
-    from sparknet_tpu.obs import run_metadata
+    from sparknet_tpu.obs import reqtrace, run_metadata
     from sparknet_tpu.utils.config import RunConfig
     from sparknet_tpu.utils.logger import Logger
     from sparknet_tpu.zoo import lenet
@@ -2660,16 +2668,73 @@ def obs_bench(out_path: str | None = "BENCH_OBS.json", rounds: int = 40,
 
         log = Logger(os.path.join(root, "log.txt"), echo=False,
                      jsonl_path=os.path.join(root, "metrics.jsonl"))
+        if telemetry:
+            # the on arm carries a LIVE RequestTracer (sharding to disk)
+            # so "telemetry fully on" includes the request-trace layer's
+            # ambient cost
+            reqtrace.start_request_tracing(
+                out_dir=os.path.join(root, "reqtrace"))
         try:
             train(cfg, lenet(batch=b), ds, None, logger=log,
                   round_hook=hook)
         finally:
             stop.set()
             log.close()
+            if telemetry:
+                tr = reqtrace.stop_request_tracing()
+                if tr is not None:
+                    tr.flush()
             if scraper is not None:
                 scraper.join(timeout=2.0)
         deltas = [b_ - a for a, b_ in zip(marks[warmup:], marks[warmup + 1:])]
         return statistics.median(deltas)
+
+    def serve_arm(tracing: bool, n: int = 300, req_warmup: int = 40
+                  ) -> float:
+        """Median per-request latency over the binary wire, tracing off
+        vs on at head_sample=1.0 — the request-tracing hot path measured
+        where it runs."""
+        from sparknet_tpu.serve.binary_frontend import (BinaryClient,
+                                                        BinaryFrontend)
+        from sparknet_tpu.serve.server import InferenceServer, ServeConfig
+
+        class Doubler:
+            def input_shapes(self):
+                return {"x": (1, 16)}
+
+            def input_dtypes(self):
+                return {"x": np.float32}
+
+            def forward(self, batch, blob_names=None):
+                return {"y": np.asarray(batch["x"]) * 2.0}
+
+        if tracing:
+            reqtrace.start_request_tracing(head_sample=1.0)
+        lats: list[float] = []
+        try:
+            cfg = ServeConfig(max_batch=8, max_wait_ms=0.2,
+                              buckets=(1, 8), outputs=("y",),
+                              metrics_every_batches=0)
+            payload = {"x": np.ones((16,), np.float32)}
+            with InferenceServer(Doubler(), cfg) as srv:
+                fe = BinaryFrontend(srv, port=0)
+                cli = None
+                try:
+                    host, port = fe.address
+                    cli = BinaryClient(host, port, timeout=10.0)
+                    for i in range(req_warmup + n):
+                        t0 = time.perf_counter()
+                        cli.infer(payload, model="default")
+                        if i >= req_warmup:
+                            lats.append(time.perf_counter() - t0)
+                finally:
+                    if cli is not None:
+                        cli.close()
+                    fe.stop()
+        finally:
+            if tracing:
+                reqtrace.stop_request_tracing()
+        return statistics.median(lats)
 
     # interleave the arms in ABBA order (off,on,on,off) and take the MIN
     # median per arm: on a contended bench host the background load
@@ -2695,6 +2760,19 @@ def obs_bench(out_path: str | None = "BENCH_OBS.json", rounds: int = 40,
                 print(f"  telemetry {'on' if telemetry else 'off'} "
                       f"(rep {rep}): {med * 1e3:.3f} ms/round",
                       file=sys.stderr)
+    # the serve-path arm, same ABBA-and-min discipline
+    rbest = {False: float("inf"), True: float("inf")}
+    for rep in range(2):
+        for tracing in ((False, True) if rep % 2 == 0
+                        else (True, False)):
+            med = serve_arm(tracing)
+            rbest[tracing] = min(rbest[tracing], med)
+            print(f"  reqtrace {'on' if tracing else 'off'} "
+                  f"(rep {rep}): {med * 1e3:.3f} ms/request",
+                  file=sys.stderr)
+    r_off = round(rbest[False] * 1e3, 4)
+    r_on = round(rbest[True] * 1e3, 4)
+    r_overhead = max(r_on / r_off - 1.0, 0.0)
     off = round(best[False] * 1e3, 4)
     on = round(best[True] * 1e3, 4)
     overhead = max(on / off - 1.0, 0.0)
@@ -2702,11 +2780,17 @@ def obs_bench(out_path: str | None = "BENCH_OBS.json", rounds: int = 40,
         "metric": "obs_full_telemetry_per_round_overhead",
         "value": round(overhead, 4),
         "unit": "median per-round overhead, telemetry on vs off "
-                "(registry + breakdown rows + trace + scraped /metrics + "
+                "(registry + breakdown rows + trace + request tracer + "
+                "scraped /metrics + "
                 "device sampling + pod heartbeat/aggregator; "
                 "target <= 0.02)",
         "vs_baseline": round(min(0.02 / max(overhead, 1e-9), 100.0), 2),
         "per_mode": {"off_ms": off, "on_ms": on},
+        "reqtrace_per_request": {
+            "overhead": round(r_overhead, 4),
+            "off_ms": r_off, "on_ms": r_on,
+            "note": "binary-wire request latency, tracing off vs on at "
+                    "head_sample=1.0 (every request captured)"},
     }
     if out_path:
         with open(out_path, "w") as f:

@@ -46,6 +46,7 @@ import numpy as np
 from ..data.dataset import ArrayDataset
 from ..data.gcs import retry_delay
 from ..obs import MetricsRegistry, StatusServer
+from ..obs import reqtrace
 from ..serve.batcher import DeadlineExpiredError, QueueFullError
 from ..serve.binary_frontend import BinaryClient
 from ..utils.heartbeat import HeartbeatWriter
@@ -168,7 +169,8 @@ class BatchDriver:
     # -- one unit ------------------------------------------------------------
 
     def _unit_rows_out(self, cli: BinaryClient, data: ArrayDataset,
-                      lo: int, hi: int) -> Dict[str, np.ndarray]:
+                      lo: int, hi: int,
+                      trace=None) -> Dict[str, np.ndarray]:
         """Dispatch one unit's rows pipelined on one connection; returns
         {blob: (rows, ...) array}. Raises on the FIRST failed row — the
         unit is the retry granule, a half-computed unit is never
@@ -184,7 +186,12 @@ class BatchDriver:
                     payload, model=cfg.model, deadline_s=cfg.deadline_s,
                     tenant=cfg.tenant, priority=cfg.priority,
                     stream=True,
-                    outputs=(cfg.outputs or None)))
+                    outputs=(cfg.outputs or None),
+                    # every row request is a child span of the unit's
+                    # trace — one trace id per work unit, so the
+                    # assembler reconstructs the whole unit's fan-out
+                    trace=(trace.child() if trace is not None
+                           else None)))
                 nexti += 1
             results.append(cli.collect(rids.pop(0),
                                        timeout=cfg.request_timeout_s))
@@ -204,8 +211,16 @@ class BatchDriver:
         cfg = self.cfg
         hard_attempts = 0
         attempt = 0
+        # one trace per work unit (the driver is a front door: it MINTS)
+        rt = reqtrace.active()
+        ctx = rec = None
+        if rt is not None:
+            ctx = rt.mint()
+            rec = rt.begin(ctx, transport="batch", model=cfg.model)
         while True:
             if self._stop.is_set():
+                if rec is not None:
+                    rt.finish(rec, "cancelled")
                 raise UnitFailedError(f"unit {uid}: driver stopping")
             addr = cfg.replicas[(uid + attempt) % len(cfg.replicas)]
             attempt += 1
@@ -215,12 +230,17 @@ class BatchDriver:
                 cli = BinaryClient(host, port,
                                    timeout=cfg.request_timeout_s,
                                    use_shm=cfg.use_shm)
-                out = self._unit_rows_out(cli, data, lo, hi)
+                out = self._unit_rows_out(cli, data, lo, hi, trace=ctx)
                 buf = io.BytesIO()
                 np.savez(buf, **out)
                 raw = buf.getvalue()
                 store.write_bytes(
                     store.join(cfg.output, mf.part_name(uid)), raw)
+                if rec is not None:
+                    rt.stage(ctx, "unit", rec["ts"],
+                             rt.now_us() - rec["ts"], unit=uid,
+                             rows=hi - lo, attempts=attempt)
+                    rt.finish(rec, "ok")
                 return addr, attempt, len(raw)
             except BACKPRESSURE_ERRORS as e:
                 # shed, typed: the fleet is busy — the scavenger waits
@@ -233,6 +253,8 @@ class BatchDriver:
                 hard_attempts += 1
                 self._note_retry("error", uid, addr, attempt, e)
                 if hard_attempts >= cfg.max_attempts:
+                    if rec is not None:
+                        rt.finish_exc(rec, e)
                     raise UnitFailedError(
                         f"unit {uid} rows [{lo}, {hi}): "
                         f"{hard_attempts} hard failures across the "

@@ -33,6 +33,12 @@ Since the pod PR, two more layers sit on top:
 `meta.run_metadata()` stamps artifacts (BENCH_*.json) and the
 `sparknet_build_info` gauge with provenance; `summary` is the
 `sparknet-metrics` JSONL reader.
+
+`reqtrace` is the DISTRIBUTED counterpart of `trace`: per-REQUEST spans
+keyed by a trace context that crosses process boundaries (X-Trace-Id on
+HTTP, the REQUEST-meta trace field on the binary wire), tail-sampled and
+flushed as per-process JSONL shards; `sparknet-trace` assembles the
+shards into one Chrome trace per request.
 """
 from .registry import (DEFAULT_BUCKETS, Metric, MetricsRegistry,
                        default_registry)
@@ -43,6 +49,12 @@ from .trace import (Tracer, active_tracer, span, start_tracing,
 from .device import (DeviceTelemetry, attach_compile_metrics, compile_stats,
                      note_compile, timed_compile)
 from .pod import PodAggregator, WorkerView, flag_stragglers
+# reqtrace LAST: it leans on utils.metrics, which imports obs.trace —
+# importing it earlier would re-enter this package mid-init
+from . import reqtrace
+from .reqtrace import (RequestTracer, TraceContext, mint_context,
+                       parse_context, request_tracing,
+                       start_request_tracing, stop_request_tracing)
 
 __all__ = [
     "DEFAULT_BUCKETS", "Metric", "MetricsRegistry", "default_registry",
@@ -52,4 +64,7 @@ __all__ = [
     "DeviceTelemetry", "attach_compile_metrics", "compile_stats",
     "note_compile", "timed_compile",
     "PodAggregator", "WorkerView", "flag_stragglers",
+    "RequestTracer", "TraceContext", "mint_context", "parse_context",
+    "request_tracing", "start_request_tracing", "stop_request_tracing",
+    "reqtrace",
 ]

@@ -700,6 +700,14 @@ def format_pod_table(status: Dict[str, Any]) -> str:
                            ("swaps", "swaps={}")):
                 if m.get(k) is not None:
                     parts.append(fmt.format(m[k]))
+            # the worst recent captured request (reqtrace exemplar):
+            # duration, dominant stage, and the trace id prefix to feed
+            # straight to `sparknet-trace`
+            sr = m.get("slow_request")
+            if isinstance(sr, dict) and sr.get("ms") is not None:
+                parts.append(
+                    f"slow={sr['ms']}ms@{sr.get('stage', '-')}"
+                    f"[{str(sr.get('trace', ''))[:8]}]")
             lines.append(f"    └ {' '.join(parts)}")
     log = status.get("straggler_log") or []
     if log:

@@ -303,6 +303,18 @@ def main(argv=None) -> None:
                    help="capture host-side spans (serve worker lane: "
                    "forwards, hot swaps) as Chrome-trace-event JSON — "
                    "merges on one timeline with a trainer's --trace-out")
+    p.add_argument("--request-trace", default=None, metavar="DIR",
+                   help="distributed per-REQUEST tracing: capture "
+                   "tail-sampled request spans (admission, queue, batch "
+                   "formation, forward, wire hops) as JSONL shards in "
+                   "DIR; every shed/error and everything beyond the "
+                   "live p95 is kept. Assemble shards from all "
+                   "processes with `sparknet-trace DIR ...`")
+    p.add_argument("--trace-head-sample", type=float, default=0.01,
+                   metavar="P",
+                   help="with --request-trace: ALSO head-sample this "
+                   "fraction of ordinary requests (default 0.01) so "
+                   "healthy-path traces exist to compare tails against")
     p.add_argument("--workdir", default=None,
                    help="log/JSONL directory (default $SPARKNET_TPU_HOME)")
     p.add_argument("--demo", type=int, default=None, metavar="N",
@@ -428,8 +440,14 @@ def main(argv=None) -> None:
         return FleetController(router, provider=provider, cfg=cfg,
                                admission=tenants, logger=log)
 
-    with obs_trace.tracing(args.trace_out) if args.trace_out \
-            else contextlib.nullcontext():
+    with contextlib.ExitStack() as _traces:
+        if args.trace_out:
+            _traces.enter_context(obs_trace.tracing(args.trace_out))
+        if args.request_trace:
+            from ..obs import reqtrace
+            _traces.enter_context(reqtrace.request_tracing(
+                args.request_trace,
+                head_sample=args.trace_head_sample))
         if args.models:
             router = ModelRouter(
                 RouterConfig(workers=args.router_workers,

@@ -89,6 +89,10 @@ class ServeRequest:
     # per-request named output blobs (the featurizer route): None =
     # the lane's configured outputs / default per-row blobs
     outputs: Optional[Tuple[str, ...]] = None
+    # distributed-trace context (obs/reqtrace.TraceContext) riding the
+    # request through batch formation: None = untraced (the common case;
+    # the worker's span emission is gated on this plus one global check)
+    trace: Optional[Any] = None
 
 
 class DynamicBatcher:
@@ -152,7 +156,8 @@ class DynamicBatcher:
     def submit(self, payload: Dict[str, Any],
                deadline_s: Optional[float] = None,
                priority: Optional[str] = None,
-               outputs: Optional[Tuple[str, ...]] = None) -> Future:
+               outputs: Optional[Tuple[str, ...]] = None,
+               trace: Optional[Any] = None) -> Future:
         """Enqueue one request; returns its response future. Raises
         QueueFullError at capacity and RuntimeError after close().
         `deadline_s` (relative seconds) is the client's answer-by bound:
@@ -161,11 +166,13 @@ class DynamicBatcher:
         slot. An ALREADY-expired deadline returns a pre-failed future
         without touching the queue. `priority` tags the queued request
         with its admission class (low-share telemetry); `outputs` pins
-        per-request named blobs for the forming forward."""
+        per-request named blobs for the forming forward; `trace` is the
+        request's distributed-trace context (rides to the worker)."""
         req = ServeRequest(payload={k: np.asarray(v)
                                     for k, v in payload.items()},
                            priority=(priority or "normal"),
-                           outputs=(tuple(outputs) if outputs else None))
+                           outputs=(tuple(outputs) if outputs else None),
+                           trace=trace)
         if deadline_s is not None:
             req.deadline = req.t_enqueue + float(deadline_s)
             if deadline_s <= 0:

@@ -63,6 +63,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import reqtrace
 from ..utils.logger import Logger
 from . import shm, wire
 from .admission import (PriorityShedError, TenantAdmission,
@@ -155,10 +156,12 @@ class _Conn:
         # client hasn't hung up first
         self.reject_until: Optional[float] = None
         # req_id -> (reply bound (monotonic), response future, model,
-        # journal row); popped on completion, or by the reaper (which
-        # answers a timeout frame). The future rides along so a CANCEL
-        # frame can reach the batcher's queue entry for this id.
+        # journal row, trace record); popped on completion, or by the
+        # reaper (which answers a timeout frame). The future rides along
+        # so a CANCEL frame can reach the batcher's queue entry for this
+        # id; the trace record so every terminal path can close it.
         self.inflight: Dict[int, Tuple[float, Any, str,
+                                       Optional[dict],
                                        Optional[dict]]] = {}
         self.copied_pending = 0   # bytes of COPIED (header) data queued
         self.peak_copied = 0      # its high-water mark
@@ -307,14 +310,18 @@ class _IoLoop(threading.Thread):
                 if now >= conn.reject_until:
                     self.close_conn(conn)
                 continue
-            expired: List[Tuple[int, Optional[dict]]] = []
+            expired: List[Tuple[int, Optional[dict],
+                               Optional[dict]]] = []
             with conn.lock:
                 for rid, entry in list(conn.inflight.items()):
                     if now >= entry[0]:
-                        expired.append((rid, entry[3]))
+                        expired.append((rid, entry[3], entry[4]))
                         del conn.inflight[rid]
-            for rid, jinfo in expired:
+            rt = reqtrace.active()
+            for rid, jinfo, rec in expired:
                 self.frontend._journal_row(jinfo, "timeout")
+                if rt is not None and rec is not None:
+                    rt.finish(rec, "timeout")
                 self.frontend._answer_error(
                     conn, rid, wire.ERR_TIMEOUT,
                     "response wait timed out")
@@ -579,16 +586,29 @@ class BinaryFrontend:
                 f"request id {req_id} is already in flight on this "
                 f"connection")
             return
-        jinfo = None
+        jinfo = rec = ctx = None
+        rt = reqtrace.active()
         try:
-            model_s, tenant, priority, deadline_ms, descs, seg = \
-                wire.unpack_request_meta(meta)
+            model_s, tenant, priority, deadline_ms, trace_s, descs, \
+                seg = wire.unpack_request_meta(meta)
+            # propagated context decodes even when THIS process is not
+            # tracing (the journal still correlates); this front door
+            # MINTS one only when tracing is on and none arrived
+            if trace_s:
+                ctx = reqtrace.parse_context(trace_s)
+            if rt is not None:
+                if ctx is None:
+                    ctx = rt.mint()
+                rec = rt.begin(ctx, transport=self.transport,
+                               model=model_s or "")
             if self.journal is not None:
                 jinfo = {"transport": self.transport,
                          "model": model_s or "",
                          "tenant": tenant or "",
                          "priority": priority or "",
                          "deadline_ms": deadline_ms,
+                         "request_id": req_id,
+                         "trace_id": ctx.trace_id if ctx else None,
                          "sizes": {d.name: int(d.nbytes)
                                    for d in descs}}
             # admission runs BEFORE tensor decode / model resolution
@@ -598,9 +618,14 @@ class BinaryFrontend:
             reason = (self.tenants.admit(tenant or None,
                                          priority or None)
                       if self.tenants is not None else None)
+            if rec is not None:
+                rt.stage(ctx, "admission", rec["ts"],
+                         rt.now_us() - rec["ts"])
             if reason is not None:
                 self._c_shed.inc(model=model_s or "", reason=reason)
                 self._journal_row(jinfo, reason)
+                if rec is not None:
+                    rt.finish(rec, reason)
                 self._answer_error(
                     conn, req_id,
                     wire.ERR_TENANT_LIMIT if reason == "tenant_limit"
@@ -609,6 +634,7 @@ class BinaryFrontend:
                     if reason == "tenant_limit" else
                     "shed by priority class under admission pressure")
                 return
+            t_dec = rt.now_us() if rec is not None else 0.0
             if seg is not None:
                 # spkn-shm request: the payload lives in the client's
                 # named segment; map it (cached per connection — the
@@ -635,14 +661,19 @@ class BinaryFrontend:
             inputs, outputs = pop_outputs(inputs)
             model = self.adapter.resolve(model_s or None)
             self.adapter.coerce(model, inputs)
+            if rec is not None:
+                rt.stage(ctx, "decode", t_dec, rt.now_us() - t_dec,
+                         shm=seg is not None)
             deadline_s = (deadline_ms / 1e3 if deadline_ms is not None
                           else self.default_deadline_s)
             fut = self.adapter.submit(model, inputs, deadline_s,
                                       priority=priority or None,
-                                      outputs=outputs)
+                                      outputs=outputs, trace=ctx)
         except BaseException as e:
             ck, msg = _exception_to_err(e)
             self._journal_row(jinfo, ck[1])
+            if rec is not None:
+                rt.finish(rec, ck[1])
             self._answer_error(conn, req_id, ck, msg)
             return
         bound = time.monotonic() + (
@@ -651,7 +682,7 @@ class BinaryFrontend:
         with conn.lock:
             if conn.closed:
                 return
-            conn.inflight[req_id] = (bound, fut, model, jinfo)
+            conn.inflight[req_id] = (bound, fut, model, jinfo, rec)
         fut.add_done_callback(
             lambda f, c=conn, r=req_id, s=stream, m=model:
             self._complete(c, r, s, m, f))
@@ -664,13 +695,18 @@ class BinaryFrontend:
             entry = conn.inflight.pop(req_id, None)
         if entry is None:
             return  # reaped (already answered) or connection gone
-        jinfo = entry[3]
+        jinfo, rec = entry[3], entry[4]
+        rt = reqtrace.active()
         exc = fut.exception()
         if exc is not None:
             ck, msg = _exception_to_err(exc)
             self._journal_row(jinfo, ck[1])
+            if rt is not None and rec is not None:
+                rt.finish(rec, ck[1])
             self._answer_error(conn, req_id, ck, msg)
             return
+        t_reply = rt.now_us() if (rt is not None
+                                  and rec is not None) else 0.0
         # queue wait: stamped on the batcher future at batch formation
         # (server.py) — rides the response meta so clients can split
         # tail latency into queueing vs compute
@@ -700,6 +736,12 @@ class BinaryFrontend:
         self._journal_row(jinfo, "ok", queue_wait_ms=qw_ms)
         self._c_req.inc(code="200", transport=self.transport)
         self._enqueue(conn, items)
+        if rt is not None and rec is not None:
+            # pack + outbox enqueue; the socket write itself is async on
+            # the io thread and belongs to the client's wire span
+            rt.stage(rec["ctx"], "reply", t_reply,
+                     rt.now_us() - t_reply, stream=stream)
+            rt.finish(rec, "ok")
 
     def _journal_row(self, jinfo: Optional[dict], outcome: str,
                      queue_wait_ms: Optional[float] = None) -> None:
@@ -869,8 +911,15 @@ class BinaryClient:
                tenant: Optional[str] = None,
                priority: Optional[str] = None,
                stream: bool = False,
-               outputs: Optional[Tuple[str, ...]] = None) -> int:
+               outputs: Optional[Tuple[str, ...]] = None,
+               trace=None) -> int:
         rid = next(self._ids)
+        # trace context: accepted as a TraceContext or its encoded wire
+        # string; rides the REQUEST meta, and the local tracer (when on)
+        # records this client's wait as the `wire:binary` span that
+        # assembly matches against the server's request row
+        ctx = reqtrace.parse_context(trace) if trace is not None else None
+        rt = reqtrace.active() if ctx is not None else None
         arrays = {k: np.asarray(v)
                   for k, v in encode_outputs(payload, outputs).items()}
         seg_name = None
@@ -887,14 +936,19 @@ class BinaryClient:
             rid, model, arrays,
             deadline_ms=None if deadline_s is None else deadline_s * 1e3,
             tenant=tenant, priority=priority, stream=stream,
-            shm_seg=seg_name)
+            shm_seg=seg_name,
+            trace=None if ctx is None else ctx.encoded())
         self._pending[rid] = {"t_submit": time.perf_counter(),
                               "t_first": None, "done": False,
                               "outputs": None, "exc": None,
                               "buf": None, "descs": None, "got": 0,
                               "total": 0, "model": None, "step": None,
                               "queue_wait_ms": None,
-                              "shm_seg": seg_name}
+                              "shm_seg": seg_name,
+                              "trace": ctx if rt is not None else None,
+                              "t_submit_us": (rt.now_us()
+                                              if rt is not None
+                                              else 0.0)}
         # _fill shrinks the socket timeout toward a deadline; a cached
         # client's NEXT send must not inherit that sliver
         self.sock.settimeout(self.timeout)
@@ -1061,6 +1115,18 @@ class BinaryClient:
                     "t_complete_s":
                         time.perf_counter() - st["t_submit"],
                     "queue_wait_ms": st["queue_wait_ms"]}
+                ctx = st.get("trace")
+                if ctx is not None:
+                    rt = reqtrace.active()
+                    if rt is not None:
+                        # the client-side wire span (submit -> terminal
+                        # frame, typed errors included): its span id
+                        # equals the server request row's — the hop
+                        # assembly stitches and clock-aligns on
+                        rt.stage(ctx, "wire:binary", st["t_submit_us"],
+                                 rt.now_us() - st["t_submit_us"],
+                                 kind="client",
+                                 shm=st["shm_seg"] is not None)
                 if st["exc"] is not None:
                     raise_for_error(*st["exc"])
                 return st["outputs"]
@@ -1071,11 +1137,11 @@ class BinaryClient:
               tenant: Optional[str] = None,
               priority: Optional[str] = None, stream: bool = False,
               timeout: Optional[float] = None,
-              outputs: Optional[Tuple[str, ...]] = None
-              ) -> Dict[str, np.ndarray]:
+              outputs: Optional[Tuple[str, ...]] = None,
+              trace=None) -> Dict[str, np.ndarray]:
         rid = self.submit(payload, model=model, deadline_s=deadline_s,
                           tenant=tenant, priority=priority,
-                          stream=stream, outputs=outputs)
+                          stream=stream, outputs=outputs, trace=trace)
         return self.collect(rid, timeout=timeout)
 
 
@@ -1113,8 +1179,8 @@ def binary_infer(address, model: str,
                  stream: bool = False,
                  cancel_box: Optional[dict] = None,
                  use_shm: Optional[bool] = None,
-                 outputs: Optional[Tuple[str, ...]] = None
-                 ) -> Dict[str, np.ndarray]:
+                 outputs: Optional[Tuple[str, ...]] = None,
+                 trace=None) -> Dict[str, np.ndarray]:
     """One inference request over the binary transport (thread-cached
     keep-alive client — the `http_infer` counterpart the router's
     binary remote replicas and the bench drivers ride). The http_infer
@@ -1133,7 +1199,7 @@ def binary_infer(address, model: str,
             rid = cli.submit(payload, model=model,
                              deadline_s=deadline_s, tenant=tenant,
                              priority=priority, stream=stream,
-                             outputs=outputs)
+                             outputs=outputs, trace=trace)
             if cancel_box is not None:
                 cancel_box["cancel"] = \
                     lambda c=cli, r=rid: c.cancel(r)

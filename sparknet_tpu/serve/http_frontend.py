@@ -74,6 +74,7 @@ from __future__ import annotations
 
 import http.client
 import io
+import itertools
 import json
 import socket
 import threading
@@ -85,6 +86,7 @@ from urllib.parse import urlsplit
 
 import numpy as np
 
+from ..obs import reqtrace
 from ..utils.logger import Logger
 from .admission import (PriorityShedError, TenantAdmission,
                         TenantLimitError)
@@ -166,18 +168,20 @@ class BackendAdapter:
     def submit(self, model: str, payload: Dict[str, np.ndarray],
                deadline_s: Optional[float],
                priority: Optional[str] = None,
-               outputs: Optional[Tuple[str, ...]] = None):
+               outputs: Optional[Tuple[str, ...]] = None,
+               trace=None):
         if self.is_router:
             # the router's remote legs only speak tensors — fold the
             # outputs request back into the payload (the terminal
             # frontend, or a local lane's submit, pops it again)
             return self.backend.submit(
                 model, encode_outputs(payload, outputs),
-                deadline_s=deadline_s, priority=priority)
+                deadline_s=deadline_s, priority=priority, trace=trace)
         if model != self.backend.model_name:
             raise UnknownModelError(model)
         return self.backend.submit(payload, deadline_s=deadline_s,
-                                   priority=priority, outputs=outputs)
+                                   priority=priority, outputs=outputs,
+                                   trace=trace)
 
     def coerce(self, model: Optional[str],
                payload: Dict[str, np.ndarray]) -> None:
@@ -274,6 +278,9 @@ class HttpFrontend:
         self.connections = 0
         self.rejected_over_cap = 0
         self.requests = 0
+        # journal correlation ids (trace_id pairs with request_id so the
+        # replay lab can key rows even for untraced requests)
+        self._rids = itertools.count(1)
         self._active = 0
         self._active_lock = threading.Lock()
         self._g_active.set_fn(lambda: self._active,
@@ -332,11 +339,12 @@ class HttpFrontend:
                 payload: Dict[str, np.ndarray],
                 deadline_s: Optional[float],
                 priority: Optional[str] = None,
-                outputs: Optional[Tuple[str, ...]] = None):
+                outputs: Optional[Tuple[str, ...]] = None,
+                trace=None):
         model = self.adapter.resolve(model)
         return model, self.adapter.submit(model, payload, deadline_s,
                                           priority=priority,
-                                          outputs=outputs)
+                                          outputs=outputs, trace=trace)
 
     def _step(self, model: str) -> Optional[int]:
         return self.adapter.step(model)
@@ -372,6 +380,21 @@ class HttpFrontend:
     def _handle_post(self, h: BaseHTTPRequestHandler) -> None:
         self.requests += 1
         t0 = time.perf_counter()
+        # distributed trace: accept the client's X-Trace-Id (parsed even
+        # when this process is not tracing, so the journal correlates);
+        # this front door MINTS a context only when tracing is on and
+        # none arrived. The record finishes in _reply_bytes — the one
+        # funnel every terminal path (200, typed shed, 500) flows through.
+        rt = reqtrace.active()
+        ctx = rec = None
+        ts_hdr = h.headers.get("X-Trace-Id")
+        if ts_hdr:
+            ctx = reqtrace.parse_context(ts_hdr)
+        if rt is not None:
+            if ctx is None:
+                ctx = rt.mint()
+            rec = rt.begin(ctx, transport="http")
+            h._spkn_rec = rec
         try:
             if getattr(h, "_over_cap", False):
                 try:
@@ -407,6 +430,9 @@ class HttpFrontend:
             reason = (self.tenants.admit(h.headers.get("X-Tenant"),
                                          h.headers.get("X-Priority"))
                       if self.tenants is not None else None)
+            if rec is not None:
+                rt.stage(ctx, "admission", rec["ts"],
+                         rt.now_us() - rec["ts"])
             if reason is not None:
                 # shed the flood before DECODING or touching a queue
                 # slot ("tenant_limit" = this tenant's bucket is empty;
@@ -435,8 +461,11 @@ class HttpFrontend:
             ctype = (h.headers.get("Content-Type") or "").split(";")[0]
             want_npz = ctype == NPZ_CONTENT_TYPE or \
                 NPZ_CONTENT_TYPE in (h.headers.get("Accept") or "")
+            t_dec = rt.now_us() if rec is not None else 0.0
             payload, deadline_ms, outputs = self._decode(
                 model, body, ctype, h)
+            if rec is not None:
+                rt.stage(ctx, "decode", t_dec, rt.now_us() - t_dec)
             deadline_s = (deadline_ms / 1e3 if deadline_ms is not None
                           else self.default_deadline_s)
             if self.journal is not None:
@@ -447,13 +476,18 @@ class HttpFrontend:
                         tenant=h.headers.get("X-Tenant") or "",
                         priority=h.headers.get("X-Priority") or "",
                         deadline_ms=deadline_ms,
+                        request_id=next(self._rids),
+                        trace_id=ctx.trace_id if ctx else None,
                         sizes={k: int(np.asarray(v).nbytes)
                                for k, v in payload.items()})
                 except Exception:
                     pass  # the journal must never fail the data plane
             model, fut = self._submit(
                 model, payload, deadline_s,
-                priority=h.headers.get("X-Priority"), outputs=outputs)
+                priority=h.headers.get("X-Priority"), outputs=outputs,
+                trace=ctx)
+            if rec is not None:
+                rec["model"] = model or ""
             # shed-not-hang: the batcher fails the future at the deadline
             # (DeadlineExpiredError); without one we still bound the wait
             wait_s = deadline_s + 5.0 if deadline_s is not None else 30.0
@@ -591,6 +625,9 @@ class HttpFrontend:
     def _reply(self, h, code: int, obj: Dict[str, Any],
                retry_after: bool = False, close: bool = False,
                extra: Optional[Dict[str, str]] = None) -> None:
+        if getattr(h, "_spkn_rec", None) is not None:
+            h._spkn_outcome = ("ok" if code == 200
+                               else str(obj.get("error_kind") or code))
         self._reply_bytes(h, code, json.dumps(obj).encode(),
                           "application/json", retry_after=retry_after,
                           close=close, extra=extra)
@@ -598,6 +635,18 @@ class HttpFrontend:
     def _reply_bytes(self, h, code: int, data: bytes, ctype: str,
                      retry_after: bool = False, close: bool = False,
                      extra: Optional[Dict[str, str]] = None) -> None:
+        # close this request's trace record (every POST outcome funnels
+        # here) and echo the trace id so a client can go from a slow
+        # response to `sparknet-trace` without guessing
+        rec = getattr(h, "_spkn_rec", None)
+        if rec is not None:
+            h._spkn_rec = None
+            rt = reqtrace.active()
+            if rt is not None:
+                extra = {**(extra or {}),
+                         "X-Trace-Id": rec["ctx"].encoded()}
+                rt.finish(rec, getattr(h, "_spkn_outcome", None)
+                          or ("ok" if code == 200 else "error"))
         self._c_http.inc(code=str(code), transport=self.transport)
         try:
             h.send_response(code)
@@ -706,8 +755,8 @@ def http_infer(base_url: str, model: str,
                timeout: float = 30.0,
                tenant: Optional[str] = None,
                priority: Optional[str] = None,
-               outputs: Optional[Tuple[str, ...]] = None
-               ) -> Dict[str, np.ndarray]:
+               outputs: Optional[Tuple[str, ...]] = None,
+               trace=None) -> Dict[str, np.ndarray]:
     """POST one inference request (npz wire format, keep-alive) and
     return the output arrays. Maps the frontend's shed codes back to the
     serve exceptions, so a remote replica behaves like a local lane.
@@ -728,53 +777,67 @@ def http_infer(base_url: str, model: str,
         headers["X-Tenant"] = tenant
     if priority is not None:
         headers["X-Priority"] = priority
+    ctx = reqtrace.parse_context(trace) if trace is not None else None
+    rt = reqtrace.active() if ctx is not None else None
+    if ctx is not None:
+        headers["X-Trace-Id"] = ctx.encoded()
     body = _encode_npz(encode_outputs(payload, outputs))
-    for attempt in (0, 1):
-        conn = _connection(host, port, timeout)
-        try:
-            conn.request("POST", path, body=body, headers=headers)
-            resp = conn.getresponse()
-            data = resp.read()  # full read keeps the connection reusable
-            break
-        except socket.timeout:
-            _drop_connection(host, port)
-            raise  # a slow server is not a stale socket: no retry
-        except (ConnectionError, http.client.HTTPException, OSError) as e:
-            # a server-closed cached connection surfaces here: retry once
-            # on a fresh socket, then give up loudly
-            _drop_connection(host, port)
-            if attempt:
-                raise ConnectionError(
-                    f"http_infer to {base_url}: {e}") from e
-        except BaseException:
-            # ANY other failure mid-exchange (decode error raised by a
-            # lower layer, KeyboardInterrupt, ...) leaves the socket in
-            # an unknown read state: never re-use it
-            _drop_connection(host, port)
-            raise
-    if resp.status == 200:
-        try:
-            return _decode_npz(data)
-        except Exception:
-            # the reply was fully read, but undecodable — the stream
-            # itself may be desynced; drop it before raising
-            _drop_connection(host, port)
-            raise
+    t_wire = rt.now_us() if rt is not None else 0.0
     try:
-        err = json.loads(data)
-    except Exception:
-        err = {"error": data[:200].decode("utf-8", "replace")}
-    kind, msg = err.get("error_kind"), err.get("error", "")
-    if resp.status == 429 and kind == "tenant_limit":
-        raise TenantLimitError(msg)
-    if resp.status == 429 and kind == "priority":
-        raise PriorityShedError(msg)
-    if resp.status == 429:
-        raise QueueFullError(msg)
-    if resp.status == 503 and kind == "deadline":
-        raise DeadlineExpiredError(msg)
-    if resp.status == 503:
-        raise NoReplicaError(msg or f"replica shed ({kind})")
-    if resp.status == 404:
-        raise UnknownModelError(msg or model)
-    raise RuntimeError(f"http_infer: {resp.status} {msg}")
+        for attempt in (0, 1):
+            conn = _connection(host, port, timeout)
+            try:
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()  # full read keeps the conn reusable
+                break
+            except socket.timeout:
+                _drop_connection(host, port)
+                raise  # a slow server is not a stale socket: no retry
+            except (ConnectionError, http.client.HTTPException,
+                    OSError) as e:
+                # a server-closed cached connection surfaces here: retry
+                # once on a fresh socket, then give up loudly
+                _drop_connection(host, port)
+                if attempt:
+                    raise ConnectionError(
+                        f"http_infer to {base_url}: {e}") from e
+            except BaseException:
+                # ANY other failure mid-exchange (decode error raised by
+                # a lower layer, KeyboardInterrupt, ...) leaves the
+                # socket in an unknown read state: never re-use it
+                _drop_connection(host, port)
+                raise
+        if resp.status == 200:
+            try:
+                return _decode_npz(data)
+            except Exception:
+                # the reply was fully read, but undecodable — the stream
+                # itself may be desynced; drop it before raising
+                _drop_connection(host, port)
+                raise
+        try:
+            err = json.loads(data)
+        except Exception:
+            err = {"error": data[:200].decode("utf-8", "replace")}
+        kind, msg = err.get("error_kind"), err.get("error", "")
+        if resp.status == 429 and kind == "tenant_limit":
+            raise TenantLimitError(msg)
+        if resp.status == 429 and kind == "priority":
+            raise PriorityShedError(msg)
+        if resp.status == 429:
+            raise QueueFullError(msg)
+        if resp.status == 503 and kind == "deadline":
+            raise DeadlineExpiredError(msg)
+        if resp.status == 503:
+            raise NoReplicaError(msg or f"replica shed ({kind})")
+        if resp.status == 404:
+            raise UnknownModelError(msg or model)
+        raise RuntimeError(f"http_infer: {resp.status} {msg}")
+    finally:
+        # the client-side wire span brackets the whole exchange; the
+        # assembler subtracts the matched server record to expose pure
+        # network + clock-offset time on this hop
+        if rt is not None:
+            rt.stage(ctx, "wire:http", t_wire, rt.now_us() - t_wire,
+                     kind="client")

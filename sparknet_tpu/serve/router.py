@@ -38,6 +38,7 @@ from dataclasses import dataclass, replace
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..obs import MetricsRegistry, StatusServer, register_build_info
+from ..obs import reqtrace
 from ..utils.heartbeat import HeartbeatWriter, read_heartbeat, staleness_s
 from ..utils.logger import Logger
 from ..utils.metrics import LatencyStats
@@ -648,7 +649,7 @@ class ModelRouter:
 
     def _issue(self, rep: Replica, model: str, payload: Dict[str, Any],
                deadline_s: Optional[float],
-               priority: Optional[str] = None
+               priority: Optional[str] = None, trace=None
                ) -> Tuple[Future, Callable[[], None]]:
         """Issue one request LEG on a specific replica -> (future,
         cancel_fn). cancel_fn is best-effort and idempotent: locally it
@@ -660,7 +661,7 @@ class ModelRouter:
         which is what the hedge accounting counts."""
         if rep.lane is not None:
             fut = rep.lane.submit(payload, deadline_s=deadline_s,
-                                  priority=priority)
+                                  priority=priority, trace=trace)
             lane = rep.lane
             return fut, (lambda: (lane.batcher.cancel(fut), None)[1])
         proxy = self._proxy
@@ -672,7 +673,7 @@ class ModelRouter:
         fut = Future()
         cancel_box: Dict[str, Any] = {}
         proxy.submit(self._proxy_call, rep, model, payload,
-                     deadline_s, fut, False, cancel_box, priority)
+                     deadline_s, fut, False, cancel_box, priority, trace)
 
         def cancel() -> None:
             fn = cancel_box.get("cancel")
@@ -686,7 +687,8 @@ class ModelRouter:
     def submit(self, model: str, payload: Dict[str, Any],
                deadline_s: Optional[float] = None,
                priority: Optional[str] = None,
-               _exclude: Optional[Replica] = None) -> Future:
+               _exclude: Optional[Replica] = None,
+               trace=None) -> Future:
         """Route one request; returns its response future. Raises
         UnknownModelError / NoReplicaError synchronously; QueueFullError
         propagates from the chosen local lane (backpressure is
@@ -702,24 +704,50 @@ class ModelRouter:
         future's first-resolution-wins."""
         rep = self._pick(model, exclude=_exclude)
         self._c_routed.inc(model=model, replica=rep.name)
+        # trace context: a router fronted directly (no HTTP/binary front
+        # door, e.g. sparknet-batch or embedding use) MINTS the context
+        # and owns the request record; when a frontend minted it, the
+        # router is a pass-through hop and must NOT start a second
+        # record (record owner = minter — one request row per process
+        # per request)
+        rt = reqtrace.active()
+        ctx = (reqtrace.parse_context(trace) if trace is not None
+               else None)
+        rec = None
+        if rt is not None and ctx is None:
+            ctx = rt.mint()
+            rec = rt.begin(ctx, transport="router", model=model)
+        hedging = (self.cfg.hedge and _exclude is None
+                   and (priority or "normal").lower() != "low"
+                   and len(self.replicas.get(model, ())) >= 2)
+        # each LEG gets a child context (fresh span id, same trace id):
+        # the wire span a leg emits then matches exactly one server-side
+        # record, so assembly tells hedge duplicates apart. The leg tag
+        # is only set when hedging can engage — a plain child otherwise.
+        leg = (ctx.child(leg="primary") if hedging
+               else ctx.child()) if ctx is not None else None
         fut, cancel = self._issue(rep, model, payload, deadline_s,
-                                  priority)
+                                  priority, trace=leg)
         ret = fut
         # low-priority (scavenger/batch) requests never hedge: a hedge
         # duplicates exactly the load the admission stack exists to
         # shed, and a scavenger's tail is free to be long
-        if (self.cfg.hedge and _exclude is None
-                and (priority or "normal").lower() != "low"
-                and len(self.replicas.get(model, ())) >= 2):
+        if hedging:
             counts = self._hedge_counts.setdefault(model, [0, 0])
             counts[0] += 1
             ret = self._hedge_arm(model, payload, deadline_s, rep,
-                                  fut, cancel, priority)
+                                  fut, cancel, priority, trace=ctx)
         t0 = time.perf_counter()
         lat = self._ensure_latency(model)
-        ret.add_done_callback(
-            lambda f: lat.add(time.perf_counter() - t0)
-            if f.exception() is None else None)
+
+        def _done(f: Future) -> None:
+            exc = f.exception()
+            if exc is None:
+                lat.add(time.perf_counter() - t0)
+            if rec is not None:
+                rt.finish_exc(rec, exc) if exc is not None \
+                    else rt.finish(rec, "ok")
+        ret.add_done_callback(_done)
         return ret
 
     # -- hedging (tail-at-scale tied requests) --------------------------------
@@ -727,7 +755,8 @@ class ModelRouter:
     def _hedge_arm(self, model: str, payload: Dict[str, Any],
                    deadline_s: Optional[float], rep: Replica,
                    fut: Future, cancel: Callable[[], None],
-                   priority: Optional[str] = None) -> Future:
+                   priority: Optional[str] = None,
+                   trace=None) -> Future:
         """Wrap the primary leg in an OUTER future and schedule the
         hedge decision. At fire time (adaptive delay past submit) an
         unanswered request gets a second leg on another replica; the
@@ -778,8 +807,14 @@ class ModelRouter:
             except Exception:
                 return  # hedge target draining/down: primary stands alone
             try:
+                # the hedge leg's child context is tagged leg=hedge —
+                # the trace shows exactly which copy of the work each
+                # span belongs to, and which leg won
+                leg2 = (trace.child(leg="hedge")
+                        if trace is not None else None)
                 fut2, cancel2 = self._issue(rep2, model, payload,
-                                            deadline_s, priority)
+                                            deadline_s, priority,
+                                            trace=leg2)
             except Exception:
                 return  # a refused hedge leg must never hurt the primary
             counts[1] += 1
@@ -856,7 +891,8 @@ class ModelRouter:
                     deadline_s: Optional[float], fut: Future,
                     retried: bool = False,
                     cancel_box: Optional[Dict[str, Any]] = None,
-                    priority: Optional[str] = None) -> None:
+                    priority: Optional[str] = None,
+                    trace=None) -> None:
         try:
             if rep.transport == "binary":
                 from .binary_frontend import binary_infer  # cycle guard
@@ -864,12 +900,13 @@ class ModelRouter:
                                    deadline_s=deadline_s,
                                    priority=priority,
                                    cancel_box=cancel_box,
-                                   use_shm=self.cfg.proxy_shm)
+                                   use_shm=self.cfg.proxy_shm,
+                                   trace=trace)
             else:
                 from .http_frontend import http_infer  # cycle guard
                 out = http_infer(rep.url, model, payload,
                                  deadline_s=deadline_s,
-                                 priority=priority)
+                                 priority=priority, trace=trace)
             fut.set_result(out)
         except RequestCancelledError as e:
             fut.set_exception(e)  # a hedge loser's confirmed cancel —
@@ -895,7 +932,7 @@ class ModelRouter:
             if rep2.lane is not None:
                 try:
                     f2 = rep2.lane.submit(payload, deadline_s=deadline_s,
-                                          priority=priority)
+                                          priority=priority, trace=trace)
                 except Exception as e2:
                     fut.set_exception(e2)
                     return
@@ -903,7 +940,7 @@ class ModelRouter:
             else:
                 self._proxy_call(rep2, model, payload, deadline_s, fut,
                                  retried=True, cancel_box=cancel_box,
-                                 priority=priority)
+                                 priority=priority, trace=trace)
         except Exception as e:
             fut.set_exception(e)
 
@@ -1029,7 +1066,7 @@ class ModelRouter:
         """/status JSON: per-model lane vitals + replica sets. The
         `models` key is the same compact-row schema single-model servers
         emit, so /pod/status renders per-model rows either way."""
-        return {
+        out: Dict[str, Any] = {
             "role": "serve",
             "router": True,
             "pool_workers": self.pool_size(),
@@ -1048,6 +1085,13 @@ class ModelRouter:
                         for m, c in self._hedge_counts.items()},
             "autoscale": self.fleet is not None,
         }
+        rt = reqtrace.active()
+        if rt is not None:
+            ex = rt.exemplars()
+            if ex:
+                out["slow_requests"] = ex
+            out["reqtrace"] = rt.stats()
+        return out
 
     @property
     def status_address(self):

@@ -51,6 +51,7 @@ from ..model.quant import QuantConfig
 from ..obs import (MetricsRegistry, StatusServer, register_build_info,
                    trace as obs_trace)
 from ..obs import device as obs_device
+from ..obs import reqtrace
 from ..utils.compile_cache import init_compile_cache, track_compiles
 from ..utils.heartbeat import HeartbeatWriter
 from ..utils.logger import Logger
@@ -369,7 +370,8 @@ class InferenceServer:
     def submit(self, payload: Dict[str, Any],
                deadline_s: Optional[float] = None,
                priority: Optional[str] = None,
-               outputs: Optional[Tuple[str, ...]] = None):
+               outputs: Optional[Tuple[str, ...]] = None,
+               trace=None):
         """Enqueue one example (dict of per-example arrays); returns a
         Future resolving to {blob name: per-example array}. `deadline_s`
         threads the client's answer-by bound into batch formation: an
@@ -392,7 +394,8 @@ class InferenceServer:
                         f"unknown output blob(s) {bad!r} "
                         f"(net has {sorted(known)})")
         return self.batcher.submit(payload, deadline_s=deadline_s,
-                                   priority=priority, outputs=outputs)
+                                   priority=priority, outputs=outputs,
+                                   trace=trace)
 
     def _known_blobs(self) -> Optional[set]:
         """The net's nameable blobs, or None when the backend can't
@@ -517,6 +520,15 @@ class InferenceServer:
         if self.cfg.slo_p99_ms is not None:
             out["slo_p99_ms"] = self.cfg.slo_p99_ms
         out.update(self.latency.summary())
+        # recent worst captured requests (trace_id, total ms, dominant
+        # stage): "p99 is burning" -> the exact trace in two steps. Reads
+        # a locked snapshot; absent entirely when tracing is off.
+        rt = reqtrace.active()
+        if rt is not None:
+            ex = rt.exemplars().get(self.model_name)
+            if ex:
+                out["slow_requests"] = ex
+            out["reqtrace"] = rt.stats()
         # per-model rows for the pod view (PodAggregator._collect_http
         # lifts this into WorkerView.models; the router emits one row per
         # lane here, a single-model server exactly one)
@@ -603,7 +615,7 @@ class InferenceServer:
         enough for `sparknet-podview` to attribute per-model stragglers
         without shipping the whole status dict."""
         lat = self.latency.summary()
-        return {"step": self.manager.step,
+        row = {"step": self.manager.step,
                 # staleness without a /metrics scrape: the rollout duty
                 # reads adoption (model_step) from heartbeat rows, and
                 # sparknet-podview renders freshness per replica
@@ -619,6 +631,12 @@ class InferenceServer:
                 "recent_occupancy": self.fill_signal(),
                 "swaps": self.manager.swaps,
                 "swap_failures": self.manager.swap_failures}
+        rt = reqtrace.active()
+        if rt is not None:
+            worst = rt.worst(self.model_name)
+            if worst is not None:
+                row["slow_request"] = worst
+        return row
 
     def fill_signal(self) -> Optional[float]:
         """Recent batch occupancy vs max_batch in [0,1] (None until a
@@ -709,6 +727,17 @@ class InferenceServer:
         t_form = time.perf_counter()
         for r in reqs:
             r.future._spkn_queue_wait_s = t_form - r.t_enqueue
+        # distributed-trace stages: one global None-check when tracing is
+        # off; per-request rows only for requests carrying a context.
+        # bucket/batch_n attrs are SHARED by every coalesced request in
+        # the group — the trace shows who a request formed with.
+        rt = reqtrace.active()
+        traced = ([r for r in reqs if r.trace is not None]
+                  if rt is not None else ())
+        for r in traced:
+            rt.stage(r.trace, "queue", rt.to_us(r.t_enqueue),
+                     (t_form - r.t_enqueue) * 1e6,
+                     bucket=bucket, batch_n=n)
         try:
             full = self._bucket_batch(reqs, bucket)
             # per-request named blobs (the featurizer route) widen the
@@ -719,10 +748,15 @@ class InferenceServer:
                 if r.outputs:
                     extra.update(r.outputs)
             t_fwd0 = time.perf_counter()
+            for r in traced:
+                rt.stage(r.trace, "form", rt.to_us(t_form),
+                         (t_fwd0 - t_form) * 1e6,
+                         bucket=bucket, batch_n=n)
             with track_compiles() as tc:
                 out = self.net.forward(
                     full,
                     blob_names=list(set(self.cfg.outputs or ()) | extra))
+            t_fwd1 = time.perf_counter()
             if bucket not in self._compiled_buckets:
                 # this forward traced+compiled the bucket's executable;
                 # cache_hit says whether the persistent compile cache
@@ -752,6 +786,15 @@ class InferenceServer:
             else:
                 default = [f for f in fields if f[2]]
             now = time.perf_counter()
+            # emitted BEFORE set_result: resolving the future runs the
+            # frontend's completion callback, which finishes the trace
+            # record and drains this request's parked spans
+            for r in traced:
+                rt.stage(r.trace, "forward", rt.to_us(t_fwd0),
+                         (t_fwd1 - t_fwd0) * 1e6,
+                         bucket=bucket, batch_n=n)
+                rt.stage(r.trace, "depad", rt.to_us(t_fwd1),
+                         (now - t_fwd1) * 1e6)
             for i, r in enumerate(reqs):
                 sel = ([f for f in fields if f[0] in r.outputs]
                        if r.outputs else default)

@@ -13,7 +13,10 @@ segments: the descriptor table still travels over the socket (framing,
 ordering, and error handling stay exactly the wire protocol's), but the
 payload bytes are written once into a named segment and read in place by
 the peer. Zero tensor bytes through the socket in either direction —
-pinned by byte counters in BENCH_TAIL's shm arm.
+pinned by byte counters in BENCH_TAIL's shm arm. (The request TRACE
+context needs no shm treatment: it is a <50-byte str8 in the REQUEST
+meta, so it rides the socket-side descriptor table unchanged and spans
+on both ends of an shm hop join the same trace.)
 
 Three pieces:
 

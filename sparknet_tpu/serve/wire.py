@@ -39,6 +39,9 @@ Meta sections (str8 = u8 length + utf-8 bytes; str16 = u16 length):
   REQUEST:  model str8 | tenant str8 | priority str8 ("" = normal;
             the admission priority class, serve/admission.py) |
             deadline_ms f64 (NaN = none) |
+            trace str8 ("" = untraced: the encoded TraceContext —
+            trace_id, span id, sampling flag, hedge-leg tag — see
+            obs/reqtrace.py) |
             n_tensors u16 | descriptor* |
             [seg str8 — only with FLAG_SHM: the shared-memory segment
             holding the payload bytes the descriptors index into]
@@ -93,13 +96,15 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 MAGIC = b"SPK1"
-# version 3: RESPONSE meta grew the queue_wait_ms f64 field (between
-# step and the descriptor table), plus the CANCEL/SHM_HELLO/SHM_RELEASE
-# frame types and FLAG_SHM. The bump is what makes a rolling upgrade
-# honest: a v2 peer gets the TYPED bad_version error frame instead of
-# silently misparsing the queue-wait bytes as a descriptor count.
-# (version 2 grew the REQUEST priority str8; same discipline.)
-VERSION = 3
+# version 4: REQUEST meta grew the trace str8 field (between deadline_ms
+# and the descriptor table) carrying the encoded distributed-trace
+# context. The bump is what makes a rolling upgrade honest: a v3 peer
+# gets the TYPED bad_version error frame instead of silently misparsing
+# the trace bytes as a descriptor count.
+# (version 3 grew the RESPONSE queue_wait_ms f64 + the CANCEL/SHM_HELLO/
+# SHM_RELEASE frame types and FLAG_SHM; version 2 grew the REQUEST
+# priority str8; same discipline each time.)
+VERSION = 4
 HEADER = struct.Struct("<4sBBHQQQ")
 HEADER_LEN = HEADER.size  # 32
 
@@ -292,7 +297,8 @@ def pack_request(request_id: int, model: str,
                  tenant: Optional[str] = None,
                  priority: Optional[str] = None,
                  stream: bool = False,
-                 shm_seg: Optional[str] = None
+                 shm_seg: Optional[str] = None,
+                 trace: Optional[str] = None
                  ) -> Tuple[bytes, List[memoryview]]:
     """(header+meta bytes, payload byte views). The caller writes the
     bytes then each view — the tensors are never re-serialized. With
@@ -313,6 +319,7 @@ def pack_request(request_id: int, model: str,
         _pack_str8(priority or ""),
         struct.pack("<d", float("nan") if deadline_ms is None
                     else float(deadline_ms)),
+        _pack_str8(trace or ""),
         _pack_table(descs),
         tail))
     head = _header(T_REQUEST, flags, request_id, len(meta), total)
@@ -321,9 +328,12 @@ def pack_request(request_id: int, model: str,
 
 def unpack_request_meta(meta: bytes
                         ) -> Tuple[str, str, str, Optional[float],
-                                   List[TensorDesc], Optional[str]]:
-    """-> (model, tenant, priority, deadline_ms, descriptors, shm_seg).
-    shm_seg is None for inline payloads (no trailing segment name)."""
+                                   Optional[str], List[TensorDesc],
+                                   Optional[str]]:
+    """-> (model, tenant, priority, deadline_ms, trace, descriptors,
+    shm_seg). trace is None when the request is untraced ("" on the
+    wire); shm_seg is None for inline payloads (no trailing segment
+    name)."""
     r = _Reader(meta)
     model = r.str8()
     tenant = r.str8()
@@ -333,9 +343,10 @@ def unpack_request_meta(meta: bytes
         deadline = None
     else:
         deadline = float(deadline_ms)
+    trace = r.str8() or None
     descs = _read_table(r)
     seg = r.str8() if r.pos < len(meta) else None
-    return model, tenant, priority, deadline, descs, seg
+    return model, tenant, priority, deadline, trace, descs, seg
 
 
 def pack_response(request_id: int, model: str, step: Optional[int],

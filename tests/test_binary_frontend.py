@@ -70,17 +70,25 @@ def test_wire_request_roundtrip():
                "label": np.arange(2, dtype=np.int32)}
     head, views = wire.pack_request(7, "m", payload, deadline_ms=125.0,
                                     tenant="t1", priority="low",
-                                    stream=True)
+                                    stream=True,
+                                    trace="00000000000000ab-000000cd-1")
     buf = head + b"".join(bytes(v) for v in views)
     ftype, flags, rid, meta_len, payload_len = wire.parse_header(buf)
     assert (ftype, rid) == (wire.T_REQUEST, 7)
     assert flags & wire.FLAG_STREAM
     meta = buf[wire.HEADER_LEN:wire.HEADER_LEN + meta_len]
-    model, tenant, priority, deadline_ms, descs, seg = \
+    model, tenant, priority, deadline_ms, trace, descs, seg = \
         wire.unpack_request_meta(meta)
     assert seg is None  # inline payload: no trailing shm segment
     assert (model, tenant, priority, deadline_ms) == \
         ("m", "t1", "low", 125.0)
+    assert trace == "00000000000000ab-000000cd-1"
+    # an untraced request puts "" on the wire, surfaced as None
+    h2, v2 = wire.pack_request(8, "m", payload)
+    buf2 = h2 + b"".join(bytes(v) for v in v2)
+    meta2_len = wire.parse_header(buf2)[3]
+    assert wire.unpack_request_meta(
+        buf2[wire.HEADER_LEN:wire.HEADER_LEN + meta2_len])[4] is None
     out = wire.tensors_from(descs,
                             buf[wire.HEADER_LEN + meta_len:])
     assert set(out) == {"data", "label"}
@@ -291,14 +299,18 @@ def test_bad_version_answered_typed(srv):
     bfe = BinaryFrontend(srv, port=0)
     try:
         head, _ = wire.pack_request(1, "default", {})
-        s = socket.create_connection(bfe.address, timeout=10)
-        s.sendall(head[:4] + bytes([42]) + head[5:])
-        ftype, flags, rid, meta = _recv_frame(s)
-        code, kind, _ = wire.unpack_error_meta(meta)
-        assert ftype == wire.T_ERROR and (code, kind) == \
-            (400, "bad_version")
-        assert s.recv(4096) == b""
-        s.close()
+        # version 3 is the PRE-TRACE wire (no trace field in the REQUEST
+        # meta, this PR's bump): an old peer must get the typed frame,
+        # not a silent close or a garbled meta decode
+        for bad in (42, wire.VERSION - 1):
+            s = socket.create_connection(bfe.address, timeout=10)
+            s.sendall(head[:4] + bytes([bad]) + head[5:])
+            ftype, flags, rid, meta = _recv_frame(s)
+            code, kind, _ = wire.unpack_error_meta(meta)
+            assert ftype == wire.T_ERROR and (code, kind) == \
+                (400, "bad_version")
+            assert s.recv(4096) == b""
+            s.close()
         _serves_fine(bfe)
     finally:
         bfe.stop()

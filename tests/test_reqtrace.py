@@ -1,0 +1,483 @@
+"""Distributed per-request tracing (this PR) — the `obs.reqtrace`
+layer and its transport satellites:
+
+  - trace-context mint / encode / parse / child semantics;
+  - propagation pins across BOTH wires (X-Trace-Id on HTTP, the
+    REQUEST-meta trace field on the binary wire) and through the
+    router's remote proxy hop;
+  - hedge legs carry leg=primary / leg=hedge tags exactly once;
+  - the batch driver mints one trace per work unit, rows as children;
+  - journal rows carry trace_id + request_id on both front doors;
+  - the tail-sampling policy (typed sheds always, beyond-live-p95
+    always, head-sample as minted) and the bounded-buffer drop
+    accounting under a span flood;
+  - clock-offset normalization + Chrome-trace assembly on synthetic
+    skewed shards, and the LIVE two-process acceptance run (a
+    deliberately slowed request router -> remote replica over the
+    binary wire assembles into one trace with the cross-process hop).
+
+Tier-1: CPU backend, pure-python nets (ModelManager tolerates a
+paramless net when checkpoint_dir/quant are off), ephemeral ports.
+"""
+import http.client
+import json
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.obs import reqtrace
+from sparknet_tpu.serve.batcher import QueueFullError
+from sparknet_tpu.serve.binary_frontend import BinaryFrontend, binary_infer
+from sparknet_tpu.serve.http_frontend import (NPZ_CONTENT_TYPE,
+                                              HttpFrontend, _encode_npz,
+                                              http_infer)
+from sparknet_tpu.serve.router import ModelRouter, RouterConfig
+from sparknet_tpu.serve.server import InferenceServer, ServeConfig
+from sparknet_tpu.utils.logger import Logger
+
+
+class SleepyNet:
+    """Pure-python net: y = 2x after an optional sleep — slow enough to
+    shape queues, no jax compile in the loop."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+
+    def input_shapes(self):
+        return {"x": (1, 4)}
+
+    def input_dtypes(self):
+        return {"x": np.float32}
+
+    def forward(self, batch, blob_names=None):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return {"y": np.asarray(batch["x"], dtype=np.float32) * 2.0}
+
+
+def _cfg(**kw):
+    base = dict(max_batch=2, max_wait_ms=1.0, buckets=(1, 2),
+                outputs=("y",), metrics_every_batches=0)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+_X = {"x": np.ones((4,), np.float32)}
+
+
+@pytest.fixture
+def tracer():
+    """A live tracer for the duration of one test, head-sampling
+    everything (capture decisions under test get their own tracers)."""
+    with reqtrace.request_tracing(None, head_sample=1.0,
+                                  proc="test") as tr:
+        yield tr
+
+
+def _rows_until(tr, pred, timeout=10.0):
+    """Poll the tracer's buffered rows until `pred(rows)` (completion
+    callbacks may land after the client's future resolves)."""
+    deadline = time.monotonic() + timeout
+    rows = []
+    while time.monotonic() < deadline:
+        rows = rows + tr.drain_rows()
+        if pred(rows):
+            return rows
+        time.sleep(0.02)
+    raise AssertionError(f"rows never satisfied predicate: {rows}")
+
+
+# -- context ------------------------------------------------------------------
+
+def test_context_mint_encode_parse_child():
+    ctx = reqtrace.mint_context(sampled=True)
+    assert len(ctx.trace_id) == 16 and len(ctx.span_id) == 8
+    back = reqtrace.parse_context(ctx.encoded())
+    assert back == ctx
+    # child: fresh span id, same identity; leg inherited unless overridden
+    kid = ctx.child()
+    assert kid.trace_id == ctx.trace_id and kid.span_id != ctx.span_id
+    assert kid.sampled is True
+    hedge = ctx.child(leg="hedge")
+    assert hedge.leg == "hedge"
+    assert hedge.child().leg == "hedge"  # a hedge's proxy hop stays hedge
+    assert "-hedge" in hedge.encoded()
+    assert reqtrace.parse_context(hedge.encoded()).leg == "hedge"
+    # tolerant decode: garbage is None, never an exception
+    for junk in (None, "", "xyz", "nothex!-00-1", "aa-bb", "aa-bb-7", 42):
+        assert reqtrace.parse_context(junk) is None
+
+
+# -- propagation over both wires ---------------------------------------------
+
+def test_trace_propagates_over_binary_wire(tracer):
+    with InferenceServer(SleepyNet(), _cfg()) as srv:
+        fe = BinaryFrontend(srv, port=0)
+        try:
+            ctx = tracer.mint(sampled=True)
+            # a client-side record owns the wire span, as the router
+            # does on a proxy hop (finishing it drains whatever the
+            # server-side finish didn't — same-tracer test, two procs
+            # in production)
+            cli_rec = tracer.begin(ctx, transport="cli")
+            out = binary_infer(fe.address, "default", _X, trace=ctx)
+            tracer.finish(cli_rec, "ok")
+            np.testing.assert_allclose(out["y"], 2.0)
+            rows = _rows_until(
+                tracer,
+                lambda rs: any(r["k"] == "r" and
+                               r["transport"] == "binary" for r in rs))
+        finally:
+            fe.stop()
+    req = [r for r in rows
+           if r["k"] == "r" and r["transport"] == "binary"]
+    assert len(req) == 1
+    # the server's request row carries the trace identity AND the exact
+    # span id the client sent — the cross-process join key
+    assert req[0]["trace"] == ctx.trace_id
+    assert req[0]["span"] == ctx.span_id
+    assert req[0]["transport"] == "binary"
+    assert req[0]["outcome"] == "ok"
+    # the server-side stage spans are captured under the same trace
+    # (asserted on the span rows: with BOTH wire ends sharing one
+    # tracer in-process, which request row's finish() drains a given
+    # span is timing-dependent — in production they are two processes)
+    names = {r["name"] for r in rows
+             if r["k"] == "s" and r.get("kind") == "server"}
+    for st in ("queue", "forward", "reply"):
+        assert st in names, names
+    # the client-side wire span matches by the same span id
+    wire_spans = [r for r in rows
+                  if r["k"] == "s" and r.get("kind") == "client"]
+    assert [s["span"] for s in wire_spans] == [ctx.span_id]
+    assert wire_spans[0]["name"] == "wire:binary"
+    # exemplars feed /status
+    assert srv.status().get("slow_requests")
+
+
+def test_trace_propagates_over_http_wire_and_echoes_header(tracer):
+    with InferenceServer(SleepyNet(), _cfg()) as srv:
+        fe = HttpFrontend(srv, port=0)
+        try:
+            ctx = tracer.mint(sampled=True)
+            conn = http.client.HTTPConnection(*fe.address, timeout=30)
+            conn.request("POST", "/v1/models/default/infer",
+                         body=_encode_npz(_X),
+                         headers={"Content-Type": NPZ_CONTENT_TYPE,
+                                  "Accept": NPZ_CONTENT_TYPE,
+                                  "X-Trace-Id": ctx.encoded()})
+            resp = conn.getresponse()
+            echoed = resp.getheader("X-Trace-Id")
+            resp.read()
+            conn.close()
+            assert resp.status == 200
+            # the reply names the trace so a slow client can go straight
+            # to sparknet-trace
+            assert echoed == ctx.encoded()
+            rows = _rows_until(
+                tracer, lambda rs: any(r["k"] == "r" for r in rs))
+        finally:
+            fe.stop()
+    req = [r for r in rows if r["k"] == "r"]
+    assert len(req) == 1
+    assert req[0]["trace"] == ctx.trace_id
+    assert req[0]["span"] == ctx.span_id
+    assert req[0]["transport"] == "http"
+    for st in ("admission", "decode", "queue", "forward"):
+        assert st in req[0]["stages"], req[0]["stages"]
+
+
+def test_journal_rows_carry_trace_and_request_id(tracer, tmp_path):
+    jpath = tmp_path / "journal.jsonl"
+    journal = Logger(jsonl_path=str(jpath), echo=False)
+    with InferenceServer(SleepyNet(), _cfg()) as srv:
+        bfe = BinaryFrontend(srv, port=0, journal=journal)
+        hfe = HttpFrontend(srv, port=0, journal=journal)
+        try:
+            ctx_b = tracer.mint(sampled=True)
+            ctx_h = tracer.mint(sampled=True)
+            binary_infer(bfe.address, "default", _X, trace=ctx_b)
+            http_infer(f"http://{hfe.address[0]}:{hfe.address[1]}",
+                       "default", _X, trace=ctx_h)
+        finally:
+            bfe.stop()
+            hfe.stop()
+            journal.close()
+    rows = [json.loads(l) for l in
+            jpath.read_text().strip().splitlines()]
+    by_transport = {r["transport"]: r for r in rows}
+    assert set(by_transport) == {"binary", "http"}
+    assert by_transport["binary"]["trace_id"] == ctx_b.trace_id
+    assert by_transport["http"]["trace_id"] == ctx_h.trace_id
+    for r in by_transport.values():
+        # the Logger numeric-casts jsonl values; identity, not type
+        assert r["request_id"] == int(r["request_id"]) >= 1
+
+
+# -- router: proxy hop + hedge legs ------------------------------------------
+
+def test_router_proxy_hop_propagates_and_mints(tracer):
+    """A router fronted directly MINTS the context; the remote proxy
+    hop carries a child of it over the binary wire, so the server-side
+    request row joins the same trace by span-id equality."""
+    with InferenceServer(SleepyNet(), _cfg()) as srv:
+        fe = BinaryFrontend(srv, port=0)
+        router = ModelRouter(RouterConfig(workers=2, hedge=False))
+        router.add_remote_replica(
+            "default", f"spkn://{fe.address[0]}:{fe.address[1]}")
+        try:
+            with router:
+                out = router.infer("default", _X, timeout=30.0)
+            np.testing.assert_allclose(out["y"], 2.0)
+            rows = _rows_until(
+                tracer,
+                lambda rs: sum(r["k"] == "r" for r in rs) >= 2)
+        finally:
+            fe.stop()
+    req = [r for r in rows if r["k"] == "r"]
+    tids = {r["trace"] for r in req}
+    assert len(tids) == 1  # one trace end to end
+    by_transport = {r["transport"]: r for r in req}
+    assert set(by_transport) == {"router", "binary"}
+    assert by_transport["router"]["root"] is True
+    # the frontend's row is keyed by the LEG's span id (a child), which
+    # the client wire span shares
+    wire = [r for r in rows if r["k"] == "s" and r.get("kind") == "client"]
+    assert by_transport["binary"]["span"] in {s["span"] for s in wire}
+    assert by_transport["binary"]["span"] != by_transport["router"]["span"]
+
+
+def test_hedge_legs_tagged_exactly_once(tracer):
+    """With hedging forced (2 slow replicas, no delay floor, full
+    budget) a traced request's server-side rows carry leg=primary and
+    leg=hedge EXACTLY once each — the trace shows both copies of the
+    work and which leg is which."""
+    srv1 = InferenceServer(SleepyNet(0.15), _cfg())
+    srv2 = InferenceServer(SleepyNet(0.15), _cfg())
+    srv1.start()
+    srv2.start()
+    fe1 = BinaryFrontend(srv1, port=0)
+    fe2 = BinaryFrontend(srv2, port=0)
+    router = ModelRouter(RouterConfig(workers=4, hedge=True,
+                                      hedge_budget=1.0,
+                                      hedge_min_delay_ms=1.0))
+    for fe in (fe1, fe2):
+        router.add_remote_replica(
+            "default", f"spkn://{fe.address[0]}:{fe.address[1]}")
+    try:
+        with router:
+            out = router.infer("default", _X, timeout=30.0)
+        np.testing.assert_allclose(out["y"], 2.0)
+
+        def both_legs(rs):
+            legs = [r.get("leg") for r in rs if r["k"] == "r"
+                    and r["transport"] == "binary"]
+            return "primary" in legs and "hedge" in legs
+        rows = _rows_until(tracer, both_legs, timeout=15.0)
+    finally:
+        fe1.stop()
+        fe2.stop()
+        srv1.stop()
+        srv2.stop()
+    legs = [r.get("leg") for r in rows
+            if r["k"] == "r" and r["transport"] == "binary"]
+    assert legs.count("primary") == 1, legs
+    assert legs.count("hedge") == 1, legs
+    # both legs belong to ONE trace
+    assert len({r["trace"] for r in rows if r["k"] == "r"}) == 1
+
+
+# -- batch driver -------------------------------------------------------------
+
+def test_batch_driver_unit_spans(tracer, tmp_path):
+    from sparknet_tpu.batch import BatchConfig, BatchDriver
+    r = np.random.default_rng(3)
+    np.savez(str(tmp_path / "in.npz"),
+             x=r.standard_normal((8, 4)).astype(np.float32))
+    with InferenceServer(SleepyNet(), _cfg()) as srv:
+        fe = BinaryFrontend(srv, port=0)
+        try:
+            res = BatchDriver(BatchConfig(
+                input=str(tmp_path / "in.npz"),
+                output=str(tmp_path / "out"),
+                replicas=[f"{fe.address[0]}:{fe.address[1]}"],
+                outputs=("y",), unit_rows=4, window=2, concurrency=1,
+                deadline_s=30.0, request_timeout_s=60.0)).run()
+            assert res["done"]
+        finally:
+            fe.stop()
+    rows = tracer.drain_rows()
+    units = [r for r in rows
+             if r["k"] == "r" and r["transport"] == "batch"]
+    assert len(units) == 2  # one trace per work unit
+    for u in units:
+        assert u["outcome"] == "ok"
+        assert "unit" in u["stages"]
+        # the unit's row requests are children on the SAME trace: each
+        # produced a server-side binary request row under this trace_id
+        kids = [r for r in rows if r["k"] == "r"
+                and r["transport"] == "binary"
+                and r["trace"] == u["trace"]]
+        assert len(kids) == 4
+        assert all(k["span"] != u["span"] for k in kids)
+
+
+# -- sampling policy + bounded buffers ---------------------------------------
+
+def test_tail_sampling_policy():
+    tr = reqtrace.RequestTracer(head_sample=0.0, slow_min_n=4)
+    ctx = reqtrace.mint_context(sampled=False)
+    # 1) healthy + unsampled: forgotten
+    assert tr.finish(tr.begin(ctx, model="m"), "ok") is False
+    # 2) typed shed: ALWAYS captured
+    rec = tr.begin(reqtrace.mint_context(), model="m")
+    assert tr.finish_exc(rec, QueueFullError("full")) is True
+    row = [r for r in tr.drain_rows() if r["k"] == "r"][0]
+    assert row["outcome"] == "queue_full" and row["why"] == ["outcome"]
+    # 3) beyond the live windowed p95: captured, with the threshold read
+    #    BEFORE this observation joins the window
+    for _ in range(16):
+        tr.finish(tr.begin(reqtrace.mint_context(), model="m"), "ok")
+    slow = tr.begin(reqtrace.mint_context(), model="m")
+    slow["ts"] -= 2e6  # backdate 2 s: far past any live p95
+    assert tr.finish(slow, "ok") is True
+    srow = [r for r in tr.drain_rows() if r["k"] == "r"][0]
+    assert "slow" in srow["why"]
+    # 4) head-sample flag minted into the context is honored
+    rec = tr.begin(reqtrace.mint_context(sampled=True), model="m")
+    assert tr.finish(rec, "ok") is True
+    assert "sampled" in [r for r in tr.drain_rows()
+                         if r["k"] == "r"][0]["why"]
+
+
+def test_outcome_mapping_walks_mro():
+    class SubQueueFull(QueueFullError):
+        pass
+    assert reqtrace.outcome_of(SubQueueFull("x")) == "queue_full"
+    assert reqtrace.outcome_of(TimeoutError()) == "timeout"
+    assert reqtrace.outcome_of(ValueError("?")) == "error"
+
+
+def test_bounded_buffers_account_drops_under_flood():
+    tr = reqtrace.RequestTracer(head_sample=1.0, max_pending=64,
+                                max_rows=128, flush_every=10 ** 9)
+    # span flood across many traces that never finish: the pending
+    # bound evicts oldest traces wholesale, with accounting
+    for i in range(300):
+        ctx = reqtrace.mint_context()
+        tr.stage(ctx, "queue", 0.0, 1.0)
+    st = tr.stats()
+    assert st["pending_spans"] <= 64
+    assert st["dropped_spans"] >= 300 - 64
+    # captured-row flood: the shard bound drops whole requests, counted
+    for i in range(300):
+        tr.finish(tr.begin(reqtrace.mint_context(sampled=True),
+                           model="m"), "ok")
+    st = tr.stats()
+    assert st["buffered_rows"] <= 128
+    assert st["dropped_rows"] > 0
+    assert st["finished"] == 300
+    # the tracer never threw and still works
+    rec = tr.begin(reqtrace.mint_context(sampled=True), model="m")
+    tr.drain_rows()
+    assert tr.finish(rec, "ok") is True
+
+
+# -- assembly -----------------------------------------------------------------
+
+def _row(k, proc, span, ts_us, dur_us, **kw):
+    base = {"k": k, "trace": "t" * 16, "span": span, "ts": ts_us,
+            "dur": dur_us, "pid": 1, "proc": proc}
+    if k == "r":
+        base.update(root=False, model="m", transport="binary",
+                    outcome="ok", why=["sampled"], stages={})
+    else:
+        base.update(name="wire:binary", kind="client")
+    base.update(kw)
+    return base
+
+
+def test_clock_offsets_recover_synthetic_skew():
+    """Server clock skewed +500 ms: the matched wire hop's midpoint
+    alignment recovers the offset, and the assembled Chrome trace nests
+    the server row inside the client span on one normalized timeline."""
+    skew = 500_000.0
+    client_req = _row("r", "router", "aaaa", 1_000.0, 60_000.0,
+                      root=True, transport="router",
+                      stages={"queue": 1.0})
+    wire = _row("s", "router", "bbbb", 5_000.0, 50_000.0)
+    server_req = _row("r", "replica", "bbbb", 10_000.0 + skew, 40_000.0,
+                      stages={"forward": 35.0, "queue": 2.0})
+    rows = [client_req, wire, server_req]
+    offs = reqtrace.clock_offsets(rows)
+    assert offs["router"] == 0.0
+    # off[replica] = mid(client span) - mid(server row) = 30000 - 530000
+    assert offs["replica"] == pytest.approx(-skew, abs=1.0)
+    ch = reqtrace.chrome_trace("t" * 16, rows, offs)
+    evs = {(e["pid"], e["tid"]): e for e in ch["traceEvents"]
+           if e["ph"] == "X"}
+    assert len({pid for pid, _ in evs}) == 2
+    # normalized: the server row starts AFTER the wire span starts and
+    # ends before it ends, despite the raw +500 ms skew
+    srv_ev = [e for e in ch["traceEvents"] if e["ph"] == "X"
+              and e["args"].get("transport") == "binary"][0]
+    wire_ev = [e for e in ch["traceEvents"] if e["ph"] == "X"
+               and e["name"] == "wire:binary"][0]
+    assert wire_ev["ts"] <= srv_ev["ts"]
+    assert (srv_ev["ts"] + srv_ev["dur"]
+            <= wire_ev["ts"] + wire_ev["dur"] + 1.0)
+    s = reqtrace.trace_summary("t" * 16, rows, offs)
+    assert s["procs"] == 2 and s["hops"] == 1
+    assert s["forward_ms"] == pytest.approx(35.0)
+    assert s["queue_ms"] == pytest.approx(3.0)
+    # wire = client wait minus the server's own time
+    assert s["wire_ms"] == pytest.approx(10.0)
+    assert s["total_ms"] == pytest.approx(60.0)
+    assert s["dominant"] == "forward"
+
+
+def test_shard_roundtrip_and_tolerant_loader(tmp_path):
+    tr = reqtrace.RequestTracer(out_dir=str(tmp_path), head_sample=1.0,
+                                proc="p/1")  # sanitized in filename
+    ctx = tr.mint(sampled=True)
+    rec = tr.begin(ctx, transport="http", model="m")
+    tr.stage(ctx, "queue", rec["ts"], 10.0)
+    tr.finish(rec, "ok")
+    path = tr.flush()
+    assert path and path.endswith(".jsonl") and "/" not in path.split(
+        "trace-")[1]
+    with open(path, "a") as f:
+        f.write("not json\n{\"k\": \"junk\"}\n")
+    rows = reqtrace.load_shards([str(tmp_path)])
+    assert {r["k"] for r in rows} == {"r", "s"}
+    asm = reqtrace.assemble(rows)
+    assert ctx.trace_id in asm
+    assert asm[ctx.trace_id]["summary"]["queue_ms"] == pytest.approx(
+        0.01)
+    # the console table renders without a live tracer
+    table = reqtrace.format_slowest(
+        [t["summary"] for t in asm.values()])
+    assert ctx.trace_id in table
+
+
+# -- the live two-process acceptance run -------------------------------------
+
+def test_two_process_slow_request_assembles_one_trace(tmp_path):
+    """The PR's acceptance path, live: a router here proxies a
+    deliberately slowed request over the binary wire to a replica
+    subprocess; both processes shard spans; `sparknet-trace` assembly
+    must produce ONE trace crossing both processes with a matched wire
+    hop and the queue/formation/forward breakdown. (This is exactly
+    what `sparknet-trace --selfcheck` runs in CI.)"""
+    keep = str(tmp_path / "selfcheck")
+    assert reqtrace._selfcheck(keep=keep, delay_ms=40.0) == 0
+    rows = reqtrace.load_shards([keep + "/shards"])
+    traces = reqtrace.assemble(rows)
+    crossing = [t for t in traces.values()
+                if t["summary"]["procs"] >= 2]
+    assert crossing
+    s = max(crossing, key=lambda t: t["summary"]["total_ms"])["summary"]
+    assert s["hops"] >= 1
+    assert s["forward_ms"] >= 20.0  # the planted 40 ms delay dominates
+    assert s["dominant"] == "forward"
