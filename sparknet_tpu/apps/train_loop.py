@@ -483,6 +483,19 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
                 log.log(f"train status server at "
                         f"http://{status_srv.address[0]}:"
                         f"{status_srv.address[1]}/metrics")
+    # the SLO ledger's history sampler: the training process gets the
+    # same /timeseries surface serve and router processes get, plus
+    # JSONL shards for `sparknet-slo` retrospective reports
+    history = None
+    if cfg.history and registry is not None:
+        from ..obs.history import HistoryConfig, MetricsHistory
+        history = MetricsHistory(
+            registry,
+            HistoryConfig(sample_interval_s=cfg.history_interval_s,
+                          persist_dir=cfg.history_dir),
+            logger=log).start()
+        if status_srv is not None:
+            history.attach_http(status_srv)
     # worker 0 additionally serves the POD view over the shared heartbeat
     # prefix: merged /metrics + /pod/status with straggler attribution
     pod_srv = None
@@ -1179,6 +1192,8 @@ def run_loop(cfg: RunConfig, trainer, train_ds: ArrayDataset,
             # re-raising: the port must unbind and the process-global
             # tracer must uninstall (a leaked active tracer would keep
             # swallowing every later span in this process)
+            if history is not None:
+                history.stop()
             if status_srv is not None:
                 status_srv.stop()
             if pod_srv is not None:

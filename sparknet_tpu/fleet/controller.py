@@ -75,6 +75,12 @@ class FleetConfig:
     down_cooldown_s: float = 20.0   # min gap between shrinks (per model)
     # fallback objective for lanes without ServeConfig.slo_p99_ms
     slo_p99_ms: Optional[float] = None
+    # admission-pressure floor while a burn-rate PAGE is firing (the
+    # SLO alerter, attach_alerter): the fast lever jumps ahead of the
+    # slow replica lever the moment the ledger pages — never below what
+    # the burn signal already asks for, and still subject to the batch-
+    # starvation relief clamp
+    page_pressure: float = 0.9
     replace_dead: bool = True
     status_row_every: int = 10      # fleet_replicas JSONL cadence, ticks
     policy: FleetPolicy = field(default_factory=FleetPolicy)
@@ -97,6 +103,9 @@ class FleetConfig:
                 f"({self.pool_min})")
         if self.dead_ticks < 1:
             raise ValueError("dead_ticks must be >= 1")
+        if not 0.0 <= self.page_pressure <= 1.0:
+            raise ValueError(f"page_pressure must be in [0, 1] "
+                             f"(got {self.page_pressure})")
         if isinstance(self.policy, dict):
             self.policy = FleetPolicy(**self.policy)
 
@@ -150,6 +159,10 @@ class FleetController:
             "continuously admission-shed with nothing admitted")
         self._g_starvation.set(0.0)
         self._batch_relieving = False  # audit edge detector
+        # SLO burn-rate alerter (attach_alerter): firing pages escalate
+        # the fast lever; edge-detected for the audit trail
+        self.alerter = None
+        self._page_escalating = False
         self._state: Dict[str, _ModelState] = {}
         # provider-grown replicas: model -> [(router Replica, handle)]
         self._owned: Dict[str, List[Tuple[Any, ReplicaHandle]]] = {}
@@ -219,6 +232,12 @@ class FleetController:
                 self.provider.stop()
             except Exception as e:
                 self._log(f"fleet: provider stop failed: {e}")
+
+    def attach_alerter(self, alerter) -> "FleetController":
+        """Wire a `BurnRateAlerter`: its `firing_pages()` becomes a fast
+        admission-pressure input each tick (cfg.page_pressure)."""
+        self.alerter = alerter
+        return self
 
     def __enter__(self) -> "FleetController":
         return self.start()
@@ -318,6 +337,22 @@ class FleetController:
         # fast lever: admission pressure, every tick, no hysteresis —
         # shedding low-priority load is cheap and instantly reversible
         self.pressure = self.policy.pressure_from_burn(burn_max)
+        # SLO page escalation: a firing burn-rate page floors the fast
+        # lever at page_pressure IMMEDIATELY, ahead of the replica
+        # lever's cooldowns — only admission, never the hysteresis-
+        # guarded levers, and the batch-relief clamp below still wins
+        pages = self.alerter.firing_pages() if self.alerter is not None \
+            else []
+        if pages and self.cfg.page_pressure > self.pressure:
+            if not self._page_escalating:
+                self._page_escalating = True
+                self._event("_slo", "pressure", "slo_page",
+                            models=",".join(pages),
+                            pressure=round(self.pressure, 4),
+                            escalated=self.cfg.page_pressure)
+            self.pressure = self.cfg.page_pressure
+        elif not pages:
+            self._page_escalating = False
         # scavenger relief: sustained pressure must not weld the door
         # shut on the low class forever. Past the policy's starvation
         # bound the pressure is clamped just under low's shed threshold

@@ -34,6 +34,14 @@ Since the pod PR, two more layers sit on top:
 `sparknet_build_info` gauge with provenance; `summary` is the
 `sparknet-metrics` JSONL reader.
 
+The SLO ledger (`history` + `slo`) makes the registry answerable
+RETROSPECTIVELY: `MetricsHistory` samples it into multi-resolution ring
+buffers (+ JSONL shards, the /timeseries route), and `BurnRateAlerter`
+evaluates declarative `SloSpec` objectives over the rings with
+multi-window multi-burn-rate rules — firing/resolved edge alerts on
+/slo/status, in the fleet controller's fast lever, and in the
+`sparknet-slo` retrospective reports.
+
 `reqtrace` is the DISTRIBUTED counterpart of `trace`: per-REQUEST spans
 keyed by a trace context that crosses process boundaries (X-Trace-Id on
 HTTP, the REQUEST-meta trace field on the binary wire), tail-sampled and
@@ -49,6 +57,8 @@ from .trace import (Tracer, active_tracer, span, start_tracing,
 from .device import (DeviceTelemetry, attach_compile_metrics, compile_stats,
                      note_compile, timed_compile)
 from .pod import PodAggregator, WorkerView, flag_stragglers
+from .history import (HistoryConfig, MetricsHistory, read_history_shards)
+from .slo import BurnRateAlerter, SloSpec, build_report
 # reqtrace LAST: it leans on utils.metrics, which imports obs.trace —
 # importing it earlier would re-enter this package mid-init
 from . import reqtrace
@@ -64,6 +74,8 @@ __all__ = [
     "DeviceTelemetry", "attach_compile_metrics", "compile_stats",
     "note_compile", "timed_compile",
     "PodAggregator", "WorkerView", "flag_stragglers",
+    "HistoryConfig", "MetricsHistory", "read_history_shards",
+    "BurnRateAlerter", "SloSpec", "build_report",
     "RequestTracer", "TraceContext", "mint_context", "parse_context",
     "request_tracing", "start_request_tracing", "stop_request_tracing",
     "reqtrace",

@@ -29,6 +29,26 @@ from .registry import MetricsRegistry
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
+def _normalize_route(fn: Callable[..., Dict[str, Any]]
+                     ) -> Callable[[str], Dict[str, Any]]:
+    """Route callables come in two arities: zero-arg (the original
+    contract, e.g. /pod/status) and one-arg taking the request path so
+    query strings reach the handler (e.g. /timeseries?name=...). Decide
+    ONCE at registration — dispatch must not guess with try/TypeError,
+    which would swallow genuine TypeErrors inside the handler."""
+    import inspect
+    try:
+        params = [p for p in inspect.signature(fn).parameters.values()
+                  if p.default is p.empty
+                  and p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+        takes_path = len(params) >= 1
+    except (TypeError, ValueError):  # builtins / C callables: assume 0-arg
+        takes_path = False
+    if takes_path:
+        return fn
+    return lambda _path, _fn=fn: _fn()
+
+
 class StatusServer:
     """Threaded HTTP server for /metrics, /healthz, /status."""
 
@@ -52,15 +72,24 @@ class StatusServer:
         self.status = status
         self.metrics_text = metrics_text
         # longest prefix first so /pod/status cannot be shadowed by /pod
-        self.routes = sorted((routes or {}).items(),
-                             key=lambda kv: -len(kv[0]))
+        self.routes = sorted(
+            ((p, _normalize_route(fn)) for p, fn in (routes or {}).items()),
+            key=lambda kv: -len(kv[0]))
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib casing)
                 try:
                     for prefix, fn in owner.routes:
                         if self.path.startswith(prefix):
-                            self._reply(200, json.dumps(fn()))
+                            try:
+                                body = fn(self.path)
+                            except ValueError as e:
+                                # bad query params (e.g. /timeseries with an
+                                # unknown metric) are the caller's fault
+                                self._reply(400,
+                                            json.dumps({"error": str(e)}))
+                                return
+                            self._reply(200, json.dumps(body))
                             return
                     if self.path.startswith("/metrics"):
                         if owner.metrics_text is not None:
@@ -106,6 +135,19 @@ class StatusServer:
         self._thread = threading.Thread(target=self._http.serve_forever,
                                         name="obs-status", daemon=True)
         self._thread.start()
+
+    def add_route(self, prefix: str,
+                  fn: Callable[..., Dict[str, Any]]) -> None:
+        """Register an extra JSON GET endpoint after construction — the
+        history/SLO layers attach to an already-running server this way.
+        `fn` may take zero arguments, or one (the full request path,
+        query string included) for routes that parse `?name=...` params;
+        a ValueError raised by the route maps to a 400 reply."""
+        routes = [kv for kv in self.routes if kv[0] != prefix]
+        routes.append((prefix, _normalize_route(fn)))
+        # rebuilt then swapped atomically: the handler thread iterates
+        # whatever list object it read, never a half-sorted one
+        self.routes = sorted(routes, key=lambda kv: -len(kv[0]))
 
     @property
     def address(self) -> Tuple[str, int]:

@@ -708,6 +708,14 @@ def format_pod_table(status: Dict[str, Any]) -> str:
                 parts.append(
                     f"slow={sr['ms']}ms@{sr.get('stage', '-')}"
                     f"[{str(sr.get('trace', ''))[:8]}]")
+            # the SLO ledger's per-replica slice: error budget left and
+            # any FIRING alert (model:objective:severity), so a burning
+            # page is visible from the pod table without a /slo/status
+            # round-trip per replica
+            if m.get("slo_budget_remaining") is not None:
+                parts.append(f"budget={m['slo_budget_remaining']}")
+            if m.get("slo_firing"):
+                parts.append("SLO:" + ",".join(m["slo_firing"]))
             lines.append(f"    └ {' '.join(parts)}")
     log = status.get("straggler_log") or []
     if log:

@@ -53,7 +53,10 @@ from contextlib import contextmanager
 from dataclasses import dataclass, replace
 from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
 
-from ..utils.metrics import LatencyStats
+# LatencyStats is imported where it is constructed, not here: utils.metrics
+# itself imports obs.trace (which runs this package's __init__, which imports
+# this module) — a module-level import completes that cycle and breaks any
+# process that touches sparknet_tpu.utils before sparknet_tpu.obs.
 
 # -- trace context -----------------------------------------------------------
 
@@ -268,6 +271,7 @@ class RequestTracer:
             self._pending_n -= len(spans)
             lat = self._lat.get(rec["model"])
             if lat is None:
+                from ..utils.metrics import LatencyStats
                 lat = self._lat[rec["model"]] = LatencyStats(window=2048)
         # the threshold is read BEFORE adding this observation: "beyond
         # the live p95" means beyond the distribution as it stood

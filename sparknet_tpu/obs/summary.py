@@ -25,6 +25,11 @@ the POD view below; `--keep DIR` retains the artifacts for CI upload.
 adds the scale-event audit trail and the per-model replica count over
 time — the post-hoc answer to "when did the fleet grow, and why".
 
+**SLO view**: when the records carry the burn-rate alerter's edge rows
+(`event="slo_alert"` — firing/resolved, burn multiples, full-window
+attainment at edge time), the summary adds per-model attainment, the
+set of alerts still firing at end-of-record, and the alert audit trail.
+
 **Pod view**: when the merged records span >= 2 workers (the `worker`
 field every multi-host run stamps, falling back to one-file-per-worker
 input order), the summary adds a per-worker step-time breakdown table
@@ -42,6 +47,7 @@ import math
 import os
 import shutil
 import sys
+import time
 from typing import Any, Dict, List, Optional
 
 from .pod import flag_stragglers
@@ -135,6 +141,9 @@ def summarize(recs: List[Dict[str, Any]], tail: int = 10) -> Dict[str, Any]:
     batch = _batch_view(recs)
     if batch is not None:
         out["batch"] = batch
+    slo = _slo_view(recs)
+    if slo is not None:
+        out["slo"] = slo
     return out
 
 
@@ -255,6 +264,45 @@ def _batch_view(recs: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
         "retries_by_kind": dict(sorted(by_kind.items())),
         "units_by_replica": dict(sorted(by_replica.items())),
         "jobs": jobs,
+    }
+
+
+def _slo_view(recs: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """The SLO ledger's record: burn-rate alert EDGES
+    (`event="slo_alert"` rows — model, objective, severity,
+    firing/resolved, burn multiples, full-window attainment at edge
+    time) aggregated into per-model attainment, the set of alerts
+    still firing at end-of-record, and the audit trail. None when the
+    records carry no alert rows."""
+    edges = [r for r in recs if r.get("event") == "slo_alert"]
+    if not edges:
+        return None
+    by_kind: Dict[str, int] = {}
+    last_edge: Dict[tuple, Dict[str, Any]] = {}
+    models: Dict[str, Any] = {}
+    for e in edges:
+        key = f"{e.get('severity', '?')}/{e.get('edge', '?')}"
+        by_kind[key] = by_kind.get(key, 0) + 1
+        k = (str(e.get("model", "?")), str(e.get("objective", "?")),
+             str(e.get("severity", "?")))
+        last_edge[k] = e
+        m = models.setdefault(k[0], {"edges": 0, "pages": 0,
+                                     "attainment": {}})
+        m["edges"] += 1
+        if e.get("severity") == "page" and e.get("edge") == "firing":
+            m["pages"] += 1
+        if e.get("attainment") is not None:
+            m["attainment"][k[1]] = e["attainment"]  # last edge wins
+    firing = sorted(":".join(k) for k, e in last_edge.items()
+                    if e.get("edge") == "firing")
+    return {
+        "alert_edges": len(edges),
+        "edges_by_kind": dict(sorted(by_kind.items())),
+        "firing_at_end": firing,
+        "models": models,
+        "audit": [{k: v for k, v in e.items()
+                   if k not in ("t", "ts", "event", "step")}
+                  for e in edges[-20:]],
     }
 
 
@@ -500,6 +548,31 @@ def format_text(s: Dict[str, Any]) -> str:
                 f"{j.get('units_done')}/{j.get('units_total')}  "
                 f"{j.get('rows_per_s')} rows/s"
                 + (f"  ${cpm}/M embeddings" if cpm is not None else ""))
+    slo = s.get("slo")
+    if slo:
+        lines.append("")
+        firing = (", ".join(slo["firing_at_end"])
+                  if slo["firing_at_end"] else "none")
+        lines.append(f"slo view ({slo['alert_edges']} alert edges; "
+                     f"firing at end: {firing}):")
+        for m, row in sorted(slo["models"].items()):
+            att = "  ".join(f"{obj}={v:.4f}" for obj, v
+                            in sorted(row["attainment"].items()))
+            lines.append(f"  model {m}: {row['edges']} edges  "
+                         f"{row['pages']} pages"
+                         + (f"  attainment {att}" if att else ""))
+        if slo["edges_by_kind"]:
+            kinds = "  ".join(f"{k}={n}" for k, n
+                              in slo["edges_by_kind"].items())
+            lines.append(f"  edges: {kinds}")
+        for e in slo["audit"]:
+            rest = " ".join(f"{k}={v}" for k, v in e.items()
+                            if k not in ("model", "objective",
+                                         "severity", "edge"))
+            lines.append(f"    {e.get('model', '?')}: "
+                         f"{e.get('severity', '?')} "
+                         f"{e.get('edge', '?')} "
+                         f"({e.get('objective', '?')}) {rest}".rstrip())
     if s["event_trail"]:
         lines.append("")
         lines.append("health/event audit trail:")
@@ -560,7 +633,49 @@ def _selfcheck_jsonl(n_workers: int = 1,
     paths.append(_selfcheck_serve_jsonl(root))
     paths.append(_selfcheck_fleet_jsonl(root))
     paths.append(_selfcheck_batch_jsonl(root))
+    paths.append(_selfcheck_slo_jsonl(root))
     return paths
+
+
+def _selfcheck_slo_jsonl(root: str) -> str:
+    """Drive a real MetricsHistory + BurnRateAlerter through a
+    quiet->burn->recovery traffic shape on an injected clock and return
+    the alert JSONL it wrote — so the SLO view (attainment + the
+    firing/resolved alert audit trail) cannot rot against the
+    alerter's live record schema without failing the selfcheck."""
+    import os
+
+    from .history import HistoryConfig, MetricsHistory
+    from .registry import MetricsRegistry
+    from .slo import LATENCY_METRIC, REQUESTS_METRIC, BurnRateAlerter, SloSpec
+    from ..utils.logger import Logger
+
+    jsonl = os.path.join(root, "selfcheck_slo_metrics.jsonl")
+    log = Logger(os.path.join(root, "selfcheck_slo_log.txt"),
+                 echo=False, jsonl_path=jsonl)
+    reg = MetricsRegistry()
+    lat = reg.histogram(LATENCY_METRIC, labels=("model",))
+    req = reg.counter(REQUESTS_METRIC, labels=("model", "outcome"))
+    hist = MetricsHistory(reg, HistoryConfig(
+        sample_interval_s=1.0, rings=((1.0, 600),)))
+    spec = SloSpec(model="slo_demo", latency_ms=50.0, availability=0.99,
+                   window_s=120.0, fast_burn=8.0, fast_window_s=10.0,
+                   fast_confirm_s=2.0, slow_burn=2.0, slow_window_s=60.0,
+                   slow_confirm_s=10.0)
+    alerter = BurnRateAlerter(hist, [spec], registry=reg, logger=log)
+    t0 = time.time()
+    try:
+        for i in range(45):
+            burning = 15 <= i < 30
+            for _ in range(20):
+                lat.observe(0.2 if burning else 0.005, model="slo_demo")
+                req.inc(model="slo_demo",
+                        outcome="failed" if burning else "ok")
+            hist.sample_now(now=t0 + i)
+            alerter.evaluate(now=t0 + i)
+    finally:
+        log.close()
+    return jsonl
 
 
 def _selfcheck_batch_jsonl(root: str) -> str:
@@ -799,6 +914,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.selfcheck and not (s.get("batch") or {}).get("units"):
         print("selfcheck: batch run produced no unit-commit rows "
               "(the batch view's input)", file=sys.stderr)
+        return 1
+    if args.selfcheck and not (s.get("slo") or {}).get("alert_edges"):
+        print("selfcheck: burn drive produced no slo_alert edges "
+              "(the SLO view's input)", file=sys.stderr)
         return 1
     return 0
 

@@ -174,7 +174,21 @@ def main(argv=None) -> None:
     p.add_argument("--max-wait-ms", type=float, default=5.0)
     p.add_argument("--slo-p99-ms", type=float, default=None,
                    help="advisory per-model p99 objective (stamped into "
-                   "/status and BENCH_SERVE rows)")
+                   "/status and BENCH_SERVE rows); with --history it "
+                   "becomes a LIVE latency SLO the burn-rate alerter "
+                   "pages on")
+    p.add_argument("--slo-availability", type=float, default=None,
+                   metavar="FRAC",
+                   help="availability objective (e.g. 0.999) evaluated "
+                   "by the burn-rate alerter (needs --history)")
+    p.add_argument("--history", action="store_true",
+                   help="run the SLO ledger: metrics-history sampler "
+                   "(multi-resolution rings, /timeseries route) and — "
+                   "when an objective is declared — the burn-rate "
+                   "alerter (/slo/status, fleet page escalation)")
+    p.add_argument("--history-dir", default=None, metavar="DIR",
+                   help="persist append-only history shards here "
+                   "(`sparknet-slo DIR` builds retrospective reports)")
     p.add_argument("--buckets", default=None,
                    help="comma-separated batch buckets (default: powers "
                    "of 2 up to max-batch)")
@@ -363,7 +377,8 @@ def main(argv=None) -> None:
         return ServeConfig(
             model_name=name, max_batch=args.max_batch,
             max_wait_ms=args.max_wait_ms, buckets=lane_buckets,
-            slo_p99_ms=args.slo_p99_ms, outputs=outputs,
+            slo_p99_ms=args.slo_p99_ms,
+            slo_availability=args.slo_availability, outputs=outputs,
             checkpoint_dir=checkpoint_dir,
             poll_interval_s=args.poll_interval,
             poll_jitter=args.poll_jitter,
@@ -456,7 +471,9 @@ def main(argv=None) -> None:
                              heartbeat_every_s=args.heartbeat_every,
                              hedge=args.hedge,
                              hedge_budget=args.hedge_budget,
-                             coalesce=args.coalesce),
+                             coalesce=args.coalesce,
+                             history=args.history,
+                             history_dir=args.history_dir),
                 logger=log)
             if tenants is not None:
                 # hedging reads the admission door's pressure: a
@@ -476,6 +493,10 @@ def main(argv=None) -> None:
             with router:
                 frontends = make_frontends(router)
                 if fleet is not None:
+                    if router.alerter is not None:
+                        # the ledger's firing pages become the fleet's
+                        # fast admission-pressure input
+                        fleet.attach_alerter(router.alerter)
                     fleet.start()
                 try:
                     _serve_until_done(router.status, args, log,
@@ -494,6 +515,8 @@ def main(argv=None) -> None:
         cfg.status_port = args.status_port
         cfg.heartbeat_path = args.heartbeat
         cfg.heartbeat_every_s = args.heartbeat_every
+        cfg.history = args.history
+        cfg.history_dir = args.history_dir
         server = InferenceServer(net, cfg, logger=log)
         with server:
             frontends = make_frontends(server)

@@ -233,6 +233,14 @@ class RouterConfig:
     heartbeat_path: Optional[str] = None
     heartbeat_every_s: float = 10.0
     registry: Optional[MetricsRegistry] = None
+    # SLO ledger over the SHARED registry: one history sampler for every
+    # lane (the /timeseries route), one burn-rate alerter holding a
+    # SloSpec per lane that declares an objective (slo_p99_ms /
+    # slo_availability on its ServeConfig) — /slo/status, the slo status
+    # section, and the fleet controller's page escalation
+    history: bool = False
+    history_dir: Optional[str] = None
+    history_interval_s: float = 1.0
 
 
 class ModelRouter:
@@ -275,6 +283,9 @@ class ModelRouter:
         self._proxy: Optional[ThreadPoolExecutor] = None
         self._running = False
         self._http = None
+        # SLO ledger handles (started with the router when cfg.history)
+        self.history = None
+        self.alerter = None
         self.fleet = None  # FleetController attaches here (attach_fleet)
         # PriorityAdmission attaches here (attach_admission): its
         # .pressure gates hedging — no extra copies under overload
@@ -427,7 +438,42 @@ class ModelRouter:
                 host=self.cfg.status_host,
                 healthz=self._healthz, status=self.status,
                 routes={"/fleet/status": self._fleet_status})
+        if self.cfg.history:
+            self._start_history()
         return self
+
+    def _start_history(self) -> None:
+        """One SLO ledger for the whole router: the shared registry's
+        `model` labels keep lanes apart, so a single history + alerter
+        covers every lane (specs from each lane's declared objectives)."""
+        from ..obs.history import HistoryConfig, MetricsHistory
+        from ..obs.slo import SloSpec, BurnRateAlerter
+        self.history = MetricsHistory(
+            self.registry,
+            HistoryConfig(sample_interval_s=self.cfg.history_interval_s,
+                          persist_dir=self.cfg.history_dir),
+            logger=self.log)
+        specs = []
+        for name, lane in sorted(self.lanes.items()):
+            if lane.cfg.slo_spec is not None:
+                specs.append(lane.cfg.slo_spec)
+            elif lane.cfg.slo_p99_ms is not None or \
+                    lane.cfg.slo_availability is not None:
+                specs.append(SloSpec(
+                    model=name, latency_ms=lane.cfg.slo_p99_ms,
+                    availability=lane.cfg.slo_availability))
+        if specs:
+            self.alerter = BurnRateAlerter(self.history, specs,
+                                           logger=self.log).attach()
+            for name in (s.model for s in specs):
+                lane = self.lanes.get(name)
+                if lane is not None:
+                    lane.alerter = self.alerter  # model_row slo fields
+        if self._http is not None:
+            self.history.attach_http(self._http)
+            if self.alerter is not None:
+                self.alerter.attach_http(self._http)
+        self.history.start()
 
     def attach_fleet(self, controller) -> None:
         """Bind a FleetController: /fleet/status starts answering with
@@ -506,6 +552,10 @@ class ModelRouter:
         if self._proxy is not None:
             self._proxy.shutdown(wait=False)
             self._proxy = None
+        if self.history is not None:
+            self.history.stop()
+            self.history = None
+            self.alerter = None
         if self._http is not None:
             self._http.stop()
             self._http = None
@@ -1085,6 +1135,8 @@ class ModelRouter:
                         for m, c in self._hedge_counts.items()},
             "autoscale": self.fleet is not None,
         }
+        if self.alerter is not None:
+            out["slo"] = self.alerter.summary()
         rt = reqtrace.active()
         if rt is not None:
             ex = rt.exemplars()

@@ -215,6 +215,21 @@ class ServeConfig:
     # co-located components; None = a fresh per-server instance.
     status_port: Optional[int] = None   # None = no HTTP; 0 = ephemeral
     status_host: str = "127.0.0.1"      # "0.0.0.0" for cross-host scrapes
+    # SLO ledger (obs/history.py + obs/slo.py). history=True runs the
+    # metrics-history sampler thread (multi-resolution rings, the
+    # /timeseries route) and — when an objective is declared — the
+    # burn-rate alerter (/slo/status, slo section in /status, fleet
+    # page escalation). history_dir persists append-only JSONL shards
+    # `sparknet-slo` reports from (None = rings only, no disk).
+    history: bool = False
+    history_dir: Optional[str] = None
+    history_interval_s: float = 1.0
+    # availability objective (fraction of requests answered "ok", e.g.
+    # 0.999); pairs with slo_p99_ms (the latency objective) to form this
+    # lane's SloSpec. slo_spec overrides both with a full obs.slo.SloSpec
+    # (custom burn windows).
+    slo_availability: Optional[float] = None
+    slo_spec: Optional[Any] = None
     heartbeat_path: Optional[str] = None
     heartbeat_every_s: float = 10.0
     metrics_every_batches: int = 50     # JSONL cadence (0 = off)
@@ -248,6 +263,13 @@ class ServeConfig:
                     f"{self.max_batch}: a full batch would have no "
                     f"bucket")
             self.buckets = b
+        if self.history_interval_s <= 0:
+            raise ValueError(f"history_interval_s must be > 0 "
+                             f"(got {self.history_interval_s})")
+        if self.slo_availability is not None \
+                and not 0.0 < self.slo_availability < 1.0:
+            raise ValueError(f"slo_availability must be in (0, 1) "
+                             f"(got {self.slo_availability})")
         # "int8" / dict / QuantConfig -> QuantConfig (validates knobs)
         self.quant = QuantConfig.coerce(self.quant)
 
@@ -362,6 +384,12 @@ class InferenceServer:
         self._duty_s = max(min(duties), 1e-3)
         self._worker: Optional[threading.Thread] = None
         self._http = None
+        # SLO ledger handles (started with the server when cfg.history)
+        self.history = None
+        self.alerter = None
+        # per-example input schema, resolved lazily at the submit door
+        # (shape validation); None until the first submit
+        self._input_specs = None
         self._running = False
         self._last_tick = 0.0
 
@@ -393,9 +421,42 @@ class InferenceServer:
                     raise ValueError(
                         f"unknown output blob(s) {bad!r} "
                         f"(net has {sorted(known)})")
+        self._validate_payload(payload)
         return self.batcher.submit(payload, deadline_s=deadline_s,
                                    priority=priority, outputs=outputs,
                                    trace=trace)
+
+    def _validate_payload(self, payload: Dict[str, Any]) -> None:
+        """Reject a mis-shaped or unknown-field example AT THE DOOR with
+        a ValueError (the frontends' typed-400 ladder), before it can
+        enter a batch. Previously a wrong per-example shape survived to
+        the pre-sized pad path, where `np.stack(rows, out=buf[:n])` blew
+        up the WHOLE signature group with an opaque "Output array is the
+        wrong shape" — a client bug surfacing as a server-side 500.
+        Skipped when a preprocessor is configured: raw pixel shapes
+        legitimately differ from the net's input schema until decode."""
+        if self.preprocessor is not None:
+            return
+        specs = self._input_specs
+        if specs is None:
+            try:
+                specs = net_input_specs(self.net)
+            except Exception:
+                specs = {}  # net without introspection: can't validate
+            self._input_specs = specs
+        if not specs:
+            return
+        for k, v in payload.items():
+            spec = specs.get(k)
+            if spec is None:
+                raise ValueError(
+                    f"request field {k!r} is not a net input "
+                    f"(net has {sorted(specs)})")
+            shape = tuple(np.shape(v))
+            if shape != spec[0]:
+                raise ValueError(
+                    f"request field {k!r} has per-example shape "
+                    f"{shape}, net input wants {spec[0]}")
 
     def _known_blobs(self) -> Optional[set]:
         """The net's nameable blobs, or None when the backend can't
@@ -436,7 +497,34 @@ class InferenceServer:
             self._worker.start()
         if self.cfg.status_port is not None:
             self._start_http(self.cfg.status_port)
+        if self.cfg.history:
+            self._start_history()
         return self
+
+    def _start_history(self) -> None:
+        """The SLO ledger: history sampler (+ alerter when an objective
+        is declared), attached to the status server when one is up."""
+        from ..obs.history import HistoryConfig, MetricsHistory
+        from ..obs.slo import SloSpec, BurnRateAlerter
+        self.history = MetricsHistory(
+            self.registry,
+            HistoryConfig(sample_interval_s=self.cfg.history_interval_s,
+                          persist_dir=self.cfg.history_dir),
+            logger=self.log)
+        spec = self.cfg.slo_spec
+        if spec is None and (self.cfg.slo_p99_ms is not None
+                             or self.cfg.slo_availability is not None):
+            spec = SloSpec(model=self.model_name,
+                           latency_ms=self.cfg.slo_p99_ms,
+                           availability=self.cfg.slo_availability)
+        if spec is not None:
+            self.alerter = BurnRateAlerter(self.history, [spec],
+                                           logger=self.log).attach()
+        if self._http is not None:
+            self.history.attach_http(self._http)
+            if self.alerter is not None:
+                self.alerter.attach_http(self._http)
+        self.history.start()
 
     def stop(self, drain_s: float = 5.0) -> None:
         """Stop accepting work, serve what's already queued (bounded by
@@ -457,6 +545,10 @@ class InferenceServer:
         if self.log is not None and self.fill.batches and \
                 self.cfg.metrics_every_batches:
             self._log_metrics_row()
+        if self.history is not None:
+            self.history.stop()
+            self.history = None
+            self.alerter = None
         if self._http is not None:
             self._http.stop()
             self._http = None
@@ -519,6 +611,9 @@ class InferenceServer:
         }
         if self.cfg.slo_p99_ms is not None:
             out["slo_p99_ms"] = self.cfg.slo_p99_ms
+        if self.alerter is not None:
+            # the ledger's live slice: firing alerts + budget left
+            out["slo"] = self.alerter.summary()
         out.update(self.latency.summary())
         # recent worst captured requests (trace_id, total ms, dominant
         # stage): "p99 is burning" -> the exact trace in two steps. Reads
@@ -636,6 +731,16 @@ class InferenceServer:
             worst = rt.worst(self.model_name)
             if worst is not None:
                 row["slow_request"] = worst
+        if self.alerter is not None:
+            s = self.alerter.summary()
+            # a router-shared alerter carries every lane's alerts: keep
+            # only THIS model's on its row
+            row["slo_firing"] = [
+                f for f in s["firing"]
+                if f.startswith(f"{self.model_name}:")]
+            br = s["budget_remaining"].get(self.model_name)
+            if br is not None:
+                row["slo_budget_remaining"] = round(br, 4)
         return row
 
     def fill_signal(self) -> Optional[float]:
@@ -713,6 +818,16 @@ class InferenceServer:
                     # input): stack on the side, let assignment cast —
                     # the slow path the old concat always paid
                     dst[:n] = np.stack(rows)
+                except ValueError as e:
+                    # belt-and-suspenders: the submit door validates
+                    # shapes, so this is only reachable for payloads
+                    # that bypassed it — name the field and the schema
+                    # instead of numpy's bare "Output array is the
+                    # wrong shape"
+                    raise ValueError(
+                        f"request field {k!r} rows (shape "
+                        f"{np.shape(rows[0])}) do not match net input "
+                        f"shape {dst.shape[1:]}") from e
             dst[n:] = 0
             dirty.add(k)
         return buf

@@ -246,6 +246,14 @@ class RunConfig:
     status_port: Optional[int] = None
     status_host: str = "127.0.0.1"
     status_address: Optional[Tuple[str, int]] = None
+    # SLO ledger (obs/history.py): history=True runs the metrics-history
+    # sampler in the training process — bounded multi-resolution rings
+    # behind a /timeseries route on the status server, with optional
+    # JSONL shard persistence under history_dir for `sparknet-slo`
+    # retrospective reports. Off by default (zero overhead unless asked).
+    history: bool = False
+    history_dir: Optional[str] = None
+    history_interval_s: float = 1.0
     trace_out: Optional[str] = None
     # pod-scope observability (obs/pod.py). pod_dir is a shared prefix —
     # local/NFS dir or a gs://|s3:// bucket — where EVERY worker rewrites

@@ -304,16 +304,22 @@ def test_concurrent_clients_every_request_answered_exactly_once(server,
         assert 1 <= n <= bucket <= server.cfg.max_batch
 
 
-def test_mis_shaped_request_fails_alone(server):
-    """A bad request in the same window as good ones fails ITS future;
-    the good requests still answer (signature grouping)."""
+def test_mis_shaped_request_rejected_at_the_door(server):
+    """A mis-shaped request is a TYPED ValueError at submit() — the
+    frontends' 400 ladder — never a batch-mate poisoner. It used to
+    survive to the pre-sized pad path, where `np.stack(rows,
+    out=buf[:n])` blew up the WHOLE signature group with an opaque
+    "Output array is the wrong shape" server-side 500."""
     good = [server.submit(_example(i)) for i in range(2)]
-    bad = server.submit({"data": np.zeros((7, 7, 1), np.float32)})
+    with pytest.raises(ValueError, match=r"\(7, 7, 1\)"):
+        server.submit({"data": np.zeros((7, 7, 1), np.float32)})
+    with pytest.raises(ValueError, match="not a net input"):
+        server.submit({"dta": _example(0)["data"]})
+    # co-batched good requests are untouched, and the bad one never
+    # entered the pipeline: no server-side failure is recorded
     for f in good:
         assert np.isfinite(f.result(timeout=30.0)["prob"]).all()
-    with pytest.raises(Exception):
-        bad.result(timeout=30.0)
-    assert server.status()["requests_failed"] == 1
+    assert server.status()["requests_failed"] == 0
 
 
 # -- parity ------------------------------------------------------------------

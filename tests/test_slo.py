@@ -1,0 +1,402 @@
+"""The SLO ledger (obs/history.py + obs/slo.py): ring-cascade fidelity
+against exact recomputation, histogram-ring quantiles against the exact
+order statistic (error bounded by the bucket ladder), burn-rate alert
+EDGE semantics (firing AND resolved, zero-traffic burns nothing), the
+FleetController's page-escalation fast lever (audited, still clamped by
+batch relief), the /timeseries + /slo/status routes over real HTTP, the
+shard round-trip, and the `sparknet-slo --selfcheck` end-to-end gate."""
+import json
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+# obs first: importing fleet before obs trips the utils.metrics <->
+# obs.reqtrace import cycle (obs/__init__ orders reqtrace last)
+from sparknet_tpu.obs import MetricsRegistry, StatusServer
+from sparknet_tpu.fleet import FleetConfig, FleetController, FleetPolicy
+from sparknet_tpu.obs.history import (HistoryConfig, MetricsHistory,
+                                      merge_slots, quantile_from_buckets,
+                                      read_history_shards)
+from sparknet_tpu.obs.slo import (LATENCY_METRIC, REQUESTS_METRIC,
+                                  BurnRateAlerter, SloSpec, build_report)
+from sparknet_tpu.obs.summary import summarize
+from sparknet_tpu.utils.logger import Logger
+from sparknet_tpu.utils.metrics import LatencyStats
+
+
+def _spec(**over):
+    kw = dict(model="m", latency_ms=50.0, availability=0.99,
+              window_s=120.0, fast_burn=8.0, fast_window_s=10.0,
+              fast_confirm_s=2.0, slow_burn=2.0, slow_window_s=60.0,
+              slow_confirm_s=10.0)
+    kw.update(over)
+    return SloSpec(**kw)
+
+
+# -- ring fidelity: the cascade must agree with exact recomputation ----------
+
+def test_ring_downsampling_matches_exact_recompute():
+    """Counter deltas and gauge envelopes read from the COARSE ring must
+    equal an exact recompute over the raw per-second sample stream —
+    slots merge losslessly (deltas add, envelopes widen), so
+    downsampling is a fold, not an approximation."""
+    reg = MetricsRegistry()
+    c = reg.counter("sparknet_test_total")
+    g = reg.gauge("sparknet_test_depth")
+    hist = MetricsHistory(reg, HistoryConfig(
+        sample_interval_s=1.0, rings=((1.0, 60), (10.0, 60))))
+    c.inc(0)  # materialize the series BEFORE the baseline sample
+    g.set(0.0)
+    t0 = time.time()
+    hist.sample_now(now=t0)  # first sight: baseline, no delta
+    rng = np.random.default_rng(7)
+    incs = rng.integers(0, 9, 120)
+    gvals = rng.uniform(-5.0, 5.0, 120)
+    for i in range(120):
+        c.inc(int(incs[i]))
+        g.set(float(gvals[i]))
+        hist.sample_now(now=t0 + 1 + i)
+    now = t0 + 121
+    # the full span only fits the 10 s ring: its folded delta must be
+    # the exact sum of every per-second increment
+    w = hist.window("sparknet_test_total", 600.0, now=now)
+    assert w["sparknet_test_total"]["delta"] == int(incs.sum())
+    # the fine ring answers short windows exactly too
+    w30 = hist.window("sparknet_test_total", 30.0, now=now)
+    assert w30["sparknet_test_total"]["delta"] == int(incs[-30:].sum())
+    # gauge envelope over the coarse ring: exact min/max/last
+    wg = hist.window("sparknet_test_depth", 600.0, now=now)
+    env = wg["sparknet_test_depth"]
+    assert env["last"] == pytest.approx(float(gvals[-1]))
+    assert env["min"] == pytest.approx(float(gvals.min()))
+    assert env["max"] == pytest.approx(float(gvals.max()))
+
+
+def test_histogram_ring_quantile_bounded_by_bucket_ladder():
+    """The ring-windowed p99 is interpolated from fixed buckets; against
+    the exact order statistic (LatencyStats over the SAME observations)
+    the error must stay inside one bucket-ladder rung (adjacent default
+    edges are <= 2.5x apart)."""
+    reg = MetricsRegistry()
+    stats = LatencyStats(window=4096, registry=reg, name=LATENCY_METRIC,
+                         model="m")
+    hist = MetricsHistory(reg, HistoryConfig(
+        sample_interval_s=1.0, rings=((1.0, 600),)))
+    stats.add(0.02)  # materialize the series before the baseline
+    t0 = time.time()
+    hist.sample_now(now=t0)
+    rng = np.random.default_rng(3)
+    draws = np.exp(rng.normal(np.log(0.02), 0.6, 2000))  # ~5..80 ms
+    for i in range(10):
+        for v in draws[i * 200:(i + 1) * 200]:
+            stats.add(float(v))
+        hist.sample_now(now=t0 + 1 + i)
+    for q in (0.5, 0.9, 0.99):
+        exact = stats.windowed_quantile(q, 300.0)
+        est = hist.windowed_quantile(LATENCY_METRIC, q, 300.0,
+                                     labels={"model": "m"}, now=t0 + 11)
+        assert exact is not None and est is not None
+        assert 1 / 2.6 < est / exact < 2.6, \
+            f"q={q}: ring {est} vs exact {exact}"
+
+
+def test_quantile_from_buckets_interpolation_and_inf_clamp():
+    le = [0.1, 1.0]  # finite edges only (snapshot convention); the
+    # overflow is count - sum(counts)
+    # 10 obs <= 0.1, 10 in (0.1, 1], none above: p50 sits mid-ladder
+    assert quantile_from_buckets(le, [10, 10], 20, 0.5) == \
+        pytest.approx(0.1)
+    assert quantile_from_buckets(le, [10, 10], 20, 0.75) == \
+        pytest.approx(0.55)
+    # all mass in the +Inf overflow clamps to the top finite edge
+    assert quantile_from_buckets(le, [0, 0], 10, 0.99) == \
+        pytest.approx(1.0)
+    assert quantile_from_buckets(le, [10, 10], 0, 0.5) is None
+
+
+# -- shard persistence -------------------------------------------------------
+
+def test_history_shards_roundtrip(tmp_path):
+    reg = MetricsRegistry()
+    c = reg.counter(REQUESTS_METRIC, labels=("model", "outcome"))
+    lat = reg.histogram(LATENCY_METRIC, labels=("model",))
+    hist = MetricsHistory(reg, HistoryConfig(
+        sample_interval_s=1.0, rings=((1.0, 600),),
+        persist_dir=str(tmp_path)))
+    c.inc(0, model="m", outcome="ok")  # pre-baseline registration
+    lat.observe(0.01, model="m")       # (one obs rides the baseline)
+    t0 = time.time()
+    hist.sample_now(now=t0)
+    for i in range(20):
+        c.inc(3, model="m", outcome="ok")
+        lat.observe(0.01, model="m")
+        hist.sample_now(now=t0 + 1 + i)
+    families, slots = read_history_shards(str(tmp_path))
+    # the meta row self-describes the families — including the bucket
+    # ladder the offline report's quantiles need
+    assert families[LATENCY_METRIC]["kind"] == "histogram"
+    assert families[LATENCY_METRIC]["le"][-1] == 10.0  # finite edges
+    merged = merge_slots(slots)
+    key = f'{REQUESTS_METRIC}{{model=m,outcome=ok}}'
+    assert merged.c[key] == 60
+    hkey = f'{LATENCY_METRIC}{{model=m}}'
+    assert merged.h[hkey][2] == 20  # n
+    assert sum(merged.h[hkey][0]) == 20  # per-bucket deltas
+
+
+# -- burn-rate alerting: edges, not levels ------------------------------------
+
+def _drive(alerter, hist, lat, req, t0, start, n, latency_s, outcome):
+    for i in range(start, start + n):
+        for _ in range(20):
+            lat.observe(latency_s, model="m")
+            req.inc(model="m", outcome=outcome)
+        hist.sample_now(now=t0 + i)
+        alerter.evaluate(now=t0 + i)
+
+
+def test_burn_edges_fire_and_resolve():
+    reg = MetricsRegistry()
+    lat = reg.histogram(LATENCY_METRIC, labels=("model",))
+    req = reg.counter(REQUESTS_METRIC, labels=("model", "outcome"))
+    hist = MetricsHistory(reg, HistoryConfig(
+        sample_interval_s=1.0, rings=((1.0, 600),)))
+    alerter = BurnRateAlerter(hist, [_spec()], registry=reg)
+    t0 = time.time()
+    _drive(alerter, hist, lat, req, t0, 0, 30, 0.005, "ok")
+    assert alerter.alerts_fired == 0  # quiet traffic must not page
+    assert alerter.firing_pages() == []
+    _drive(alerter, hist, lat, req, t0, 30, 20, 0.2, "failed")
+    assert "m" in alerter.firing_pages()
+    fired = alerter.alerts_fired
+    assert fired > 0
+    _drive(alerter, hist, lat, req, t0, 50, 40, 0.005, "ok")
+    assert alerter.firing_pages() == []  # short window lets it resolve
+    assert alerter.alerts_fired == fired  # resolve is not a new firing
+    edges = {(r["severity"], r["edge"]) for r in alerter.audit}
+    assert ("page", "firing") in edges and ("page", "resolved") in edges
+    # attainment rides every edge row (the sparknet-metrics hook)
+    assert all(0.0 <= r["attainment"] <= 1.0 for r in alerter.audit)
+
+
+def test_zero_traffic_burns_nothing():
+    reg = MetricsRegistry()
+    reg.histogram(LATENCY_METRIC, labels=("model",))
+    reg.counter(REQUESTS_METRIC, labels=("model", "outcome"))
+    hist = MetricsHistory(reg, HistoryConfig(
+        sample_interval_s=1.0, rings=((1.0, 600),)))
+    alerter = BurnRateAlerter(hist, [_spec()], registry=reg)
+    t0 = time.time()
+    for i in range(30):
+        hist.sample_now(now=t0 + i)
+        alerter.evaluate(now=t0 + i)
+    assert alerter.alerts_fired == 0
+    assert alerter.firing_pages() == []
+    g = reg.gauge("sparknet_slo_error_budget_remaining",
+                  labels=("model",))
+    assert g.value(model="m") == 1.0  # no traffic, no budget burned
+
+
+def test_spec_validation_fails_at_construction():
+    with pytest.raises(ValueError):
+        _spec(latency_ms=None, availability=None)  # no objective at all
+    with pytest.raises(ValueError):
+        _spec(availability=1.5)
+    with pytest.raises(ValueError):
+        _spec(fast_window_s=5.0, fast_confirm_s=10.0)  # confirm > long
+    with pytest.raises(ValueError):
+        BurnRateAlerter(
+            MetricsHistory(MetricsRegistry(), HistoryConfig()),
+            [_spec(), _spec()])  # one spec per model
+
+
+# -- the fleet controller's fast lever ----------------------------------------
+
+class _StubRouter:
+    """The minimal router surface the controller's fast lever reads."""
+
+    def __init__(self):
+        self.registry = MetricsRegistry()
+        self.cfg = types.SimpleNamespace(workers=1)
+        self.lanes = {}
+        self.replicas = {"m": []}
+        self.latency = {}
+
+    def attach_fleet(self, controller):
+        pass
+
+    def _replica_routable(self, rep):
+        return True
+
+
+class _StubAlerter:
+    def __init__(self):
+        self.pages = []
+
+    def firing_pages(self):
+        return list(self.pages)
+
+
+class _StubAdmission:
+    def __init__(self, starvation=0.0):
+        self.starvation = starvation
+        self.pressures = []
+
+    def set_pressure(self, p):
+        self.pressures.append(p)
+
+    def starvation_s(self):
+        return self.starvation
+
+
+def test_controller_page_escalation_edge_audited():
+    router = _StubRouter()
+    alerter = _StubAlerter()
+    fc = FleetController(router, cfg=FleetConfig(
+        interval_s=0.05, page_pressure=0.9,
+        policy=FleetPolicy(up_ticks=2, min_window_n=8)))
+    fc.attach_alerter(alerter)
+    fc.tick()
+    assert fc.pressure == 0.0  # quiet: no page, no pressure
+    alerter.pages = ["m"]
+    fc.tick()
+    assert fc.pressure == 0.9  # floored at page_pressure immediately
+    ev = fc.audit[-1]
+    assert (ev["model"], ev["direction"], ev["reason"]) == \
+        ("_slo", "pressure", "slo_page")
+    assert ev["models"] == "m"
+    n_audit = len(fc.audit)
+    fc.tick()
+    assert len(fc.audit) == n_audit  # edge, not level: no repeat rows
+    alerter.pages = []
+    fc.tick()
+    assert fc.pressure == 0.0  # page cleared -> lever releases
+
+
+def test_batch_relief_still_clamps_page_escalation():
+    """The scavenger-starvation clamp outranks the page floor: a firing
+    page must not weld the door shut on the low class forever."""
+    router = _StubRouter()
+    alerter = _StubAlerter()
+    alerter.pages = ["m"]
+    admission = _StubAdmission(starvation=120.0)
+    policy = FleetPolicy(up_ticks=2, min_window_n=8,
+                         batch_max_starvation_s=60.0)
+    fc = FleetController(router, admission=admission, cfg=FleetConfig(
+        interval_s=0.05, page_pressure=0.9, policy=policy))
+    fc.attach_alerter(alerter)
+    fc.tick()
+    assert fc.pressure == policy.batch_relief_pressure
+    assert admission.pressures[-1] == policy.batch_relief_pressure
+    kinds = {(e["direction"], e["reason"]) for e in fc.audit}
+    assert ("pressure", "slo_page") in kinds
+    assert ("relief", "batch_starvation") in kinds
+
+
+# -- the HTTP surface ---------------------------------------------------------
+
+def _get(srv, path):
+    host, port = srv.address
+    with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                timeout=5) as r:
+        return json.loads(r.read())
+
+
+def test_timeseries_and_slo_status_over_http():
+    reg = MetricsRegistry()
+    lat = reg.histogram(LATENCY_METRIC, labels=("model",))
+    req = reg.counter(REQUESTS_METRIC, labels=("model", "outcome"))
+    hist = MetricsHistory(reg, HistoryConfig(
+        sample_interval_s=1.0, rings=((1.0, 600),)))
+    alerter = BurnRateAlerter(hist, [_spec()], registry=reg)
+    t0 = time.time()
+    _drive(alerter, hist, lat, req, t0, 0, 10, 0.2, "failed")
+    srv = StatusServer(0, reg)
+    hist.attach_http(srv)
+    alerter.attach_http(srv)
+    try:
+        disco = _get(srv, "/timeseries")
+        assert LATENCY_METRIC in disco["families"]
+        assert disco["rings"][0]["res_s"] == 1.0
+        body = _get(srv, f"/timeseries?name={LATENCY_METRIC}"
+                         f"&window=600&q=0.99&model=m")
+        qv = body["quantile"]
+        assert qv["q"] == 0.99 and qv["value"] > 0.05  # a 200 ms tail
+        rate = _get(srv, f"/timeseries?name={REQUESTS_METRIC}"
+                         f"&window=600&outcome=failed")
+        key = f"{REQUESTS_METRIC}{{model=m,outcome=failed}}"
+        assert rate["agg"][key]["delta"] == 180  # post-baseline incs
+        slo = _get(srv, "/slo/status")
+        assert slo["specs"][0]["model"] == "m"
+        assert any(a["firing"] for a in slo["alerts"])
+        assert slo["audit"][-1]["edge"] == "firing"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv, "/timeseries?name=nope_total")
+        assert ei.value.code == 400  # unknown series: typed, not a 500
+    finally:
+        srv.stop()
+
+
+# -- the summarizer's SLO view ------------------------------------------------
+
+def test_summary_slo_view_from_alert_rows(tmp_path):
+    jsonl = tmp_path / "m.jsonl"
+    log = Logger(echo=False, jsonl_path=str(jsonl))
+    reg = MetricsRegistry()
+    lat = reg.histogram(LATENCY_METRIC, labels=("model",))
+    req = reg.counter(REQUESTS_METRIC, labels=("model", "outcome"))
+    hist = MetricsHistory(reg, HistoryConfig(
+        sample_interval_s=1.0, rings=((1.0, 600),)))
+    alerter = BurnRateAlerter(hist, [_spec()], registry=reg, logger=log)
+    t0 = time.time()
+    _drive(alerter, hist, lat, req, t0, 0, 15, 0.2, "failed")
+    _drive(alerter, hist, lat, req, t0, 15, 30, 0.005, "ok")
+    log.close()
+    recs = [json.loads(ln) for ln in
+            jsonl.read_text().splitlines() if ln]
+    s = summarize(recs)
+    view = s["slo"]
+    assert view["alert_edges"] >= 2
+    assert view["firing_at_end"] == []  # recovery resolved everything
+    m = view["models"]["m"]
+    assert m["pages"] >= 1
+    assert 0.0 < m["attainment"]["latency"] < 1.0
+
+
+# -- offline report + the end-to-end selfcheck --------------------------------
+
+def test_build_report_from_shards_and_journal(tmp_path):
+    hist_dir = tmp_path / "history"
+    jsonl = tmp_path / "journal.jsonl"
+    log = Logger(echo=False, jsonl_path=str(jsonl))
+    reg = MetricsRegistry()
+    lat = reg.histogram(LATENCY_METRIC, labels=("model",))
+    req = reg.counter(REQUESTS_METRIC, labels=("model", "outcome"))
+    hist = MetricsHistory(reg, HistoryConfig(
+        sample_interval_s=1.0, rings=((1.0, 600),),
+        persist_dir=str(hist_dir)))
+    alerter = BurnRateAlerter(hist, [_spec()], registry=reg, logger=log)
+    t0 = time.time()
+    _drive(alerter, hist, lat, req, t0, 0, 20, 0.005, "ok")
+    _drive(alerter, hist, lat, req, t0, 20, 20, 0.2, "failed")
+    log.close()
+    rep = build_report(str(hist_dir), [str(jsonl)], [_spec()],
+                       report_window_s=10)
+    m = rep["models"]["m"]
+    # 800 sent minus 2 first-sight baselines (each outcome series'
+    # first sample establishes a baseline, not a delta)
+    assert m["requests"] == 760
+    assert 0.0 < m["availability"] < 1.0
+    latency = m["slo"]["latency"]
+    assert latency["attainment"] < 1.0  # the burn shows up
+    assert latency["worst_windows"][0]["err_frac"] > 0.5
+    assert any(a["edge"] == "firing" for a in rep["alerts"])
+
+
+def test_sparknet_slo_selfcheck_end_to_end(tmp_path):
+    from sparknet_tpu.obs.slo import main as slo_main
+    assert slo_main(["--selfcheck", "--keep", str(tmp_path)]) == 0
